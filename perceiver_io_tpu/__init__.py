@@ -1,0 +1,47 @@
+"""perceiver_io_tpu — a TPU-native (JAX/XLA/Pallas/pjit) Perceiver IO framework.
+
+A from-scratch rebuild of the capabilities of the reference PyTorch/Lightning
+implementation (DartingMelody/perceiver-io): generic Perceiver encoder/decoder
+core with injected modality adapters, MLM pretraining, encoder transfer, and
+image classification — designed TPU-first:
+
+- pure-functional flax.linen modules jitted end-to-end,
+- SPMD over a `jax.sharding.Mesh` (data/model/sequence axes) instead of DDP,
+- a fused Pallas latent-attention kernel on the hot path,
+- host-side data/tokenizer pipeline feeding device prefetch.
+
+Public API mirrors the reference package surface (reference
+`perceiver/__init__.py:1-13`).
+"""
+
+from perceiver_io_tpu.models.adapters import (
+    InputAdapter,
+    OutputAdapter,
+    ImageInputAdapter,
+    TextInputAdapter,
+    ClassificationOutputAdapter,
+    TextOutputAdapter,
+)
+from perceiver_io_tpu.models.perceiver import (
+    PerceiverEncoder,
+    PerceiverDecoder,
+    PerceiverIO,
+    PerceiverMLM,
+)
+from perceiver_io_tpu.ops.masking import TextMasking
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "InputAdapter",
+    "OutputAdapter",
+    "ImageInputAdapter",
+    "TextInputAdapter",
+    "ClassificationOutputAdapter",
+    "TextOutputAdapter",
+    "PerceiverEncoder",
+    "PerceiverDecoder",
+    "PerceiverIO",
+    "PerceiverMLM",
+    "TextMasking",
+]

@@ -1,0 +1,8 @@
+from perceiver_io_tpu.data.tokenizer import (
+    PAD_TOKEN,
+    UNK_TOKEN,
+    MASK_TOKEN,
+    SPECIAL_TOKENS,
+)
+
+__all__ = ["PAD_TOKEN", "UNK_TOKEN", "MASK_TOKEN", "SPECIAL_TOKENS"]

@@ -1,0 +1,13 @@
+"""Tokenizer constants (full first-party WordPiece pipeline lands with the
+data layer; see SURVEY.md §7 step 4).
+
+Special-token contract matches the reference (``perceiver/tokenizer.py:10-15``):
+``[PAD]``, ``[UNK]``, ``[MASK]`` occupy ids 0, 1, 2 — the masking op relies on
+special tokens filling the first ids (reference ``model.py:284-289``).
+"""
+
+PAD_TOKEN = "[PAD]"
+UNK_TOKEN = "[UNK]"
+MASK_TOKEN = "[MASK]"
+
+SPECIAL_TOKENS = [PAD_TOKEN, UNK_TOKEN, MASK_TOKEN]

@@ -1,0 +1,27 @@
+from perceiver_io_tpu.models.adapters import (
+    InputAdapter,
+    OutputAdapter,
+    ImageInputAdapter,
+    TextInputAdapter,
+    ClassificationOutputAdapter,
+    TextOutputAdapter,
+)
+from perceiver_io_tpu.models.perceiver import (
+    PerceiverEncoder,
+    PerceiverDecoder,
+    PerceiverIO,
+    PerceiverMLM,
+)
+
+__all__ = [
+    "InputAdapter",
+    "OutputAdapter",
+    "ImageInputAdapter",
+    "TextInputAdapter",
+    "ClassificationOutputAdapter",
+    "TextOutputAdapter",
+    "PerceiverEncoder",
+    "PerceiverDecoder",
+    "PerceiverIO",
+    "PerceiverMLM",
+]

@@ -1,0 +1,198 @@
+"""Task-specific input/output adapters.
+
+The adapter contract is the reference's central extensibility mechanism
+(``perceiver/adapter.py:9-32``), preserved here as flax modules satisfying a
+shape contract:
+
+- input adapters map task input to ``(B, M, C_in)`` and expose
+  ``num_input_channels`` (read by the encoder to size cross-attention KV,
+  reference ``model.py:153``);
+- output adapters map generic decoder output ``(B, K, C_out)`` to task output
+  and expose ``output_shape == (K, C_out)`` (read by the decoder to size its
+  learned query array, reference ``model.py:213-222``).
+
+Because flax modules are dataclasses, both properties are derivable from
+constructor fields on *unbound* instances — so the encoder/decoder can read
+them at construction time exactly like the reference does.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from perceiver_io_tpu.ops.attention import (
+    torch_linear_bias_init,
+    torch_linear_kernel_init,
+)
+from perceiver_io_tpu.ops.fourier import (
+    fourier_position_encodings,
+    num_position_encoding_channels,
+    spatial_positions,
+)
+
+Array = jax.Array
+
+
+def uniform_init(low: float, high: float):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, dtype, low, high)
+
+    return init
+
+
+class InputAdapter(nn.Module):
+    """ABC for input adapters (reference ``adapter.py:9-19``)."""
+
+    @property
+    def num_input_channels(self) -> int:
+        raise NotImplementedError
+
+    def __call__(self, x: Array) -> Array:
+        raise NotImplementedError
+
+
+class OutputAdapter(nn.Module):
+    """ABC for output adapters (reference ``adapter.py:22-32``)."""
+
+    @property
+    def output_shape(self) -> Tuple[int, int]:
+        raise NotImplementedError
+
+    def __call__(self, x: Array) -> Array:
+        raise NotImplementedError
+
+
+class ImageInputAdapter(InputAdapter):
+    """Flatten image to (B, H*W, C) and concat Fourier position encodings.
+
+    Reference ``adapter.py:35-109``: coordinates evenly spaced in [-1, 1] per
+    spatial dim; ``num_frequency_bands`` linearly spaced frequencies
+    1.0 → size/2 with sin+cos plus raw positions; encodings computed once per
+    shape and folded into the compiled program as a constant.
+    """
+
+    image_shape: Tuple[int, ...] = (28, 28, 1)
+    num_frequency_bands: int = 32
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def spatial_shape(self) -> Tuple[int, ...]:
+        return self.image_shape[:-1]
+
+    @property
+    def num_image_channels(self) -> int:
+        return self.image_shape[-1]
+
+    @property
+    def num_input_channels(self) -> int:
+        return self.num_image_channels + num_position_encoding_channels(
+            len(self.spatial_shape), self.num_frequency_bands
+        )
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b, *d = x.shape
+        if tuple(d) != tuple(self.image_shape):
+            raise ValueError(
+                f"Input image shape {tuple(d)} different from required shape "
+                f"{tuple(self.image_shape)}"
+            )
+
+        pos = spatial_positions(self.spatial_shape)
+        enc = fourier_position_encodings(pos, self.num_frequency_bands)
+        enc = enc.reshape(-1, enc.shape[-1]).astype(self.dtype)  # (M, C_pos)
+
+        x = x.reshape(b, -1, self.num_image_channels).astype(self.dtype)
+        enc = jnp.broadcast_to(enc, (b, *enc.shape))
+        return jnp.concatenate([x, enc], axis=-1)
+
+
+class TextInputAdapter(InputAdapter):
+    """Token embedding * sqrt(C) + learned position encodings.
+
+    Reference ``adapter.py:112-133``: embedding init U(-0.1, 0.1), position
+    encodings (max_seq_len, C) init U(-0.5, 0.5), sliced to actual length.
+    """
+
+    vocab_size: int = 10003
+    max_seq_len: int = 512
+    num_channels: int = 64
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def num_input_channels(self) -> int:
+        return self.num_channels
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b, l = x.shape
+        if l > self.max_seq_len:
+            raise ValueError(f"sequence length {l} exceeds max_seq_len {self.max_seq_len}")
+
+        emb = nn.Embed(
+            num_embeddings=self.vocab_size,
+            features=self.num_channels,
+            embedding_init=uniform_init(-0.1, 0.1),
+            dtype=self.dtype,
+            name="text_embedding",
+        )(x)
+        pos_enc = self.param(
+            "pos_encoding",
+            uniform_init(-0.5, 0.5),
+            (self.max_seq_len, self.num_channels),
+        )
+        scale = math.sqrt(self.num_channels)
+        return emb * scale + pos_enc[:l].astype(self.dtype)
+
+
+class ClassificationOutputAdapter(OutputAdapter):
+    """Linear head over decoder output; squeezes the query dim when K == 1.
+
+    Reference ``adapter.py:136-149``: output_shape = (num_outputs, C_out) with
+    C_out defaulting to num_classes; torch-default Linear init.
+    """
+
+    num_classes: int = 2
+    num_outputs: int = 1
+    num_output_channels: Optional[int] = None
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def output_shape(self) -> Tuple[int, int]:
+        c = self.num_output_channels if self.num_output_channels is not None else self.num_classes
+        return (self.num_outputs, c)
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        c_in = self.output_shape[-1]
+        x = nn.Dense(
+            self.num_classes,
+            dtype=self.dtype,
+            kernel_init=torch_linear_kernel_init,
+            bias_init=torch_linear_bias_init(c_in),
+            name="linear",
+        )(x)
+        if x.shape[1] == 1:
+            x = jnp.squeeze(x, axis=1)
+        return x
+
+
+def TextOutputAdapter(
+    vocab_size: int,
+    max_seq_len: int,
+    num_output_channels: Optional[int] = None,
+    dtype: jnp.dtype = jnp.float32,
+) -> ClassificationOutputAdapter:
+    """Per-position vocab logits: a classification adapter with one output
+    query per sequence position (reference ``adapter.py:152-159``)."""
+    return ClassificationOutputAdapter(
+        num_classes=vocab_size,
+        num_outputs=max_seq_len,
+        num_output_channels=num_output_channels,
+        dtype=dtype,
+    )

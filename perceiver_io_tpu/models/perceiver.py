@@ -1,0 +1,222 @@
+"""The generic Perceiver IO core: encoder, decoder, and composed models.
+
+Architecture (reference ``perceiver/model.py``): arbitrary-modality inputs are
+cross-attended into a small fixed-size latent array — decoupling compute from
+input length M (the architectural long-context mechanism: all O(M) work is a
+single cross-attention per layer; quadratic self-attention touches only the N
+latents) — then decoded by cross-attending task-specific output queries
+against the latents.
+
+Key structural semantics preserved:
+
+- encoder layer 1 has unique weights; layers 2..num_layers share ONE weight
+  set applied recurrently (reference ``model.py:162-166,185-187``). In flax,
+  re-calling the same bound submodule shares parameters, and JAX autodiff
+  accumulates gradients across applications exactly like torch autograd.
+- learned latent / output-query arrays init ~N(0, 0.02) clamped to ±2
+  (reference ``model.py:169-174,222-227``).
+- the decoder validates the latent shape (reference ``model.py:232-233``) —
+  here at trace time, so the check costs nothing at run time.
+
+TPU-first choices: modules take a ``dtype`` (bfloat16 compute, f32 params),
+an ``attn_impl`` switch ('xla' einsum vs. fused Pallas kernel), and an
+optional ``remat`` flag that rematerializes each perceiver layer to trade
+FLOPs for HBM when the recurrent stack is deep.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from perceiver_io_tpu.ops.attention import CrossAttentionLayer, SelfAttentionBlock
+from perceiver_io_tpu.ops.masking import TextMasking
+
+Array = jax.Array
+
+
+def latent_init(std: float = 0.02, clamp: float = 2.0):
+    """~N(0, std) clamped to ±clamp (reference ``model.py:169-174``)."""
+
+    def init(key, shape, dtype=jnp.float32):
+        return jnp.clip(jax.random.normal(key, shape) * std, -clamp, clamp).astype(dtype)
+
+    return init
+
+
+class PerceiverLayer(nn.Module):
+    """One encoder layer: cross-attention (latent ← input) + self-attention block
+    (reference ``model.py:150-160``)."""
+
+    num_latent_channels: int
+    num_input_channels: int
+    num_cross_attention_heads: int
+    num_self_attention_heads: int
+    num_self_attention_layers_per_block: int
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, x_latent, x_input, pad_mask=None, deterministic=True):
+        x_latent = CrossAttentionLayer(
+            num_q_channels=self.num_latent_channels,
+            num_kv_channels=self.num_input_channels,
+            num_heads=self.num_cross_attention_heads,
+            dropout=self.dropout,
+            dtype=self.dtype,
+            attn_impl=self.attn_impl,
+            name="cross_attention_layer",
+        )(x_latent, x_input, pad_mask=pad_mask, deterministic=deterministic)
+        return SelfAttentionBlock(
+            num_layers=self.num_self_attention_layers_per_block,
+            num_channels=self.num_latent_channels,
+            num_heads=self.num_self_attention_heads,
+            dropout=self.dropout,
+            dtype=self.dtype,
+            attn_impl=self.attn_impl,
+            name="self_attention_block",
+        )(x_latent, deterministic=deterministic)
+
+
+class PerceiverEncoder(nn.Module):
+    """Generic Perceiver IO encoder (reference ``model.py:119-189``).
+
+    ``input_adapter`` is injected by the caller (the reference's inversion of
+    control, ``model.py:121,145``); its ``num_input_channels`` sizes the
+    cross-attention KV stream.
+    """
+
+    input_adapter: nn.Module
+    latent_shape: Tuple[int, int]
+    num_layers: int
+    num_cross_attention_heads: int = 4
+    num_self_attention_heads: int = 4
+    num_self_attention_layers_per_block: int = 2
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"
+    remat: bool = False
+
+    def _make_layer(self, name: str) -> nn.Module:
+        cls = nn.remat(PerceiverLayer) if self.remat else PerceiverLayer
+        return cls(
+            num_latent_channels=self.latent_shape[1],
+            num_input_channels=self.input_adapter.num_input_channels,
+            num_cross_attention_heads=self.num_cross_attention_heads,
+            num_self_attention_heads=self.num_self_attention_heads,
+            num_self_attention_layers_per_block=self.num_self_attention_layers_per_block,
+            dropout=self.dropout,
+            dtype=self.dtype,
+            attn_impl=self.attn_impl,
+            name=name,
+        )
+
+    @nn.compact
+    def __call__(self, x, pad_mask=None, deterministic=True):
+        b = x.shape[0]
+
+        x = self.input_adapter(x)
+
+        latent = self.param("latent", latent_init(), self.latent_shape)
+        x_latent = jnp.broadcast_to(latent.astype(self.dtype), (b, *self.latent_shape))
+
+        x_latent = self._make_layer("layer_1")(
+            x_latent, x, pad_mask=pad_mask, deterministic=deterministic
+        )
+        if self.num_layers > 1:
+            # One weight set used recurrently for layers 2..num_layers
+            # (reference model.py:162-166,185-187).
+            layer_n = self._make_layer("layer_n")
+            for _ in range(self.num_layers - 1):
+                x_latent = layer_n(
+                    x_latent, x, pad_mask=pad_mask, deterministic=deterministic
+                )
+        return x_latent
+
+
+class PerceiverDecoder(nn.Module):
+    """Generic Perceiver IO decoder (reference ``model.py:192-237``).
+
+    A learned output-query array of shape ``output_adapter.output_shape``
+    cross-attends against the latents, then the injected output adapter maps
+    the result to task output.
+    """
+
+    output_adapter: nn.Module
+    latent_shape: Tuple[int, int]
+    num_cross_attention_heads: int = 4
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "xla"
+
+    @nn.compact
+    def __call__(self, x, deterministic=True):
+        b, *d = x.shape
+        if tuple(d) != tuple(self.latent_shape):
+            raise ValueError(
+                f"Latent shape {tuple(d)} different from required shape "
+                f"{tuple(self.latent_shape)}"
+            )
+
+        output_shape = self.output_adapter.output_shape
+        output = self.param("output", latent_init(), tuple(output_shape))
+        x_output = jnp.broadcast_to(output.astype(self.dtype), (b, *output_shape))
+
+        x_output = CrossAttentionLayer(
+            num_q_channels=output_shape[-1],
+            num_kv_channels=self.latent_shape[1],
+            num_heads=self.num_cross_attention_heads,
+            dropout=self.dropout,
+            dtype=self.dtype,
+            attn_impl=self.attn_impl,
+            name="cross_attention_layer",
+        )(x_output, x, deterministic=deterministic)
+        return self.output_adapter(x_output)
+
+
+class PerceiverIO(nn.Module):
+    """encoder → decoder (reference ``model.py:321-325``)."""
+
+    encoder: PerceiverEncoder
+    decoder: PerceiverDecoder
+
+    def __call__(self, x, pad_mask=None, deterministic=True):
+        x_latent = self.encoder(x, pad_mask=pad_mask, deterministic=deterministic)
+        return self.decoder(x_latent, deterministic=deterministic)
+
+
+class PerceiverMLM(nn.Module):
+    """masking → encoder → decoder, logits truncated to input length
+    (reference ``model.py:296-318``).
+
+    Masking consumes the ``'masking'`` RNG stream, so a forward with
+    ``masking=True`` must be applied with ``rngs={'masking': key}``.
+    """
+
+    encoder: PerceiverEncoder
+    decoder: PerceiverDecoder
+    masking: TextMasking
+
+    def __call__(
+        self,
+        x_input: Array,
+        pad_mask: Optional[Array] = None,
+        masking: bool = True,
+        deterministic: bool = True,
+    ) -> Tuple[Array, Optional[Array]]:
+        _, l = x_input.shape
+
+        if masking:
+            key = self.make_rng("masking")
+            x_masked, x_labels = self.masking(key, x_input, pad_mask)
+        else:
+            x_masked = x_input
+            x_labels = None
+
+        x_latent = self.encoder(x_masked, pad_mask=pad_mask, deterministic=deterministic)
+        x_logits = self.decoder(x_latent, deterministic=deterministic)[:, :l, :]
+        return x_logits, x_labels

@@ -1,0 +1,30 @@
+from perceiver_io_tpu.ops.attention import (
+    MultiHeadAttention,
+    CrossAttention,
+    SelfAttention,
+    CrossAttentionLayer,
+    SelfAttentionLayer,
+    SelfAttentionBlock,
+    MLP,
+)
+from perceiver_io_tpu.ops.fourier import (
+    spatial_positions,
+    fourier_position_encodings,
+    num_position_encoding_channels,
+)
+from perceiver_io_tpu.ops.masking import TextMasking, apply_text_masking
+
+__all__ = [
+    "MultiHeadAttention",
+    "CrossAttention",
+    "SelfAttention",
+    "CrossAttentionLayer",
+    "SelfAttentionLayer",
+    "SelfAttentionBlock",
+    "MLP",
+    "spatial_positions",
+    "fourier_position_encodings",
+    "num_position_encoding_channels",
+    "TextMasking",
+    "apply_text_masking",
+]

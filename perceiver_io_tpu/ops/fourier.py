@@ -1,0 +1,76 @@
+"""Fourier position encodings, computed once on host/at-trace as constants.
+
+Matches the reference scheme (``perceiver/adapter.py:53-97``):
+
+- positions: per spatial dim, evenly spaced coordinates in [-1, 1]
+  (``linspace``), combined with an 'ij'-indexed meshgrid and stacked channel-last.
+- encodings: per dim *i*, ``num_bands`` frequencies linearly spaced from 1.0 to
+  ``max_freq_i / 2`` where ``max_freq_i`` defaults to the spatial size of dim
+  *i*; features are the raw positions followed by ``sin(pi f p)`` then
+  ``cos(pi f p)`` for every (dim, band) pair.
+
+Total channels: ``ndim * (2 * num_bands + include_positions)``.
+
+These are pure jnp functions; adapters precompute the encoding for one example
+and close over it as a traced constant, which XLA folds into the program (the
+analogue of the reference's ``register_buffer`` at ``adapter.py:43-51``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+def spatial_positions(
+    spatial_shape: Sequence[int], v_min: float = -1.0, v_max: float = 1.0
+) -> jnp.ndarray:
+    """Evenly spaced coordinates for each point of ``spatial_shape``.
+
+    Returns an array of shape ``(*spatial_shape, len(spatial_shape))`` with
+    values in ``[v_min, v_max]`` (reference ``adapter.py:53-62``).
+    """
+    coords = [jnp.linspace(v_min, v_max, num=s) for s in spatial_shape]
+    grid = jnp.meshgrid(*coords, indexing="ij")
+    return jnp.stack(grid, axis=-1)
+
+
+def fourier_position_encodings(
+    p: jnp.ndarray,
+    num_frequency_bands: int,
+    max_frequencies: Optional[Tuple[int, ...]] = None,
+    include_positions: bool = True,
+) -> jnp.ndarray:
+    """Fourier-encode positions ``p`` of shape ``(*d, c)`` with c = len(d).
+
+    Returns shape ``(*d, c * (2 * num_bands + include_positions))``
+    (reference ``adapter.py:64-94``; feature order: positions, all sins, all cosines).
+    """
+    if max_frequencies is None:
+        max_frequencies = p.shape[:-1]
+    if len(max_frequencies) != p.shape[-1]:
+        raise ValueError(
+            f"need one max frequency per position dim: got {len(max_frequencies)} "
+            f"for {p.shape[-1]} dims"
+        )
+
+    frequency_grids = []
+    for i, max_freq in enumerate(max_frequencies):
+        freqs = jnp.linspace(1.0, max_freq / 2.0, num=num_frequency_bands)
+        frequency_grids.append(p[..., i : i + 1] * freqs)
+
+    encodings = []
+    if include_positions:
+        encodings.append(p)
+    encodings.extend(jnp.sin(jnp.pi * g) for g in frequency_grids)
+    encodings.extend(jnp.cos(jnp.pi * g) for g in frequency_grids)
+    return jnp.concatenate(encodings, axis=-1)
+
+
+def num_position_encoding_channels(
+    num_spatial_dims: int, num_frequency_bands: int, include_positions: bool = True
+) -> int:
+    """Channel count produced by :func:`fourier_position_encodings`
+    (reference ``adapter.py:96-97``)."""
+    return num_spatial_dims * (2 * num_frequency_bands + int(include_positions))

@@ -1,0 +1,120 @@
+"""BERT-style MLM text masking as a pure function of (rng key, batch).
+
+Reproduces the reference corruption scheme exactly (``perceiver/model.py:240-293``),
+including its nested-draw idiosyncrasy:
+
+- special positions = ``(x == unk_id) | pad_mask``; only non-special positions
+  are candidates,
+- ``selected``   = Bernoulli(mask_p) ∧ candidate            (15% default),
+- ``selected_1`` = selected ∧ Bernoulli(0.9)                 (these become [MASK]),
+- ``selected_2`` = selected_1 ∧ Bernoulli(1/9)               (then overwritten with a
+  random non-special token — note selected_2 ⊆ selected_1, so the random
+  tokens are drawn *from the masked set*, giving the 80/10/10 marginal split),
+- labels are ``-100`` everywhere except selected positions.
+
+Random replacement tokens are uniform over ``[num_special_tokens, vocab_size)``,
+relying on the same contract as the reference (``model.py:284-289``): special
+tokens occupy the first ids.
+
+The device RNG is a threaded ``jax.random`` key, so masking is deterministic
+given (key, batch) — the TPU-native replacement for per-step CUDA RNG.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+IGNORE_LABEL = -100
+
+
+def apply_text_masking(
+    key: Array,
+    x: Array,
+    pad_mask: Array,
+    *,
+    vocab_size: int,
+    unk_token_id: int,
+    mask_token_id: int,
+    num_special_tokens: int,
+    mask_p: float = 0.15,
+) -> Tuple[Array, Array]:
+    """Corrupt token ids ``x`` (B, L) for MLM; returns ``(x_masked, labels)``.
+
+    ``pad_mask`` is True at padding positions. Labels are ``IGNORE_LABEL`` at
+    non-selected positions.
+    """
+    k_sel, k_mask90, k_rand19, k_tok = jax.random.split(key, 4)
+    shape = x.shape
+
+    if pad_mask is None:
+        pad_mask = jnp.zeros(shape, dtype=bool)
+
+    is_special = (x == unk_token_id) | pad_mask
+    is_input = ~is_special
+
+    is_selected = (jax.random.uniform(k_sel, shape) < mask_p) & is_input
+    is_selected_1 = is_selected & (jax.random.uniform(k_mask90, shape) < 0.9)
+    is_selected_2 = is_selected_1 & (jax.random.uniform(k_rand19, shape) < 1.0 / 9.0)
+
+    random_tokens = jax.random.randint(
+        k_tok, shape, num_special_tokens, vocab_size, dtype=x.dtype
+    )
+
+    x_masked = jnp.where(is_selected_1, jnp.asarray(mask_token_id, x.dtype), x)
+    x_masked = jnp.where(is_selected_2, random_tokens, x_masked)
+
+    # Labels must be signed so IGNORE_LABEL=-100 cannot wrap for unsigned
+    # token-id dtypes.
+    labels = jnp.where(is_selected, x.astype(jnp.int32), IGNORE_LABEL)
+    return x_masked, labels
+
+
+class TextMasking:
+    """Config holder mirroring the reference's ``TextMasking`` module surface
+    (``perceiver/model.py:240-263``), as a plain dataclass-style callable —
+    masking itself is stateless and keyed."""
+
+    def __init__(
+        self,
+        vocab_size: int,
+        unk_token_id: int,
+        mask_token_id: int,
+        num_special_tokens: int,
+        mask_p: float = 0.15,
+    ):
+        self.vocab_size = vocab_size
+        self.unk_token_id = unk_token_id
+        self.mask_token_id = mask_token_id
+        self.num_special_tokens = num_special_tokens
+        self.mask_p = mask_p
+
+    @classmethod
+    def create(cls, tokenizer, **kwargs):
+        """Build from a tokenizer exposing vocab_size / token_to_id, mirroring
+        ``TextMasking.create`` (reference ``model.py:254-260``)."""
+        from perceiver_io_tpu.data.tokenizer import UNK_TOKEN, MASK_TOKEN, SPECIAL_TOKENS
+
+        return cls(
+            vocab_size=tokenizer.get_vocab_size(),
+            unk_token_id=tokenizer.token_to_id(UNK_TOKEN),
+            mask_token_id=tokenizer.token_to_id(MASK_TOKEN),
+            num_special_tokens=len(SPECIAL_TOKENS),
+            **kwargs,
+        )
+
+    def __call__(self, key: Array, x: Array, pad_mask: Array) -> Tuple[Array, Array]:
+        return apply_text_masking(
+            key,
+            x,
+            pad_mask,
+            vocab_size=self.vocab_size,
+            unk_token_id=self.unk_token_id,
+            mask_token_id=self.mask_token_id,
+            num_special_tokens=self.num_special_tokens,
+            mask_p=self.mask_p,
+        )

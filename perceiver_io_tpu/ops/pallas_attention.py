@@ -1,0 +1,33 @@
+"""Fused latent-attention Pallas kernel (TPU).
+
+Covers the Perceiver hot path: cross-attention of a small resident latent/query
+block against a long KV stream (blockwise over M so the input never fully
+materializes in VMEM), and latent self-attention — the TPU-native replacement
+for the reference's ``torch.nn.MultiheadAttention`` CUDA kernels
+(reference ``perceiver/model.py:66-74``).
+
+Contract (enforced by the dispatcher in ``ops.attention``): no attention-prob
+dropout, optional key padding mask only.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+Array = jax.Array
+
+
+def fused_attention(
+    q: Array, k: Array, v: Array, pad_mask: Optional[Array] = None
+) -> Array:
+    """Fused multi-head attention over (B, T, H, D) q and (B, S, H, D) k/v.
+
+    Not yet implemented — the XLA einsum path in ``ops.attention`` is the
+    current production path; use ``attn_impl='xla'``.
+    """
+    raise NotImplementedError(
+        "The fused Pallas attention kernel has not landed yet; "
+        "construct modules with attn_impl='xla'."
+    )

@@ -1,0 +1,3 @@
+from perceiver_io_tpu.utils.platform import ensure_cpu_only
+
+__all__ = ["ensure_cpu_only"]

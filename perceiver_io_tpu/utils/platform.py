@@ -1,0 +1,53 @@
+"""Backend/platform helpers.
+
+This JAX build initializes *every* registered PJRT backend on first device
+access, even when ``JAX_PLATFORMS=cpu`` — so a wedged/absent accelerator
+plugin can hang CPU-only test runs. ``ensure_cpu_only`` drops non-CPU backend
+factories before the first device query, making CPU runs (tests, the
+multi-chip dry-run on a virtual device mesh) independent of accelerator
+plugin health.
+
+Call it BEFORE anything touches ``jax.devices()`` / creates arrays.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_cpu_only(device_count: int | None = None) -> None:
+    """Force this process to use only the CPU backend.
+
+    Optionally requests ``device_count`` virtual CPU devices (must run before
+    backends initialize; the XLA flag is ignored afterwards).
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if device_count is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={device_count}"
+            )
+
+    # Site customization (e.g. an accelerator tunnel) may have imported jax at
+    # interpreter boot, caching jax_platforms from the env before we ran —
+    # override the live config too, not just the env var.
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+    try:
+        import jax._src.xla_bridge as xb
+
+        # Drop only third-party plugin factories (e.g. a tunneled accelerator);
+        # standard platforms must stay registered — parts of jax (checkify's
+        # MLIR lowerings) validate against the known-platform set at import.
+        standard = {"cpu", "tpu", "cuda", "gpu", "rocm", "metal"}
+        for name in list(xb._backend_factories):
+            if name not in standard:
+                xb._backend_factories.pop(name, None)
+    except Exception:
+        pass  # private API moved — JAX_PLATFORMS alone may still suffice

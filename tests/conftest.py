@@ -1,0 +1,18 @@
+"""Test configuration: force CPU with 8 virtual XLA devices.
+
+Set before jax initializes any backend so SPMD/mesh tests can exercise an
+8-device mesh without TPU hardware (the JAX-native way to test sharding,
+SURVEY.md §4). Real-TPU runs happen only via bench.py / the driver.
+"""
+
+from perceiver_io_tpu.utils.platform import ensure_cpu_only
+
+ensure_cpu_only(device_count=8)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,69 @@
+"""Unit tests for Fourier position encodings (reference adapter.py:53-97 semantics)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from perceiver_io_tpu.ops.fourier import (
+    fourier_position_encodings,
+    num_position_encoding_channels,
+    spatial_positions,
+)
+
+
+def test_spatial_positions_range_and_shape():
+    pos = spatial_positions((5, 7))
+    assert pos.shape == (5, 7, 2)
+    # corners span [-1, 1] in each dim, 'ij' indexing
+    np.testing.assert_allclose(pos[0, 0], [-1.0, -1.0], atol=1e-6)
+    np.testing.assert_allclose(pos[-1, -1], [1.0, 1.0], atol=1e-6)
+    np.testing.assert_allclose(pos[-1, 0], [1.0, -1.0], atol=1e-6)
+    # dim 0 varies along axis 0 only
+    np.testing.assert_allclose(pos[2, :, 0], np.full(7, pos[2, 0, 0]), atol=1e-6)
+
+
+def test_spatial_positions_1d():
+    pos = spatial_positions((4,))
+    assert pos.shape == (4, 1)
+    np.testing.assert_allclose(pos[:, 0], [-1, -1 / 3, 1 / 3, 1], atol=1e-6)
+
+
+def test_channel_count():
+    assert num_position_encoding_channels(2, 32) == 2 * (2 * 32 + 1)
+    assert num_position_encoding_channels(3, 8, include_positions=False) == 3 * 16
+
+
+def test_encoding_structure():
+    bands = 4
+    pos = spatial_positions((6, 8))
+    enc = np.asarray(fourier_position_encodings(pos, bands))
+    assert enc.shape == (6, 8, 2 * (2 * bands + 1))
+
+    # layout: [positions (2)] [sin dim0 (bands)] [sin dim1 (bands)] [cos dim0] [cos dim1]
+    np.testing.assert_allclose(enc[..., :2], np.asarray(pos), atol=1e-6)
+
+    p = np.asarray(pos)
+    # frequencies linspace(1.0, size/2, bands) with max_freq = spatial size per dim
+    f0 = np.linspace(1.0, 6 / 2.0, bands)
+    f1 = np.linspace(1.0, 8 / 2.0, bands)
+    sin0 = np.sin(np.pi * p[..., :1] * f0)
+    sin1 = np.sin(np.pi * p[..., 1:2] * f1)
+    cos0 = np.cos(np.pi * p[..., :1] * f0)
+    cos1 = np.cos(np.pi * p[..., 1:2] * f1)
+    np.testing.assert_allclose(enc[..., 2 : 2 + bands], sin0, atol=1e-5)
+    np.testing.assert_allclose(enc[..., 2 + bands : 2 + 2 * bands], sin1, atol=1e-5)
+    np.testing.assert_allclose(enc[..., 2 + 2 * bands : 2 + 3 * bands], cos0, atol=1e-5)
+    np.testing.assert_allclose(enc[..., 2 + 3 * bands :], cos1, atol=1e-5)
+
+
+def test_max_frequencies_override():
+    pos = spatial_positions((6,))
+    enc = fourier_position_encodings(pos, 3, max_frequencies=(10,))
+    f = np.linspace(1.0, 5.0, 3)
+    expected_sin = np.sin(np.pi * np.asarray(pos)[..., :1] * f)
+    np.testing.assert_allclose(np.asarray(enc)[..., 1:4], expected_sin, atol=1e-5)
+
+
+def test_exclude_positions():
+    pos = spatial_positions((5, 5))
+    enc = fourier_position_encodings(pos, 2, include_positions=False)
+    assert enc.shape == (5, 5, 2 * 2 * 2)

@@ -1,0 +1,107 @@
+"""Statistical tests for MLM text masking (reference model.py:240-293 semantics)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from perceiver_io_tpu.ops.masking import IGNORE_LABEL, TextMasking, apply_text_masking
+
+VOCAB = 100
+UNK, MASK = 1, 2
+NUM_SPECIAL = 3
+
+
+def make_batch(rng, b=64, l=256, pad_frac=0.2):
+    x = rng.integers(NUM_SPECIAL, VOCAB, size=(b, l)).astype(np.int32)
+    pad_mask = np.zeros((b, l), dtype=bool)
+    n_pad = int(l * pad_frac)
+    pad_mask[:, -n_pad:] = True
+    x[pad_mask] = 0
+    # sprinkle some UNKs
+    unk_pos = rng.random((b, l)) < 0.01
+    x[unk_pos & ~pad_mask] = UNK
+    return jnp.asarray(x), jnp.asarray(pad_mask)
+
+
+def run_masking(key, x, pad_mask, mask_p=0.15):
+    return apply_text_masking(
+        key, x, pad_mask,
+        vocab_size=VOCAB, unk_token_id=UNK, mask_token_id=MASK,
+        num_special_tokens=NUM_SPECIAL, mask_p=mask_p,
+    )
+
+
+def test_marginal_distribution(rng):
+    x, pad = make_batch(rng, b=128, l=512)
+    xm, labels = run_masking(jax.random.key(0), x, pad)
+    x, pad, xm, labels = map(np.asarray, (x, pad, xm, labels))
+
+    candidates = (x != UNK) & ~pad
+    selected = labels != IGNORE_LABEL
+    frac_selected = selected.sum() / candidates.sum()
+    assert 0.13 < frac_selected < 0.17
+
+    # of selected: ~80% MASK, ~10% random(!=orig, mostly), ~10% unchanged
+    sel_masked = selected & (xm == MASK)
+    sel_unchanged = selected & (xm == x)
+    frac_masked = sel_masked.sum() / selected.sum()
+    frac_unchanged = sel_unchanged.sum() / selected.sum()
+    assert 0.76 < frac_masked < 0.84
+    # unchanged includes the 10% kept + random draws that hit the original (~1/97)
+    assert 0.07 < frac_unchanged < 0.14
+
+
+def test_labels_preserve_originals(rng):
+    x, pad = make_batch(rng)
+    xm, labels = run_masking(jax.random.key(1), x, pad)
+    x, labels = np.asarray(x), np.asarray(labels)
+    selected = labels != IGNORE_LABEL
+    np.testing.assert_array_equal(labels[selected], x[selected])
+
+
+def test_specials_never_selected(rng):
+    x, pad = make_batch(rng)
+    xm, labels = run_masking(jax.random.key(2), x, pad)
+    x, pad, xm, labels = map(np.asarray, (x, pad, xm, labels))
+    specials = (x == UNK) | pad
+    assert (labels[specials] == IGNORE_LABEL).all()
+    # special positions are untouched in the corrupted input
+    np.testing.assert_array_equal(xm[specials], x[specials])
+
+
+def test_random_tokens_in_valid_range(rng):
+    x, pad = make_batch(rng, b=256)
+    xm, labels = run_masking(jax.random.key(3), x, pad)
+    xm = np.asarray(xm)
+    assert xm.min() >= 0 and xm.max() < VOCAB
+    # corrupted tokens that are neither MASK nor original must be >= NUM_SPECIAL
+    x, labels = np.asarray(x), np.asarray(labels)
+    randomized = (labels != IGNORE_LABEL) & (xm != MASK) & (xm != x)
+    if randomized.any():
+        assert xm[randomized].min() >= NUM_SPECIAL
+
+
+def test_deterministic_given_key(rng):
+    x, pad = make_batch(rng)
+    a = run_masking(jax.random.key(7), x, pad)
+    b = run_masking(jax.random.key(7), x, pad)
+    np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+    c = run_masking(jax.random.key(8), x, pad)
+    assert not np.array_equal(np.asarray(a[0]), np.asarray(c[0]))
+
+
+def test_mask_p_zero(rng):
+    x, pad = make_batch(rng)
+    xm, labels = run_masking(jax.random.key(0), x, pad, mask_p=0.0)
+    np.testing.assert_array_equal(np.asarray(xm), np.asarray(x))
+    assert (np.asarray(labels) == IGNORE_LABEL).all()
+
+
+def test_jit_compatible(rng):
+    x, pad = make_batch(rng, b=8, l=32)
+    masking = TextMasking(
+        vocab_size=VOCAB, unk_token_id=UNK, mask_token_id=MASK, num_special_tokens=NUM_SPECIAL
+    )
+    f = jax.jit(masking.__call__)
+    xm, labels = f(jax.random.key(0), x, pad)
+    assert xm.shape == x.shape and labels.shape == x.shape
