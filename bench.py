@@ -1,0 +1,119 @@
+"""Benchmark: MLM training-step throughput, printed as ONE JSON line.
+
+Measures tokens/sec/chip for the reference train_mlm-equivalent hot loop
+(IMDB config: 512-token sequences, 256 latents, 3 encoder layers × 6
+self-attention layers per block, batch 64 — SURVEY.md §3.1 / BASELINE.md) on
+whatever accelerator jax selects (the driver runs this on the real TPU chip).
+
+The reference publishes no throughput numbers (BASELINE.md), so
+``vs_baseline`` is the ratio against the value recorded in BASELINE.json's
+``published`` map when present, else 1.0.
+
+Env knobs: PIT_BENCH_CPU=1 forces CPU; PIT_BENCH_STEPS / PIT_BENCH_BATCH
+override defaults.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main() -> None:
+    if os.environ.get("PIT_BENCH_CPU") == "1":
+        from perceiver_io_tpu.utils.platform import ensure_cpu_only
+
+        ensure_cpu_only()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import perceiver_io_tpu as pit
+    from perceiver_io_tpu.ops.masking import TextMasking
+    from perceiver_io_tpu.training import (
+        OptimizerConfig,
+        TrainState,
+        make_mlm_steps,
+        make_optimizer,
+    )
+
+    vocab, seq_len = 10003, 512
+    num_latents, channels = 256, 64
+    batch_size = int(os.environ.get("PIT_BENCH_BATCH", "64"))
+    steps = int(os.environ.get("PIT_BENCH_STEPS", "20"))
+    compute_dtype = jnp.bfloat16
+
+    latent_shape = (num_latents, channels)
+    model = pit.PerceiverMLM(
+        encoder=pit.PerceiverEncoder(
+            input_adapter=pit.TextInputAdapter(
+                vocab_size=vocab, max_seq_len=seq_len, num_channels=channels,
+                dtype=compute_dtype,
+            ),
+            latent_shape=latent_shape,
+            num_layers=3,
+            num_self_attention_layers_per_block=6,
+            dtype=compute_dtype,
+        ),
+        decoder=pit.PerceiverDecoder(
+            output_adapter=pit.TextOutputAdapter(
+                vocab_size=vocab, max_seq_len=seq_len, num_output_channels=channels,
+                dtype=compute_dtype,
+            ),
+            latent_shape=latent_shape,
+            dtype=compute_dtype,
+        ),
+        masking=TextMasking(vocab_size=vocab, unk_token_id=1, mask_token_id=2,
+                            num_special_tokens=3),
+    )
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "token_ids": jnp.asarray(
+            rng.integers(3, vocab, (batch_size, seq_len)).astype(np.int32)
+        ),
+        "pad_mask": jnp.zeros((batch_size, seq_len), dtype=bool),
+    }
+    variables = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        batch["token_ids"], batch["pad_mask"],
+    )
+    tx, schedule = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    state = TrainState.create(variables["params"], tx, jax.random.key(2))
+    train_step, _, _ = make_mlm_steps(model, schedule)
+    step = jax.jit(train_step, donate_argnums=(0,))
+
+    # warmup / compile
+    for _ in range(3):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    elapsed = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    tokens_per_sec_per_chip = batch_size * seq_len * steps / elapsed / n_chips
+
+    baseline = None
+    try:
+        with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as f:
+            baseline = json.load(f).get("published", {}).get("mlm_tokens_per_sec_per_chip")
+    except Exception:
+        pass
+    vs_baseline = tokens_per_sec_per_chip / baseline if baseline else 1.0
+
+    print(json.dumps({
+        "metric": "mlm_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs_baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

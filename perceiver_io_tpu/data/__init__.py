@@ -3,6 +3,46 @@ from perceiver_io_tpu.data.tokenizer import (
     UNK_TOKEN,
     MASK_TOKEN,
     SPECIAL_TOKENS,
+    WordPieceTokenizer,
+    create_tokenizer,
+    train_tokenizer,
+    save_tokenizer,
+    load_tokenizer,
+)
+from perceiver_io_tpu.data.pipeline import DataLoader, prefetch_to_device
+from perceiver_io_tpu.data.imdb import (
+    Collator,
+    IMDBDataModule,
+    IMDBDataset,
+    load_split,
+    synthetic_reviews,
+)
+from perceiver_io_tpu.data.mnist import (
+    MNISTDataModule,
+    MNISTDataset,
+    load_mnist,
+    synthetic_digits,
 )
 
-__all__ = ["PAD_TOKEN", "UNK_TOKEN", "MASK_TOKEN", "SPECIAL_TOKENS"]
+__all__ = [
+    "PAD_TOKEN",
+    "UNK_TOKEN",
+    "MASK_TOKEN",
+    "SPECIAL_TOKENS",
+    "WordPieceTokenizer",
+    "create_tokenizer",
+    "train_tokenizer",
+    "save_tokenizer",
+    "load_tokenizer",
+    "DataLoader",
+    "prefetch_to_device",
+    "Collator",
+    "IMDBDataModule",
+    "IMDBDataset",
+    "load_split",
+    "synthetic_reviews",
+    "MNISTDataModule",
+    "MNISTDataset",
+    "load_mnist",
+    "synthetic_digits",
+]

@@ -1,0 +1,228 @@
+"""IMDB sentiment / MLM text data module.
+
+Mirrors the reference data layer's surface and on-disk layout (reference
+``data/imdb.py``): reads the ``aclImdb/{split}/{neg,pos}/*.txt`` tree under
+``<root>/IMDB`` (so an existing download cache drops in unchanged), trains and
+caches a WordPiece tokenizer at ``<root>/imdb-tokenizer-<vocab>.json`` on
+first use, and collates batches by padding/truncating to ``max_seq_len`` with
+``pad_mask = token_ids == pad_id``.
+
+Differences, by design:
+
+- tokenization is first-party (``data/tokenizer.py``) — no Rust dependency;
+- this box has zero egress, so there is no downloader; ``synthetic=True``
+  substitutes a deterministic generated corpus with the same interface
+  (word-soup reviews with a sentiment-correlated vocabulary) for tests,
+  benchmarks, and smoke training;
+- batches are dicts of numpy arrays feeding the SPMD input pipeline
+  (``data/pipeline.py``) instead of torch tensors.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from perceiver_io_tpu.data.pipeline import DataLoader
+from perceiver_io_tpu.data.tokenizer import (
+    PAD_TOKEN,
+    WordPieceTokenizer,
+    create_tokenizer,
+    load_tokenizer,
+    save_tokenizer,
+    train_tokenizer,
+)
+
+_POSITIVE_WORDS = (
+    "awesome brilliant captivating delightful excellent fantastic great "
+    "inspiring lovely masterful moving outstanding perfect powerful stunning "
+    "superb touching wonderful gripping charming"
+).split()
+_NEGATIVE_WORDS = (
+    "awful boring clumsy disappointing dreadful horrible lazy mediocre "
+    "miserable painful pointless predictable shallow sloppy terrible tedious "
+    "unwatchable weak wooden forgettable"
+).split()
+_NEUTRAL_WORDS = (
+    "movie film story plot actor actress director scene script camera music "
+    "ending character dialogue performance production audience screen watch "
+    "time people year minute way thing life world night day man woman"
+).split()
+
+
+def synthetic_reviews(
+    n: int, seed: int = 0, min_words: int = 20, max_words: int = 120
+) -> Tuple[List[str], List[int]]:
+    """Deterministic sentiment-labelled word-soup corpus (zero-egress stand-in
+    for the IMDB download)."""
+    rng = np.random.default_rng(seed)
+    texts, labels = [], []
+    for i in range(n):
+        label = int(rng.integers(0, 2))
+        length = int(rng.integers(min_words, max_words))
+        sentiment = _POSITIVE_WORDS if label else _NEGATIVE_WORDS
+        words = [
+            str(rng.choice(sentiment)) if rng.random() < 0.3 else str(rng.choice(_NEUTRAL_WORDS))
+            for _ in range(length)
+        ]
+        texts.append(" ".join(words))
+        labels.append(label)
+    return texts, labels
+
+
+def load_split(root: str, split: str) -> Tuple[List[str], List[int]]:
+    """Read the aclImdb directory tree (reference ``data/imdb.py:24-38`` layout)."""
+    if split not in ("train", "test"):
+        raise ValueError(f"invalid split: {split}")
+    texts: List[str] = []
+    labels: List[int] = []
+    for label, name in enumerate(("neg", "pos")):
+        pattern = os.path.join(root, "IMDB", "aclImdb", split, name, "*.txt")
+        for path in sorted(glob.glob(pattern)):
+            with open(path, encoding="utf-8") as f:
+                texts.append(f.read())
+            labels.append(label)
+    if not texts:
+        raise FileNotFoundError(
+            f"no IMDB data under {os.path.join(root, 'IMDB', 'aclImdb', split)} — "
+            "place the aclImdb tree there, or use synthetic=True"
+        )
+    return texts, labels
+
+
+class IMDBDataset:
+    def __init__(self, texts: Sequence[str], labels: Sequence[int]):
+        assert len(texts) == len(labels)
+        self.texts = list(texts)
+        self.labels = list(labels)
+
+    def __len__(self) -> int:
+        return len(self.texts)
+
+    def __getitem__(self, i: int) -> Tuple[int, str]:
+        return self.labels[i], self.texts[i]
+
+
+class Collator:
+    """Pad/truncate to ``max_seq_len``; emit labels, ids and pad mask
+    (reference ``data/imdb.py:52-68`` contract, dict-of-arrays form)."""
+
+    def __init__(self, tokenizer: WordPieceTokenizer, max_seq_len: int):
+        self.tokenizer = tokenizer
+        self.max_seq_len = max_seq_len
+        self.pad_id = tokenizer.token_to_id(PAD_TOKEN)
+        tokenizer.enable_truncation(max_seq_len)
+        tokenizer.enable_padding()
+
+    def collate(self, batch: Sequence[Tuple[int, str]]) -> Dict[str, np.ndarray]:
+        labels = np.asarray([y for y, _ in batch], dtype=np.int32)
+        encoded = self.tokenizer.encode_batch([x for _, x in batch])
+        width = self.max_seq_len  # static width: SPMD-friendly, no recompiles
+        ids = np.full((len(batch), width), self.pad_id, dtype=np.int32)
+        for i, e in enumerate(encoded):
+            ids[i, : len(e)] = e[:width]
+        pad_mask = ids == self.pad_id
+        return {"label": labels, "token_ids": ids, "pad_mask": pad_mask}
+
+    def encode(self, samples: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+        """Wrap raw strings for the predict path (reference ``imdb.py:66-68``)."""
+        batch = self.collate([(0, s) for s in samples])
+        return batch["token_ids"], batch["pad_mask"]
+
+
+class IMDBDataModule:
+    """Prepare/setup/loader surface mirroring the reference module
+    (``data/imdb.py:71-149``), backed by the first-party pipeline."""
+
+    def __init__(
+        self,
+        root: str = ".cache",
+        max_seq_len: int = 512,
+        vocab_size: int = 10003,
+        batch_size: int = 64,
+        synthetic: bool = False,
+        synthetic_size: int = 2048,
+        seed: int = 0,
+        shard_id: int = 0,
+        num_shards: int = 1,
+    ):
+        self.root = root
+        self.max_seq_len = max_seq_len
+        self.vocab_size = vocab_size
+        self.batch_size = batch_size
+        self.synthetic = synthetic
+        self.synthetic_size = synthetic_size
+        self.seed = seed
+        self.shard_id = shard_id
+        self.num_shards = num_shards
+
+        suffix = "synthetic-" if synthetic else ""
+        self.tokenizer_path = os.path.join(root, f"imdb-{suffix}tokenizer-{vocab_size}.json")
+        self.tokenizer: Optional[WordPieceTokenizer] = None
+        self.collator: Optional[Collator] = None
+        self.ds_train: Optional[IMDBDataset] = None
+        self.ds_valid: Optional[IMDBDataset] = None
+
+    @classmethod
+    def create(cls, args) -> "IMDBDataModule":
+        return cls(
+            root=args.root,
+            max_seq_len=args.max_seq_len,
+            vocab_size=args.vocab_size,
+            batch_size=args.batch_size,
+            synthetic=getattr(args, "synthetic", False),
+        )
+
+    def _train_texts(self) -> Tuple[List[str], List[int]]:
+        if self.synthetic:
+            return synthetic_reviews(self.synthetic_size, seed=self.seed)
+        return load_split(self.root, "train")
+
+    def _valid_texts(self) -> Tuple[List[str], List[int]]:
+        if self.synthetic:
+            return synthetic_reviews(max(self.synthetic_size // 8, 64), seed=self.seed + 1)
+        return load_split(self.root, "test")  # val = test split, as the reference
+
+    def prepare_data(self):
+        """Train + cache the WordPiece tokenizer on first run (rank-0 work;
+        reference ``imdb.py:114-126``)."""
+        if os.path.exists(self.tokenizer_path):
+            return
+        os.makedirs(self.root, exist_ok=True)
+        texts, _ = self._train_texts()
+        tokenizer = create_tokenizer(("<br />", " "))
+        train_tokenizer(tokenizer, texts, vocab_size=self.vocab_size)
+        save_tokenizer(tokenizer, self.tokenizer_path)
+
+    def setup(self):
+        self.tokenizer = load_tokenizer(self.tokenizer_path)
+        self.collator = Collator(self.tokenizer, self.max_seq_len)
+        self.ds_train = IMDBDataset(*self._train_texts())
+        self.ds_valid = IMDBDataset(*self._valid_texts())
+
+    def train_dataloader(self) -> DataLoader:
+        return DataLoader(
+            self.ds_train,
+            batch_size=self.batch_size,
+            collate=self.collator.collate,
+            shuffle=True,
+            seed=self.seed,
+            shard_id=self.shard_id,
+            num_shards=self.num_shards,
+        )
+
+    def val_dataloader(self) -> DataLoader:
+        return DataLoader(
+            self.ds_valid,
+            batch_size=self.batch_size,
+            collate=self.collator.collate,
+            shuffle=False,
+            # evaluate the full set when single-host (multi-host must drop for
+            # lockstep collectives)
+            drop_last=self.num_shards > 1,
+            shard_id=self.shard_id,
+            num_shards=self.num_shards,
+        )

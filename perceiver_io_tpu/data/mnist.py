@@ -1,0 +1,221 @@
+"""MNIST image data module.
+
+Mirrors the reference's MNIST module behavior (reference ``data/mnist.py``):
+channels-last (28, 28, 1) images, ``Normalize(0.5, 0.5)`` after scaling to
+[0, 1] (torchvision ``ToTensor`` + ``Normalize`` ⇒ pixel ∈ [-1, 1]), optional
+random crop augmentation, 10k validation split carved from the train set.
+
+Reads the standard idx files from ``<root>/MNIST/raw`` (torchvision's layout,
+``.gz`` or unpacked) so an existing cache drops in; ``synthetic=True``
+generates a deterministic digit-like dataset (class-dependent blob patterns —
+learnable, so smoke training shows a falling loss) for this zero-egress box.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from perceiver_io_tpu.data.pipeline import DataLoader
+
+_FILES = {
+    "train_images": "train-images-idx3-ubyte",
+    "train_labels": "train-labels-idx1-ubyte",
+    "test_images": "t10k-images-idx3-ubyte",
+    "test_labels": "t10k-labels-idx1-ubyte",
+}
+
+
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+def _find(root: str, base: str) -> str:
+    for candidate in (
+        os.path.join(root, "MNIST", "raw", base),
+        os.path.join(root, "MNIST", "raw", base + ".gz"),
+        os.path.join(root, base),
+        os.path.join(root, base + ".gz"),
+    ):
+        if os.path.exists(candidate):
+            return candidate
+    raise FileNotFoundError(
+        f"MNIST file {base} not found under {root} — place the idx files at "
+        f"{root}/MNIST/raw, or use synthetic=True"
+    )
+
+
+def load_mnist(root: str, split: str) -> Tuple[np.ndarray, np.ndarray]:
+    """(images uint8 (N, 28, 28), labels uint8 (N,)) for 'train' or 'test'."""
+    prefix = "train" if split == "train" else "test"
+    images = _read_idx(_find(root, _FILES[f"{prefix}_images"]))
+    labels = _read_idx(_find(root, _FILES[f"{prefix}_labels"]))
+    return images, labels
+
+
+def synthetic_digits(n: int, seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic learnable stand-in: each class is a fixed smooth random
+    28×28 template plus pixel noise."""
+    rng = np.random.default_rng(seed)
+    base = np.random.default_rng(1234)  # templates shared across splits/seeds
+    templates = base.uniform(0, 1, size=(10, 28, 28))
+    # smooth the templates a little so they look image-like...
+    for _ in range(2):
+        templates = (
+            templates
+            + np.roll(templates, 1, 1)
+            + np.roll(templates, -1, 1)
+            + np.roll(templates, 1, 2)
+            + np.roll(templates, -1, 2)
+        ) / 5.0
+    # ...then restore full contrast so class signal dominates the pixel noise
+    tmin = templates.min(axis=(1, 2), keepdims=True)
+    tmax = templates.max(axis=(1, 2), keepdims=True)
+    templates = (templates - tmin) / (tmax - tmin)
+    labels = rng.integers(0, 10, size=n).astype(np.uint8)
+    images = templates[labels] + rng.normal(0, 0.15, size=(n, 28, 28))
+    images = (np.clip(images, 0, 1) * 255).astype(np.uint8)
+    return images, labels
+
+
+class MNISTDataset:
+    """Normalized channels-last examples with optional random-crop augmentation."""
+
+    def __init__(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        random_crop: Optional[int] = None,
+        augment_seed: int = 0,
+    ):
+        self.images = images
+        self.labels = labels
+        self.random_crop = random_crop
+        self._rng = np.random.default_rng(augment_seed)
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    @property
+    def image_shape(self) -> Tuple[int, int, int]:
+        s = self.random_crop
+        h, w = self.images.shape[1:3]
+        return (s, s, 1) if s else (h, w, 1)
+
+    def __getitem__(self, i: int) -> Tuple[np.ndarray, int]:
+        img = self.images[i]
+        if self.random_crop:
+            s = self.random_crop
+            h, w = img.shape
+            top = int(self._rng.integers(0, h - s + 1))
+            left = int(self._rng.integers(0, w - s + 1))
+            img = img[top : top + s, left : left + s]
+        # ToTensor (→[0,1]) + Normalize(0.5, 0.5) + channels-last
+        img = (img.astype(np.float32) / 255.0 - 0.5) / 0.5
+        return img[..., None], int(self.labels[i])
+
+
+def _collate(batch) -> Dict[str, np.ndarray]:
+    images = np.stack([img for img, _ in batch])
+    labels = np.asarray([y for _, y in batch], dtype=np.int32)
+    return {"image": images, "label": labels}
+
+
+class MNISTDataModule:
+    """create/setup/loader surface mirroring the reference module
+    (``data/mnist.py:17-82``): val_split=10000, Normalize(0.5, 0.5),
+    channels-last, optional random crop."""
+
+    num_classes = 10
+
+    def __init__(
+        self,
+        root: str = ".cache",
+        batch_size: int = 64,
+        random_crop: Optional[int] = None,
+        val_split: int = 10000,
+        synthetic: bool = False,
+        synthetic_size: int = 4096,
+        seed: int = 0,
+        shard_id: int = 0,
+        num_shards: int = 1,
+    ):
+        self.root = root
+        self.batch_size = batch_size
+        self.random_crop = random_crop
+        self.val_split = val_split
+        self.synthetic = synthetic
+        self.synthetic_size = synthetic_size
+        self.seed = seed
+        self.shard_id = shard_id
+        self.num_shards = num_shards
+        self.ds_train: Optional[MNISTDataset] = None
+        self.ds_valid: Optional[MNISTDataset] = None
+
+    @classmethod
+    def create(cls, args) -> "MNISTDataModule":
+        return cls(
+            root=args.root,
+            batch_size=args.batch_size,
+            random_crop=args.random_crop,
+            synthetic=getattr(args, "synthetic", False),
+        )
+
+    @property
+    def dims(self) -> Tuple[int, int, int]:
+        s = self.random_crop
+        return (s, s, 1) if s else (28, 28, 1)
+
+    def prepare_data(self):
+        """No downloader on a zero-egress box: validate local data exists
+        (or synthetic mode)."""
+        if not self.synthetic:
+            _find(self.root, _FILES["train_images"])
+
+    def setup(self):
+        if self.synthetic:
+            images, labels = synthetic_digits(self.synthetic_size, seed=self.seed)
+            val = max(self.synthetic_size // 8, 32)
+        else:
+            images, labels = load_mnist(self.root, "train")
+            val = self.val_split
+        split = len(images) - val  # explicit split point: val_split=0 keeps all
+        self.ds_train = MNISTDataset(
+            images[:split], labels[:split], random_crop=self.random_crop,
+            augment_seed=self.seed,
+        )
+        self.ds_valid = MNISTDataset(images[split:], labels[split:])
+
+    def train_dataloader(self) -> DataLoader:
+        return DataLoader(
+            self.ds_train,
+            batch_size=self.batch_size,
+            collate=_collate,
+            shuffle=True,
+            seed=self.seed,
+            shard_id=self.shard_id,
+            num_shards=self.num_shards,
+        )
+
+    def val_dataloader(self) -> DataLoader:
+        return DataLoader(
+            self.ds_valid,
+            batch_size=self.batch_size,
+            collate=_collate,
+            shuffle=False,
+            # evaluate the full set when single-host (multi-host must drop for
+            # lockstep collectives)
+            drop_last=self.num_shards > 1,
+            shard_id=self.shard_id,
+            num_shards=self.num_shards,
+        )

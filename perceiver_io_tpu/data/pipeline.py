@@ -1,0 +1,164 @@
+"""Host-side input pipeline: sharded batching + background prefetch.
+
+The framework's replacement for the reference's torch ``DataLoader`` worker
+pool (reference ``data/imdb.py:136-149``): a lightweight first-party loader
+tuned for SPMD training —
+
+- deterministic per-epoch shuffling (seed ⊕ epoch),
+- **per-host sharding**: each process sees only its ``1/num_shards`` slice of
+  every batch (multi-host data parallelism; pair with
+  ``jax.make_array_from_process_local_data``),
+- ``drop_last`` so every step sees identical static shapes (no recompiles),
+- background-thread prefetch overlapping host work with device steps,
+- optional ``device_put`` with a target sharding for device prefetch.
+
+Batches are dicts of numpy arrays (the step-function contract).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Iterator, Optional, Sequence
+
+import numpy as np
+
+Batch = Dict[str, np.ndarray]
+
+
+class DataLoader:
+    """Minibatch iterator over an indexable dataset.
+
+    ``dataset`` must support ``len()`` and integer indexing; ``collate``
+    maps a list of examples to a dict-of-arrays batch.
+    """
+
+    def __init__(
+        self,
+        dataset,
+        batch_size: int,
+        collate: Callable[[list], Batch],
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = True,
+        shard_id: int = 0,
+        num_shards: int = 1,
+        prefetch: int = 2,
+    ):
+        if not (0 <= shard_id < num_shards):
+            raise ValueError(f"shard_id {shard_id} out of range for {num_shards} shards")
+        if batch_size % num_shards != 0:
+            raise ValueError(
+                f"global batch_size {batch_size} not divisible by num_shards {num_shards}"
+            )
+        if num_shards > 1 and not drop_last:
+            # A final partial batch would give hosts different step counts /
+            # shapes and deadlock multi-host collectives.
+            raise ValueError("drop_last=False is only supported with num_shards=1")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate = collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.shard_id = shard_id
+        self.num_shards = num_shards
+        self.prefetch = prefetch
+        self.epoch = 0
+
+    def __len__(self) -> int:
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def _epoch_indices(self, epoch: int) -> np.ndarray:
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng(np.uint32(self.seed) + np.uint32(epoch))
+            return rng.permutation(n)
+        return np.arange(n)
+
+    def _batches(self) -> Iterator[Batch]:
+        # consume the epoch number up front so an early `break` (fixed-step
+        # training loops) still advances the shuffle for the next iteration
+        epoch = self.epoch
+        self.epoch += 1
+        idx = self._epoch_indices(epoch)
+        n = len(idx)
+        per_shard = self.batch_size // self.num_shards
+        stop = n - self.batch_size + 1 if self.drop_last else n
+        for start in range(0, max(stop, 0), self.batch_size):
+            batch_idx = idx[start : start + self.batch_size]
+            # this host's contiguous slice of the global batch
+            local = batch_idx[self.shard_id * per_shard : (self.shard_id + 1) * per_shard]
+            if len(local) == 0:
+                continue
+            yield self.collate([self.dataset[int(i)] for i in local])
+
+    def __iter__(self) -> Iterator[Batch]:
+        if self.prefetch <= 0:
+            yield from self._batches()
+            return
+        yield from _prefetch_thread(self._batches(), self.prefetch)
+
+
+def _prefetch_thread(it: Iterator, size: int) -> Iterator:
+    q: queue.Queue = queue.Queue(maxsize=size)
+    _END = object()
+    stop = threading.Event()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for item in it:
+                if not put(item):
+                    return
+            put(_END)
+        except BaseException as e:  # surface errors in the consumer
+            put(e)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        # consumer broke early: release the (possibly blocked) worker
+        stop.set()
+
+
+def prefetch_to_device(
+    it: Iterator[Batch], sharding=None, size: int = 2
+) -> Iterator[Batch]:
+    """Move batches onto device(s) ahead of consumption.
+
+    With a ``jax.sharding.Sharding``, arrays land pre-sharded (the device-side
+    half of the input pipeline); otherwise default placement.
+    """
+    import jax
+
+    def put(batch: Batch):
+        if sharding is None:
+            return jax.device_put(batch)
+        return jax.device_put(batch, sharding)
+
+    buffer = []
+    for batch in it:
+        buffer.append(put(batch))
+        if len(buffer) > size:
+            yield buffer.pop(0)
+    yield from buffer

@@ -179,13 +179,21 @@ class PerceiverDecoder(nn.Module):
 
 
 class PerceiverIO(nn.Module):
-    """encoder → decoder (reference ``model.py:321-325``)."""
+    """encoder → decoder (reference ``model.py:321-325``).
+
+    ``encoder_deterministic`` overrides the dropout mode for the encoder alone —
+    the transfer-learning case where a frozen pretrained encoder runs in eval
+    mode while the decoder head trains with dropout (the reference's
+    ``freeze()`` = requires_grad False + ``.eval()``, ``train/utils.py:5-8``).
+    """
 
     encoder: PerceiverEncoder
     decoder: PerceiverDecoder
 
-    def __call__(self, x, pad_mask=None, deterministic=True):
-        x_latent = self.encoder(x, pad_mask=pad_mask, deterministic=deterministic)
+    def __call__(self, x, pad_mask=None, deterministic=True,
+                 encoder_deterministic: Optional[bool] = None):
+        enc_det = deterministic if encoder_deterministic is None else encoder_deterministic
+        x_latent = self.encoder(x, pad_mask=pad_mask, deterministic=enc_det)
         return self.decoder(x_latent, deterministic=deterministic)
 
 

@@ -1,0 +1,5 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+Built on demand with g++ into this directory; every native component has a
+pure-Python fallback so the framework works without a toolchain.
+"""

@@ -1,0 +1,30 @@
+"""Build-on-demand for the native components.
+
+Compiles ``<name>.cpp`` in this directory to ``lib<name>.so`` with g++ the
+first time it is needed (results cached next to the source; stale artifacts —
+older than the source — are rebuilt). Raises on failure; callers treat any
+exception as "use the Python fallback".
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD_LOCK = threading.Lock()
+
+
+def load_library(name: str) -> ctypes.CDLL:
+    src = os.path.join(_NATIVE_DIR, f"{name}.cpp")
+    lib = os.path.join(_NATIVE_DIR, f"lib{name}.so")
+    with _BUILD_LOCK:
+        if not os.path.exists(lib) or os.path.getmtime(lib) < os.path.getmtime(src):
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", lib, src],
+                check=True,
+                capture_output=True,
+            )
+    return ctypes.CDLL(lib)

@@ -1,0 +1,115 @@
+// Native WordPiece encoder: byte-trie greedy longest-match-first.
+//
+// The framework's replacement for the third-party Rust tokenizer backend the
+// reference depends on (HF `tokenizers`, reference perceiver/tokenizer.py:10-36):
+// the tokenize hot loop — matching each pre-tokenized word against the vocab —
+// runs here in C++; normalization/pre-tokenization (unicode-heavy, cacheable)
+// stay on the Python side. Bound via ctypes (see native/wordpiece.py).
+//
+// Two tries over raw UTF-8 bytes: one for word-initial pieces, one for
+// continuation pieces (the "##"-prefixed vocab entries, stored stripped).
+// Greedy matching walks the trie recording the deepest node that terminates a
+// vocab token; no match from the current offset -> whole word becomes UNK.
+
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <vector>
+
+namespace {
+
+struct TrieNode {
+  int32_t token_id = -1;  // -1: not a token end
+  std::unique_ptr<TrieNode> children[256];
+};
+
+struct WordPiece {
+  TrieNode initial;
+  TrieNode continuation;
+  int32_t unk_id;
+};
+
+void trie_insert(TrieNode* root, const char* s, size_t len, int32_t id) {
+  TrieNode* node = root;
+  for (size_t i = 0; i < len; ++i) {
+    uint8_t b = static_cast<uint8_t>(s[i]);
+    if (!node->children[b]) node->children[b] = std::make_unique<TrieNode>();
+    node = node->children[b].get();
+  }
+  node->token_id = id;
+}
+
+// Longest match for word[start..): returns matched byte length (0 if none),
+// stores the token id.
+size_t trie_longest(const TrieNode* root, const char* word, size_t len,
+                    size_t start, int32_t* id_out) {
+  const TrieNode* node = root;
+  size_t best_len = 0;
+  int32_t best_id = -1;
+  for (size_t i = start; i < len; ++i) {
+    node = node->children[static_cast<uint8_t>(word[i])].get();
+    if (!node) break;
+    if (node->token_id >= 0) {
+      best_len = i - start + 1;
+      best_id = node->token_id;
+    }
+  }
+  *id_out = best_id;
+  return best_len;
+}
+
+}  // namespace
+
+extern "C" {
+
+// tokens: n UTF-8 strings; ids: their vocab ids.
+//
+// Parity contract with the Python encoder (a single dict): a word-INITIAL
+// piece is looked up by its raw string — including tokens that literally
+// start with "##" (a '#'-heavy corpus can mint those) — so EVERY token goes
+// into the initial trie raw; a CONTINUATION piece is looked up as
+// "##" + substring, so "##"-prefixed tokens additionally enter the
+// continuation trie with the prefix stripped.
+void* wp_create(const char** tokens, const int32_t* ids, int32_t n,
+                int32_t unk_id) {
+  auto* wp = new WordPiece();
+  wp->unk_id = unk_id;
+  for (int32_t i = 0; i < n; ++i) {
+    const char* t = tokens[i];
+    size_t len = std::strlen(t);
+    if (len > 0) trie_insert(&wp->initial, t, len, ids[i]);
+    if (len > 2 && t[0] == '#' && t[1] == '#') {
+      trie_insert(&wp->continuation, t + 2, len - 2, ids[i]);
+    }
+  }
+  return wp;
+}
+
+void wp_destroy(void* handle) { delete static_cast<WordPiece*>(handle); }
+
+// Encode one pre-tokenized, normalized word (UTF-8, word_len bytes) into
+// out[0..max_out). Returns the number of ids written; on no-match returns 1
+// with out[0] = unk_id; returns -1 if out would overflow.
+int32_t wp_encode_word(void* handle, const char* word, int32_t word_len,
+                       int32_t* out, int32_t max_out) {
+  auto* wp = static_cast<WordPiece*>(handle);
+  size_t len = static_cast<size_t>(word_len);
+  size_t start = 0;
+  int32_t count = 0;
+  while (start < len) {
+    const TrieNode* root = (start == 0) ? &wp->initial : &wp->continuation;
+    int32_t id;
+    size_t matched = trie_longest(root, word, len, start, &id);
+    if (matched == 0) {
+      if (max_out < 1) return -1;
+      out[0] = wp->unk_id;
+      return 1;
+    }
+    if (count >= max_out) return -1;
+    out[count++] = id;
+    start += matched;
+  }
+  return count;
+}
+
+}  // extern "C"

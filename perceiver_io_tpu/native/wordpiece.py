@@ -1,0 +1,57 @@
+"""ctypes binding for the C++ WordPiece encoder (see wordpiece.cpp)."""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List
+
+from perceiver_io_tpu.native.build import load_library
+
+_MAX_PIECES = 512
+
+
+class NativeWordPiece:
+    def __init__(self, vocab: Dict[str, int], unk_id: int):
+        self._lib = load_library("wordpiece")
+        self._lib.wp_create.restype = ctypes.c_void_p
+        self._lib.wp_create.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+            ctypes.c_int32,
+        ]
+        self._lib.wp_encode_word.restype = ctypes.c_int32
+        self._lib.wp_encode_word.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32,
+        ]
+        self._lib.wp_destroy.argtypes = [ctypes.c_void_p]
+
+        items = list(vocab.items())
+        tokens = (ctypes.c_char_p * len(items))(
+            *[t.encode("utf-8") for t, _ in items]
+        )
+        ids = (ctypes.c_int32 * len(items))(*[i for _, i in items])
+        self._handle = self._lib.wp_create(tokens, ids, len(items), unk_id)
+        self._unk_id = unk_id
+        self._out = (ctypes.c_int32 * _MAX_PIECES)()
+
+    def encode_word(self, word: str) -> List[int]:
+        raw = word.encode("utf-8")
+        n = self._lib.wp_encode_word(
+            self._handle, raw, len(raw), self._out, _MAX_PIECES
+        )
+        if n < 0:  # overflow — absurdly long word; match the Python fallback
+            return [self._unk_id]
+        return list(self._out[:n])
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            try:
+                self._lib.wp_destroy(handle)
+            except Exception:
+                pass

@@ -1,0 +1,29 @@
+from perceiver_io_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_MODEL,
+    AXIS_SEQ,
+    make_mesh,
+    initialize_distributed,
+)
+from perceiver_io_tpu.parallel.sharding import (
+    PARAM_RULES,
+    batch_pspecs,
+    replicated,
+    sharding_for_tree,
+    shard_train_state,
+    make_sharded_train_step,
+)
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_MODEL",
+    "AXIS_SEQ",
+    "make_mesh",
+    "initialize_distributed",
+    "PARAM_RULES",
+    "batch_pspecs",
+    "replicated",
+    "sharding_for_tree",
+    "shard_train_state",
+    "make_sharded_train_step",
+]

@@ -1,0 +1,84 @@
+"""Device-mesh construction — the framework's distributed-communication layer.
+
+The reference distributes exclusively through Lightning's DDP plugin over NCCL
+(reference ``train_mlm.py:68``, ``train_seq_clf.py:30``, ``train_img_clf.py:19``);
+here distribution is a single SPMD program over one ``jax.sharding.Mesh``:
+gradient synchronization, sequence-parallel softmax reductions and
+tensor-parallel activation exchanges all become XLA collectives riding ICI
+(intra-slice) / DCN (inter-slice) — there is no user-facing communication API,
+only mesh + sharding construction.
+
+Axes:
+
+- ``data``  — batch-dim sharding (the DDP replacement; grads psum over this axis),
+- ``model`` — tensor parallelism (attention heads / MLP width / vocab dims),
+- ``seq``   — sequence/context parallelism for long inputs M: the encoder's
+  cross-attention KV stream is sharded over this axis while the small latent
+  array stays replicated, so the softmax over M runs as partial reductions +
+  psum — Perceiver's architectural alternative to ring attention (SURVEY.md §5).
+
+Multi-host: call ``initialize_distributed()`` once per process before mesh
+construction; ``jax.devices()`` then spans all hosts and every host feeds its
+own data shard (``data/pipeline.py`` shard_id/num_shards).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+
+MESH_AXES = (AXIS_DATA, AXIS_MODEL, AXIS_SEQ)
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bring-up: ``jax.distributed.initialize`` (auto-detected on
+    TPU pods; explicit coordinator for manual launches). Safe to skip on a
+    single host."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_mesh(
+    dp: Optional[int] = None,
+    tp: int = 1,
+    sp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """A (data, model, seq) mesh over the given (default: all) devices.
+
+    ``dp`` defaults to ``n_devices // (tp * sp)``. On TPU,
+    ``mesh_utils.create_device_mesh`` lays the axes out so that the
+    highest-traffic axis rides ICI neighbours.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if tp < 1 or sp < 1:
+        raise ValueError(f"tp and sp must be >= 1, got tp={tp} sp={sp}")
+    if dp is None:
+        if n % (tp * sp) != 0:
+            raise ValueError(f"{n} devices not divisible by tp*sp = {tp * sp}")
+        dp = n // (tp * sp)
+    if dp * tp * sp != n:
+        raise ValueError(f"dp*tp*sp = {dp * tp * sp} != {n} devices")
+
+    try:
+        device_grid = mesh_utils.create_device_mesh((dp, tp, sp), devices=devices)
+    except Exception:
+        # CPU/host-platform fallback: simple row-major assignment
+        device_grid = np.asarray(devices).reshape(dp, tp, sp)
+    return Mesh(device_grid, MESH_AXES)

@@ -1,0 +1,161 @@
+"""Sharding rules and the pjit-ed train step — the DDP replacement.
+
+Recipe (the scaling-book flow): pick a mesh, annotate the params/opt-state and
+batch shardings once, ``jax.jit`` the existing pure step function with those
+shardings, and let XLA's SPMD partitioner insert the collectives (grad psum
+over ``data``, all-gather/reduce-scatter for ``model``-sharded tensors,
+softmax-stat psum over ``seq``-sharded attention).
+
+Parameter rules are path-regex → PartitionSpec, applied to any params-shaped
+tree — optimizer states (Adam's mu/nu mirror the param tree paths) pick up the
+same specs automatically, which keeps ZeRO-style optimizer-state sharding one
+rule-table away.
+
+Tensor-parallel layout (Megatron-style pairing, per attention/MLP block):
+
+- q/k/v projection kernels: output (head) dim over ``model`` → attention runs
+  head-parallel; out-projection input dim over ``model`` closes the pair with
+  one psum.
+- MLP: dense_1 output and dense_2 input over ``model``.
+- vocab-sized output projection (``linear/kernel``) over ``model`` — the
+  (B, 512, vocab) MLM logits, the memory hot spot (SURVEY.md §3.1), never
+  materialize unsharded; the CE softmax reduces over the sharded axis in-place.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from perceiver_io_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL, AXIS_SEQ
+
+# (path regex, spec). First match wins; default is fully replicated.
+PARAM_RULES: Sequence[Tuple[str, P]] = (
+    (r"(q_proj|k_proj|v_proj)/kernel$", P(None, AXIS_MODEL)),
+    (r"(q_proj|k_proj|v_proj)/bias$", P(AXIS_MODEL)),
+    (r"out_proj/kernel$", P(AXIS_MODEL, None)),
+    (r"dense_1/kernel$", P(None, AXIS_MODEL)),
+    (r"dense_1/bias$", P(AXIS_MODEL)),
+    (r"dense_2/kernel$", P(AXIS_MODEL, None)),
+    (r"linear/kernel$", P(None, AXIS_MODEL)),
+    (r"linear/bias$", P(AXIS_MODEL)),
+)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def _spec_fits(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> bool:
+    """A spec is usable when every named axis divides its dimension.
+
+    (XLA supports uneven sharding via padding, but for parameters we prefer
+    clean replication over padded shards — e.g. a 10003-vocab projection on a
+    tp=2 mesh stays replicated rather than padding every optimizer step.)
+    """
+    if len(spec) > len(shape):
+        return False
+    for dim, axis in zip(shape, spec):
+        if axis is None:
+            continue
+        if dim % mesh.shape[axis] != 0:
+            return False
+    return True
+
+
+def sharding_for_tree(tree: Any, mesh: Mesh, rules: Sequence[Tuple[str, P]] = PARAM_RULES):
+    """NamedSharding tree for a params-shaped pytree by path-regex rules.
+
+    Works on concrete arrays or ShapeDtypeStructs (use with ``jax.eval_shape``
+    to plan shardings before allocating).
+    """
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def assign(path, leaf) -> NamedSharding:
+        shape = getattr(leaf, "shape", ())
+        name = jax.tree_util.keystr(path, simple=True, separator="/")
+        for pat, spec in compiled:
+            if pat.search(name):
+                if _spec_fits(spec, shape, mesh):
+                    return NamedSharding(mesh, spec)
+                return replicated(mesh)
+        return replicated(mesh)
+
+    return jax.tree_util.tree_map_with_path(assign, tree)
+
+
+def batch_pspecs(batch: Dict[str, Any], mesh: Mesh, shard_seq: bool = False) -> Dict[str, P]:
+    """PartitionSpecs for a batch dict: leading axis over ``data``; for text
+    tensors (token_ids/pad_mask), optionally the sequence axis over ``seq``.
+
+    Sequence sharding is the Perceiver sequence-parallel scheme: the encoder
+    cross-attention KV stream (derived from these tensors) is sharded over
+    ``seq`` while latents replicate — no ring required (SURVEY.md §5).
+    """
+    seq_axis = AXIS_SEQ if shard_seq and mesh.shape[AXIS_SEQ] > 1 else None
+
+    specs: Dict[str, P] = {}
+    for key, value in batch.items():
+        ndim = np.ndim(value) if not hasattr(value, "ndim") else value.ndim
+        if key in ("token_ids", "pad_mask") and ndim >= 2:
+            specs[key] = P(AXIS_DATA, seq_axis, *([None] * (ndim - 2)))
+        else:
+            specs[key] = P(AXIS_DATA, *([None] * (ndim - 1)))
+    return specs
+
+
+def batch_shardings(batch: Dict[str, Any], mesh: Mesh, shard_seq: bool = False):
+    return {
+        k: NamedSharding(mesh, spec)
+        for k, spec in batch_pspecs(batch, mesh, shard_seq).items()
+    }
+
+
+def shard_train_state(state, mesh: Mesh, rules=PARAM_RULES):
+    """Place an existing TrainState onto the mesh per the rules.
+
+    Params and optimizer state follow the same path rules (mu/nu mirror the
+    param paths); scalars and rng keys replicate.
+    """
+    shardings = sharding_for_tree(state, mesh, rules)
+    return jax.device_put(state, shardings), shardings
+
+
+def make_sharded_train_step(
+    train_step,
+    mesh: Mesh,
+    state,
+    example_batch: Dict[str, Any],
+    rules=PARAM_RULES,
+    shard_seq: bool = False,
+    donate_state: bool = True,
+):
+    """jit the pure ``(state, batch) → (state, metrics)`` step with explicit
+    in/out shardings over the mesh. Returns ``(step_fn, sharded_state,
+    batch_shardings)``.
+
+    The example batch's keys define the step's input contract: loader batches
+    may carry extra keys (e.g. ``label`` on an MLM batch) — the returned step
+    selects only the contracted keys, so loader output feeds in directly.
+    Batches can be host numpy (dispatch places them per the shardings) or
+    pre-placed via ``jax.device_put(batch, batch_shardings)``.
+    """
+    keys = tuple(sorted(example_batch))
+    sharded_state, state_shardings = shard_train_state(state, mesh, rules)
+    b_shardings = batch_shardings(example_batch, mesh, shard_seq)
+
+    jitted = jax.jit(
+        train_step,
+        in_shardings=(state_shardings, b_shardings),
+        out_shardings=(state_shardings, None),
+        donate_argnums=(0,) if donate_state else (),
+    )
+
+    def step(state, batch):
+        return jitted(state, {k: batch[k] for k in keys})
+
+    return step, sharded_state, b_shardings

@@ -1,0 +1,22 @@
+from perceiver_io_tpu.training.losses import (
+    cross_entropy_with_ignore,
+    classification_loss_and_accuracy,
+)
+from perceiver_io_tpu.training.optim import OptimizerConfig, make_optimizer
+from perceiver_io_tpu.training.train_state import TrainState
+from perceiver_io_tpu.training.steps import (
+    make_mlm_steps,
+    make_classifier_steps,
+    freeze_subtrees,
+)
+
+__all__ = [
+    "cross_entropy_with_ignore",
+    "classification_loss_and_accuracy",
+    "OptimizerConfig",
+    "make_optimizer",
+    "TrainState",
+    "make_mlm_steps",
+    "make_classifier_steps",
+    "freeze_subtrees",
+]

@@ -1,0 +1,47 @@
+"""Loss and metric functions.
+
+Semantics match the reference training layer: cross-entropy with an
+ignore-index of -100 for MLM (reference ``lightning.py:88,131-134`` — torch
+``CrossEntropyLoss`` default mean over non-ignored elements), plain CE + top-1
+accuracy for classification (reference ``lightning.py:153-160``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from perceiver_io_tpu.ops.masking import IGNORE_LABEL
+
+Array = jax.Array
+
+
+def cross_entropy_with_ignore(
+    logits: Array, labels: Array, ignore_label: int = IGNORE_LABEL
+) -> Array:
+    """Mean CE over positions where ``labels != ignore_label``.
+
+    logits: (..., C); labels: (...) int. Matches torch
+    ``CrossEntropyLoss(ignore_index=-100)`` 'mean' reduction.
+    """
+    valid = labels != ignore_label
+    safe_labels = jnp.where(valid, labels, 0)
+    per_pos = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), safe_labels
+    )
+    denom = jnp.maximum(valid.sum(), 1)
+    return jnp.where(valid, per_pos, 0.0).sum() / denom
+
+
+def classification_loss_and_accuracy(
+    logits: Array, labels: Array
+) -> Tuple[Array, Array]:
+    """(mean CE, top-1 accuracy) for (B, C) logits and (B,) int labels."""
+    loss = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels
+    ).mean()
+    acc = (jnp.argmax(logits, axis=-1) == labels).mean()
+    return loss, acc

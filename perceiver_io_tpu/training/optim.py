@@ -1,0 +1,109 @@
+"""Optimizer and LR-schedule factory.
+
+Mirrors the reference optimizer surface (``lightning.py:50-79``): Adam or
+AdamW selected by name, optional OneCycle LR stepped per optimizer step and
+requiring ``max_steps``.
+
+Semantic parity notes:
+
+- torch ``Adam(weight_decay=w)`` is *coupled* L2: ``grad += w * param`` before
+  the moment updates → ``optax.chain(add_decayed_weights, scale_by_adam, lr)``.
+- torch ``AdamW(weight_decay=w)`` is decoupled, decay scaled by the lr →
+  ``optax.adamw``.
+- torch ``OneCycleLR(max_lr, pct_start, total_steps, cycle_momentum=False)``
+  uses cosine annealing with ``div_factor=25``, ``final_div_factor=1e4``, a
+  peak at step ``pct_start*total_steps - 1`` and the minimum at step
+  ``total_steps - 1`` (one-shifted vs. ``optax.cosine_onecycle_schedule``) —
+  reproduced exactly by ``torch_one_cycle_schedule`` below.
+
+The schedule callable is returned alongside the transformation so steps can
+log the current LR (the reference's per-step ``LearningRateMonitor``,
+``train/utils.py:16-17``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Tuple
+
+import jax.numpy as jnp
+import optax
+
+
+def torch_one_cycle_schedule(
+    total_steps: int,
+    max_lr: float,
+    pct_start: float = 0.1,
+    div_factor: float = 25.0,
+    final_div_factor: float = 1e4,
+) -> Callable:
+    """Cosine OneCycle with torch's exact phase boundaries.
+
+    initial = max_lr/div_factor; min = initial/final_div_factor; cosine-anneal
+    initial→max over steps [0, pct_start*total-1], then max→min over
+    [pct_start*total-1, total-1]. jit-friendly (pure jnp on the step counter).
+    """
+    initial_lr = max_lr / div_factor
+    min_lr = initial_lr / final_div_factor
+    peak_step = max(pct_start * total_steps - 1.0, 1e-8)
+    down_steps = max(total_steps - 1.0 - peak_step, 1e-8)
+
+    def cos_anneal(start, end, frac):
+        return end + (start - end) * (1.0 + jnp.cos(jnp.pi * frac)) / 2.0
+
+    def schedule(step):
+        s = jnp.asarray(step, jnp.float32)
+        up = cos_anneal(initial_lr, max_lr, jnp.clip(s / peak_step, 0.0, 1.0))
+        down = cos_anneal(max_lr, min_lr, jnp.clip((s - peak_step) / down_steps, 0.0, 1.0))
+        return jnp.where(s <= peak_step, up, down)
+
+    return schedule
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Reference optimizer argparse group (``lightning.py:50-57``)."""
+
+    optimizer: str = "Adam"  # 'Adam' | 'AdamW'
+    learning_rate: float = 1e-3
+    weight_decay: float = 0.0
+    one_cycle_lr: bool = False
+    one_cycle_pct_start: float = 0.1
+    max_steps: Optional[int] = None
+
+
+def make_optimizer(
+    config: OptimizerConfig,
+) -> Tuple[optax.GradientTransformation, Callable[[int], float]]:
+    """Build (transformation, lr_schedule) from the config.
+
+    Raises ValueError when OneCycle is requested without ``max_steps``
+    (reference ``lightning.py:65-67``).
+    """
+    if config.one_cycle_lr:
+        if config.max_steps is None:
+            raise ValueError("OneCycleLR requires a max_steps value")
+        schedule = torch_one_cycle_schedule(
+            total_steps=config.max_steps,
+            max_lr=config.learning_rate,
+            pct_start=config.one_cycle_pct_start,
+        )
+    else:
+        schedule = optax.constant_schedule(config.learning_rate)
+
+    name = config.optimizer
+    if name == "Adam":
+        chain = []
+        if config.weight_decay:
+            chain.append(optax.add_decayed_weights(config.weight_decay))
+        chain += [
+            optax.scale_by_adam(),
+            optax.scale_by_learning_rate(schedule),
+        ]
+        tx = optax.chain(*chain)
+    elif name == "AdamW":
+        tx = optax.adamw(schedule, weight_decay=config.weight_decay)
+    else:
+        raise ValueError(f"unknown optimizer {name!r} (expected 'Adam' or 'AdamW')")
+
+    return tx, schedule

@@ -1,0 +1,151 @@
+"""Jitted train/eval step builders.
+
+The replacement for the reference's Lightning step methods
+(``lightning.py:127-177``): each builder returns pure functions
+``(state, batch) → (state, metrics)`` that the caller jits (single device) or
+pjits over a mesh (SPMD — the DDP replacement; gradient sync becomes a
+compiler-inserted psum when the batch axis is sharded).
+
+Batches are dicts of arrays:
+
+- MLM / text:  ``{'token_ids': (B, L) int, 'pad_mask': (B, L) bool[, 'label': (B,) int]}``
+- image:       ``{'image': (B, *image_shape) float, 'label': (B,) int}``
+
+Transfer learning (reference ``train_seq_clf.py:18-28``): ``freeze_subtrees``
+masks optimizer updates for a params subtree (requires_grad=False parity) and
+the classifier steps run a frozen encoder in eval mode (``.eval()`` parity).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from perceiver_io_tpu.training.losses import (
+    classification_loss_and_accuracy,
+    cross_entropy_with_ignore,
+)
+from perceiver_io_tpu.training.train_state import TrainState
+
+Array = jax.Array
+Metrics = dict
+Schedule = Callable[[Array], Array]
+
+
+def freeze_subtrees(
+    tx: optax.GradientTransformation, params, frozen_keys: Sequence[str]
+) -> optax.GradientTransformation:
+    """Zero out updates for top-level params subtrees named in ``frozen_keys``.
+
+    The functional analogue of the reference's ``freeze()``
+    (``train/utils.py:5-8``): frozen params receive no updates but still flow
+    through the forward/backward pass.
+    """
+    frozen = set(frozen_keys)
+
+    def label(tree):
+        return {k: ("frozen" if k in frozen else "trainable") for k in tree}
+
+    return optax.multi_transform(
+        {"trainable": tx, "frozen": optax.set_to_zero()}, param_labels=label(params)
+    )
+
+
+def _lr_metric(schedule: Optional[Schedule], step: Array) -> dict:
+    return {} if schedule is None else {"lr": schedule(step)}
+
+
+def make_mlm_steps(model, schedule: Optional[Schedule] = None):
+    """(train_step, eval_step, predict_fn) for a ``PerceiverMLM``.
+
+    - train: masking RNG + dropout, CE over selected positions
+      (reference ``lightning.py:127-139``).
+    - eval: masking applied with an explicit key (val loss is measured on
+      corrupted inputs, as in the reference), dropout off.
+    - predict: ``masking=False`` forward returning logits — the
+      ``predict_samples`` path (reference ``train_mlm.py:14-35``).
+    """
+
+    def loss_fn(params, batch, rngs, deterministic):
+        logits, labels = model.apply(
+            {"params": params},
+            batch["token_ids"],
+            batch["pad_mask"],
+            rngs=rngs,
+            deterministic=deterministic,
+        )
+        return cross_entropy_with_ignore(logits, labels)
+
+    def train_step(state: TrainState, batch) -> Tuple[TrainState, Metrics]:
+        rngs = state.step_rngs("masking", "dropout")
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, batch, rngs, False
+        )
+        metrics = {"loss": loss, **_lr_metric(schedule, state.step)}
+        return state.apply_gradients(grads), metrics
+
+    def eval_step(state: TrainState, batch, key: Array) -> Metrics:
+        loss = loss_fn(state.params, batch, {"masking": key}, True)
+        return {"loss": loss}
+
+    def predict_fn(params, token_ids, pad_mask):
+        logits, _ = model.apply(
+            {"params": params}, token_ids, pad_mask, masking=False
+        )
+        return logits
+
+    return train_step, eval_step, predict_fn
+
+
+def make_classifier_steps(
+    model,
+    schedule: Optional[Schedule] = None,
+    input_kind: str = "image",
+    frozen_encoder: bool = False,
+):
+    """(train_step, eval_step) for a ``PerceiverIO`` classifier.
+
+    ``input_kind``: 'image' (no pad mask, reference ``lightning.py:253-255``)
+    or 'text' (pad-masked, reference ``lightning.py:209-211``).
+    ``frozen_encoder=True`` runs the encoder deterministically (eval-mode
+    parity with the reference's freeze+``.eval()``); combine with
+    ``freeze_subtrees(tx, params, ['encoder'])`` to stop its updates.
+    """
+    if input_kind not in ("image", "text"):
+        raise ValueError(f"input_kind must be 'image' or 'text', got {input_kind!r}")
+
+    def forward(params, batch, rngs, deterministic):
+        kwargs = {"deterministic": deterministic}
+        if frozen_encoder:
+            kwargs["encoder_deterministic"] = True
+        if input_kind == "image":
+            return model.apply({"params": params}, batch["image"], rngs=rngs, **kwargs)
+        return model.apply(
+            {"params": params},
+            batch["token_ids"],
+            pad_mask=batch["pad_mask"],
+            rngs=rngs,
+            **kwargs,
+        )
+
+    def loss_fn(params, batch, rngs, deterministic):
+        logits = forward(params, batch, rngs, deterministic)
+        loss, acc = classification_loss_and_accuracy(logits, batch["label"])
+        return loss, acc
+
+    def train_step(state: TrainState, batch) -> Tuple[TrainState, Metrics]:
+        rngs = state.step_rngs("dropout")
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch, rngs, False
+        )
+        metrics = {"loss": loss, "acc": acc, **_lr_metric(schedule, state.step)}
+        return state.apply_gradients(grads), metrics
+
+    def eval_step(state: TrainState, batch) -> Metrics:
+        loss, acc = loss_fn(state.params, batch, {}, True)
+        return {"loss": loss, "acc": acc}
+
+    return train_step, eval_step

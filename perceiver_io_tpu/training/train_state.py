@@ -1,0 +1,49 @@
+"""Train state: one pytree carrying everything a jitted step updates.
+
+The whole state threads through ``jit``/``pjit`` as a single donated argument,
+so params and optimizer state never leave the device between steps (no
+host↔device traffic in the hot loop).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import optax
+from flax import struct
+
+Array = jax.Array
+
+
+class TrainState(struct.PyTreeNode):
+    step: Array
+    params: Any
+    opt_state: Any
+    rng: Array
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    @classmethod
+    def create(cls, params, tx: optax.GradientTransformation, rng: Array) -> "TrainState":
+        import jax.numpy as jnp
+
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            rng=rng,
+            tx=tx,
+        )
+
+    def apply_gradients(self, grads) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1, params=new_params, opt_state=new_opt_state
+        )
+
+    def step_rngs(self, *names: str) -> dict:
+        """Per-step derived RNG streams: deterministic in (rng, step)."""
+        base = jax.random.fold_in(self.rng, self.step)
+        keys = jax.random.split(base, len(names))
+        return dict(zip(names, keys))

