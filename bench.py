@@ -96,8 +96,10 @@ def main() -> None:
     jax.block_until_ready(metrics["loss"])
     elapsed = time.perf_counter() - t0
 
-    n_chips = jax.device_count()
-    tokens_per_sec_per_chip = batch_size * seq_len * steps / elapsed / n_chips
+    # the jitted step runs on exactly one device (no sharding here), so
+    # per-chip throughput is the total regardless of how many chips the
+    # host exposes
+    tokens_per_sec_per_chip = batch_size * seq_len * steps / elapsed
 
     baseline = None
     try:

@@ -114,8 +114,9 @@ class Collator:
         self.tokenizer = tokenizer
         self.max_seq_len = max_seq_len
         self.pad_id = tokenizer.token_to_id(PAD_TOKEN)
+        # truncation only: collate writes ids into a pre-filled pad_id array,
+        # so tokenizer-level padding would be duplicated work on the hot path
         tokenizer.enable_truncation(max_seq_len)
-        tokenizer.enable_padding()
 
     def collate(self, batch: Sequence[Tuple[int, str]]) -> Dict[str, np.ndarray]:
         labels = np.asarray([y for y, _ in batch], dtype=np.int32)

@@ -95,11 +95,13 @@ class MNISTDataset:
         self,
         images: np.ndarray,
         labels: np.ndarray,
-        random_crop: Optional[int] = None,
+        crop: Optional[int] = None,
+        random_crop: bool = True,
         augment_seed: int = 0,
     ):
         self.images = images
         self.labels = labels
+        self.crop = crop
         self.random_crop = random_crop
         self._rng = np.random.default_rng(augment_seed)
 
@@ -108,17 +110,20 @@ class MNISTDataset:
 
     @property
     def image_shape(self) -> Tuple[int, int, int]:
-        s = self.random_crop
+        s = self.crop
         h, w = self.images.shape[1:3]
         return (s, s, 1) if s else (h, w, 1)
 
     def __getitem__(self, i: int) -> Tuple[np.ndarray, int]:
         img = self.images[i]
-        if self.random_crop:
-            s = self.random_crop
+        if self.crop:
+            s = self.crop
             h, w = img.shape
-            top = int(self._rng.integers(0, h - s + 1))
-            left = int(self._rng.integers(0, w - s + 1))
+            if self.random_crop:
+                top = int(self._rng.integers(0, h - s + 1))
+                left = int(self._rng.integers(0, w - s + 1))
+            else:  # deterministic center crop: eval shapes match train/dims
+                top, left = (h - s) // 2, (w - s) // 2
             img = img[top : top + s, left : left + s]
         # ToTensor (→[0,1]) + Normalize(0.5, 0.5) + channels-last
         img = (img.astype(np.float32) / 255.0 - 0.5) / 0.5
@@ -191,10 +196,14 @@ class MNISTDataModule:
             val = self.val_split
         split = len(images) - val  # explicit split point: val_split=0 keeps all
         self.ds_train = MNISTDataset(
-            images[:split], labels[:split], random_crop=self.random_crop,
+            images[:split], labels[:split], crop=self.random_crop,
             augment_seed=self.seed,
         )
-        self.ds_valid = MNISTDataset(images[split:], labels[split:])
+        # same target size as train (center crop) so val batches match `dims`
+        self.ds_valid = MNISTDataset(
+            images[split:], labels[split:], crop=self.random_crop,
+            random_crop=False,
+        )
 
     def train_dataloader(self) -> DataLoader:
         return DataLoader(

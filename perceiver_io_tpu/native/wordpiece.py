@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import ctypes
+import threading
 from typing import Dict, List
 
 from perceiver_io_tpu.native.build import load_library
@@ -38,15 +39,19 @@ class NativeWordPiece:
         self._handle = self._lib.wp_create(tokens, ids, len(items), unk_id)
         self._unk_id = unk_id
         self._out = (ctypes.c_int32 * _MAX_PIECES)()
+        # the ctypes call releases the GIL; concurrent prefetch threads
+        # (train + val loaders sharing one tokenizer) must not share _out
+        self._lock = threading.Lock()
 
     def encode_word(self, word: str) -> List[int]:
         raw = word.encode("utf-8")
-        n = self._lib.wp_encode_word(
-            self._handle, raw, len(raw), self._out, _MAX_PIECES
-        )
-        if n < 0:  # overflow — absurdly long word; match the Python fallback
-            return [self._unk_id]
-        return list(self._out[:n])
+        with self._lock:
+            n = self._lib.wp_encode_word(
+                self._handle, raw, len(raw), self._out, _MAX_PIECES
+            )
+            if n < 0:  # overflow — absurdly long word; match the Python fallback
+                return [self._unk_id]
+            return list(self._out[:n])
 
     def __del__(self):
         handle = getattr(self, "_handle", None)

@@ -76,9 +76,16 @@ def make_mesh(
     if dp * tp * sp != n:
         raise ValueError(f"dp*tp*sp = {dp * tp * sp} != {n} devices")
 
-    try:
+    if all(d.platform == "cpu" for d in devices):
+        # host-platform (virtual-device) meshes have no physical topology —
+        # row-major assignment is exact, and create_device_mesh can reject
+        # shapes it cannot factor against fake topologies
+        try:
+            device_grid = mesh_utils.create_device_mesh((dp, tp, sp), devices=devices)
+        except Exception:
+            device_grid = np.asarray(devices).reshape(dp, tp, sp)
+    else:
+        # on real accelerators a failure here is a genuine topology error:
+        # surface it rather than silently degrading ICI locality
         device_grid = mesh_utils.create_device_mesh((dp, tp, sp), devices=devices)
-    except Exception:
-        # CPU/host-platform fallback: simple row-major assignment
-        device_grid = np.asarray(devices).reshape(dp, tp, sp)
     return Mesh(device_grid, MESH_AXES)

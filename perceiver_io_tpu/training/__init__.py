@@ -9,8 +9,20 @@ from perceiver_io_tpu.training.steps import (
     make_classifier_steps,
     freeze_subtrees,
 )
+from perceiver_io_tpu.training.checkpoint import (
+    CheckpointManager,
+    load_hparams,
+    restore_encoder_params,
+    restore_params,
+    restore_train_state,
+)
 
 __all__ = [
+    "CheckpointManager",
+    "load_hparams",
+    "restore_encoder_params",
+    "restore_params",
+    "restore_train_state",
     "cross_entropy_with_ignore",
     "classification_loss_and_accuracy",
     "OptimizerConfig",

@@ -1,0 +1,210 @@
+"""Checkpoint/resume tests: round trip, best-k retention, hparams embedding,
+encoder-subtree transfer (SURVEY.md §4 item (e))."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import perceiver_io_tpu as pit
+from perceiver_io_tpu.ops.masking import TextMasking
+from perceiver_io_tpu.training import (
+    CheckpointManager,
+    OptimizerConfig,
+    TrainState,
+    load_hparams,
+    make_mlm_steps,
+    make_optimizer,
+    restore_encoder_params,
+    restore_params,
+    restore_train_state,
+)
+
+VOCAB, SEQ, CH, LATENTS = 32, 8, 16, 4
+
+
+def tiny_mlm(vocab=VOCAB, seq=SEQ, ch=CH, latents=LATENTS):
+    latent_shape = (latents, ch)
+    return pit.PerceiverMLM(
+        encoder=pit.PerceiverEncoder(
+            input_adapter=pit.TextInputAdapter(
+                vocab_size=vocab, max_seq_len=seq, num_channels=ch
+            ),
+            latent_shape=latent_shape,
+            num_layers=2,
+            num_cross_attention_heads=2,
+            num_self_attention_heads=2,
+            num_self_attention_layers_per_block=1,
+        ),
+        decoder=pit.PerceiverDecoder(
+            output_adapter=pit.TextOutputAdapter(
+                vocab_size=vocab, max_seq_len=seq, num_output_channels=ch
+            ),
+            latent_shape=latent_shape,
+            num_cross_attention_heads=2,
+        ),
+        masking=TextMasking(
+            vocab_size=vocab, unk_token_id=1, mask_token_id=2, num_special_tokens=3
+        ),
+    )
+
+
+@pytest.fixture
+def state_and_batch(rng):
+    model = tiny_mlm()
+    batch = {
+        "token_ids": jnp.asarray(rng.integers(3, VOCAB, (2, SEQ)).astype(np.int32)),
+        "pad_mask": jnp.zeros((2, SEQ), dtype=bool),
+    }
+    variables = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        batch["token_ids"], batch["pad_mask"],
+    )
+    tx, schedule = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    state = TrainState.create(variables["params"], tx, jax.random.key(2))
+    return model, state, batch, schedule
+
+
+def _trees_equal(a, b):
+    leaves_a, leaves_b = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(leaves_a) == len(leaves_b)
+    return all(np.allclose(x, y) for x, y in zip(leaves_a, leaves_b))
+
+
+def test_save_restore_round_trip(tmp_path, state_and_batch):
+    model, state, batch, schedule = state_and_batch
+    train_step, _, _ = make_mlm_steps(model, schedule)
+    step_fn = jax.jit(train_step)
+
+    for _ in range(3):
+        state, metrics = step_fn(state, batch)
+
+    with CheckpointManager(str(tmp_path / "ckpt"), async_save=False) as mngr:
+        mngr.save(int(state.step), state, {"val_loss": float(metrics["loss"])})
+        like = TrainState.create(
+            jax.tree.map(jnp.zeros_like, state.params), state.tx, jax.random.key(0)
+        )
+        restored = mngr.restore_state(like)
+
+    assert int(restored.step) == int(state.step)
+    assert _trees_equal(restored.params, state.params)
+    assert _trees_equal(restored.opt_state, state.opt_state)
+    # restored rng must continue the same stream
+    assert np.array_equal(
+        jax.random.key_data(restored.rng), jax.random.key_data(state.rng)
+    )
+
+    # training continues identically from the restored state
+    s1, m1 = step_fn(state, batch)
+    s2, m2 = step_fn(restored, batch)
+    assert np.allclose(float(m1["loss"]), float(m2["loss"]))
+
+
+def test_best_k_retention(tmp_path, state_and_batch):
+    _, state, _, _ = state_and_batch
+    losses = {1: 5.0, 2: 3.0, 3: 4.0, 4: 2.0}  # best two: steps 4, 2
+    with CheckpointManager(
+        str(tmp_path / "ckpt"), max_to_keep=2, async_save=False
+    ) as mngr:
+        for step, loss in losses.items():
+            mngr.save(step, state.replace(step=jnp.asarray(step)), {"val_loss": loss})
+        assert mngr.best_step == 4
+        assert sorted(mngr.all_steps) == [2, 4]
+        assert mngr.restore_metrics()["val_loss"] == 2.0
+
+
+def test_hparams_embedding(tmp_path, state_and_batch):
+    _, state, _, _ = state_and_batch
+    hparams = {"num_latents": LATENTS, "optimizer": OptimizerConfig(one_cycle_lr=True)}
+    with CheckpointManager(
+        str(tmp_path / "ckpt"), hparams=hparams, async_save=False
+    ) as mngr:
+        mngr.save(1, state, {"val_loss": 1.0})
+    loaded = load_hparams(str(tmp_path / "ckpt"))
+    assert loaded["num_latents"] == LATENTS
+    assert loaded["optimizer"]["one_cycle_lr"] is True
+
+
+def test_restore_params_and_module_level_restore(tmp_path, state_and_batch):
+    _, state, _, _ = state_and_batch
+    path = str(tmp_path / "ckpt")
+    with CheckpointManager(path, async_save=False) as mngr:
+        mngr.save(7, state, {"val_loss": 1.0})
+
+    params = restore_params(path, jax.tree.map(jnp.zeros_like, state.params))
+    assert _trees_equal(params, state.params)
+
+    like = TrainState.create(
+        jax.tree.map(jnp.zeros_like, state.params), state.tx, jax.random.key(9)
+    )
+    restored = restore_train_state(path, like)
+    assert int(restored.step) == int(state.step)
+
+
+def test_module_level_restore_prefers_best_step(tmp_path, state_and_batch):
+    """restore_* helpers must load the best-by-val_loss step, not the latest."""
+    _, state, _, _ = state_and_batch
+    path = str(tmp_path / "ckpt")
+    with CheckpointManager(path, max_to_keep=3, async_save=False) as mngr:
+        best = state.replace(
+            step=jnp.asarray(1),
+            params=jax.tree.map(lambda a: a + 1.0, state.params),
+        )
+        mngr.save(1, best, {"val_loss": 0.4})
+        mngr.save(2, state.replace(step=jnp.asarray(2)), {"val_loss": 0.7})
+
+    like = TrainState.create(
+        jax.tree.map(jnp.zeros_like, state.params), state.tx, jax.random.key(0)
+    )
+    restored = restore_train_state(path, like)
+    assert int(restored.step) == 1
+    params = restore_params(path, jax.tree.map(jnp.zeros_like, state.params))
+    assert _trees_equal(params, best.params)
+
+
+def test_encoder_transfer(tmp_path, state_and_batch, rng):
+    """Pretrained-MLM-encoder → text-classifier graft
+    (reference train_seq_clf.py:18-24 semantics as a pytree swap)."""
+    _, state, _, _ = state_and_batch
+    path = str(tmp_path / "ckpt")
+    with CheckpointManager(path, async_save=False) as mngr:
+        mngr.save(1, state, {"val_loss": 1.0})
+
+    # fresh classifier sharing the encoder architecture
+    latent_shape = (LATENTS, CH)
+    clf = pit.PerceiverIO(
+        encoder=pit.PerceiverEncoder(
+            input_adapter=pit.TextInputAdapter(
+                vocab_size=VOCAB, max_seq_len=SEQ, num_channels=CH
+            ),
+            latent_shape=latent_shape,
+            num_layers=2,
+            num_cross_attention_heads=2,
+            num_self_attention_heads=2,
+            num_self_attention_layers_per_block=1,
+        ),
+        decoder=pit.PerceiverDecoder(
+            output_adapter=pit.ClassificationOutputAdapter(
+                num_classes=2, num_output_channels=CH
+            ),
+            latent_shape=latent_shape,
+            num_cross_attention_heads=2,
+        ),
+    )
+    token_ids = jnp.asarray(rng.integers(3, VOCAB, (2, SEQ)).astype(np.int32))
+    pad_mask = jnp.zeros((2, SEQ), dtype=bool)
+    clf_params = clf.init({"params": jax.random.key(3)}, token_ids, pad_mask)["params"]
+
+    encoder_params = restore_encoder_params(
+        path, jax.tree.map(jnp.zeros_like, clf_params["encoder"])
+    )
+    assert _trees_equal(encoder_params, state.params["encoder"])
+
+    grafted = dict(clf_params)
+    grafted["encoder"] = encoder_params
+    logits = clf.apply({"params": grafted}, token_ids, pad_mask)
+    assert logits.shape == (2, 2)
+    assert np.isfinite(np.asarray(logits)).all()

@@ -154,10 +154,27 @@ def test_mnist_dataset_normalization():
 
 def test_mnist_random_crop():
     images, labels = synthetic_digits(4, seed=0)
-    ds = MNISTDataset(images, labels, random_crop=20)
+    ds = MNISTDataset(images, labels, crop=20)
     img, _ = ds[0]
     assert img.shape == (20, 20, 1)
     assert ds.image_shape == (20, 20, 1)
+
+
+def test_mnist_val_crop_matches_dims():
+    """random_crop module: val batches must match `dims` (center crop)."""
+    dm = MNISTDataModule(
+        batch_size=8, synthetic=True, synthetic_size=128, random_crop=24
+    )
+    dm.setup()
+    assert dm.dims == (24, 24, 1)
+    tb = next(iter(dm.train_dataloader()))
+    vb = next(iter(dm.val_dataloader()))
+    assert tb["image"].shape[1:] == (24, 24, 1)
+    assert vb["image"].shape[1:] == (24, 24, 1)
+    # center crop is deterministic: same example → same array every epoch
+    a, _ = dm.ds_valid[0]
+    b, _ = dm.ds_valid[0]
+    np.testing.assert_array_equal(a, b)
 
 
 def test_mnist_synthetic_module():
