@@ -1,0 +1,1 @@
+"""CLI entry points (reference ``train/train_*.py`` equivalents)."""

@@ -1,0 +1,260 @@
+"""Shared CLI plumbing: layered argparse groups + model/optimizer builders.
+
+Mirrors the reference's composed-parser pattern (each layer contributes an
+argument group: model ``lightning.py:26-40``, optimizer ``lightning.py:50-57``,
+data ``imdb.py:103-112`` / ``mnist.py:53-61``, Trainer flags, per-task
+``set_defaults`` — reference ``train_mlm.py:80-106``), with TPU-specific
+groups the reference has no analogue for: mesh construction (dp/tp/sp — the
+DDP-flags replacement) and compute (dtype / attention impl / remat).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+import perceiver_io_tpu as pit
+from perceiver_io_tpu.ops.masking import TextMasking
+from perceiver_io_tpu.parallel.mesh import make_mesh
+from perceiver_io_tpu.training.optim import OptimizerConfig, make_optimizer
+from perceiver_io_tpu.training.trainer import TrainerConfig
+
+DTYPES = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+
+
+# -- argument groups ---------------------------------------------------------
+
+
+def add_model_args(parser: argparse.ArgumentParser) -> None:
+    g = parser.add_argument_group("model")
+    g.add_argument("--num_latents", type=int, default=64)
+    g.add_argument("--num_latent_channels", type=int, default=64)
+    g.add_argument("--num_encoder_layers", type=int, default=3)
+    g.add_argument("--num_self_attention_layers_per_block", type=int, default=6)
+    g.add_argument("--num_cross_attention_heads", type=int, default=4)
+    g.add_argument("--num_self_attention_heads", type=int, default=4)
+    g.add_argument("--dropout", type=float, default=0.0)
+
+
+def add_optimizer_args(parser: argparse.ArgumentParser) -> None:
+    g = parser.add_argument_group("optimizer")
+    g.add_argument("--optimizer", choices=("Adam", "AdamW"), default="Adam")
+    g.add_argument("--learning_rate", type=float, default=1e-3)
+    g.add_argument("--weight_decay", type=float, default=0.0)
+    g.add_argument("--one_cycle_lr", action="store_true")
+    g.add_argument("--one_cycle_pct_start", type=float, default=0.1)
+
+
+def add_trainer_args(parser: argparse.ArgumentParser) -> None:
+    g = parser.add_argument_group("trainer")
+    g.add_argument("--max_epochs", type=int, default=None)
+    g.add_argument("--max_steps", type=int, default=None)
+    g.add_argument("--log_every_n_steps", type=int, default=50)
+    g.add_argument("--eval_every_n_steps", type=int, default=None,
+                   help="validate every N steps (default: once per epoch)")
+    g.add_argument("--logdir", default="logs")
+    g.add_argument("--experiment", default="default")
+    g.add_argument("--max_to_keep", type=int, default=1)
+    g.add_argument("--no_tensorboard", action="store_true")
+    g.add_argument("--profile_steps", type=int, default=0,
+                   help="capture a profiler trace of N steps after warmup")
+
+
+def add_mesh_args(parser: argparse.ArgumentParser) -> None:
+    g = parser.add_argument_group("mesh (the DDP-flags replacement)")
+    g.add_argument("--dp", type=int, default=None,
+                   help="data-parallel size (default: n_devices / (tp*sp))")
+    g.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
+    g.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel size (shards the input axis M)")
+    g.add_argument("--shard_seq", action="store_true",
+                   help="shard text batches over the seq mesh axis")
+
+
+def add_compute_args(parser: argparse.ArgumentParser) -> None:
+    g = parser.add_argument_group("compute")
+    g.add_argument("--dtype", choices=sorted(DTYPES), default="bfloat16")
+    g.add_argument("--attn_impl", choices=("xla", "pallas"), default="xla")
+    g.add_argument("--remat", action="store_true",
+                   help="rematerialize encoder layers (HBM for FLOPs)")
+    g.add_argument("--seed", type=int, default=0)
+
+
+def add_imdb_args(parser: argparse.ArgumentParser) -> None:
+    g = parser.add_argument_group("data (IMDB)")
+    g.add_argument("--root", default=".cache")
+    g.add_argument("--max_seq_len", type=int, default=512)
+    g.add_argument("--vocab_size", type=int, default=10003)
+    g.add_argument("--batch_size", type=int, default=64)
+    g.add_argument("--synthetic", action="store_true",
+                   help="deterministic generated corpus (no downloads)")
+    g.add_argument("--synthetic_size", type=int, default=2048)
+
+
+def add_mnist_args(parser: argparse.ArgumentParser) -> None:
+    g = parser.add_argument_group("data (MNIST)")
+    g.add_argument("--root", default=".cache")
+    g.add_argument("--batch_size", type=int, default=128)
+    g.add_argument("--random_crop", type=int, default=None)
+    g.add_argument("--synthetic", action="store_true")
+    g.add_argument("--synthetic_size", type=int, default=4096)
+
+
+# -- builders ----------------------------------------------------------------
+
+
+def trainer_config(args) -> TrainerConfig:
+    return TrainerConfig(
+        max_epochs=args.max_epochs,
+        max_steps=args.max_steps,
+        log_every_n_steps=args.log_every_n_steps,
+        eval_every_n_steps=args.eval_every_n_steps,
+        logdir=args.logdir,
+        experiment=args.experiment,
+        max_to_keep=args.max_to_keep,
+        use_tensorboard=not args.no_tensorboard,
+        profile_steps=args.profile_steps,
+    )
+
+
+def optimizer_from_args(args):
+    return make_optimizer(
+        OptimizerConfig(
+            optimizer=args.optimizer,
+            learning_rate=args.learning_rate,
+            weight_decay=args.weight_decay,
+            one_cycle_lr=args.one_cycle_lr,
+            one_cycle_pct_start=args.one_cycle_pct_start,
+            max_steps=args.max_steps,
+        )
+    )
+
+
+def mesh_from_args(args):
+    return make_mesh(dp=args.dp, tp=args.tp, sp=args.sp)
+
+
+def build_text_encoder(args, vocab_size: int, max_seq_len: int) -> pit.PerceiverEncoder:
+    """TextInputAdapter + encoder (reference ``lightning.py:108-116``; the
+    embedding width equals the latent channel count, as in the reference's
+    north-star config)."""
+    dtype = DTYPES[args.dtype]
+    return pit.PerceiverEncoder(
+        input_adapter=pit.TextInputAdapter(
+            vocab_size=vocab_size,
+            max_seq_len=max_seq_len,
+            num_channels=args.num_latent_channels,
+            dtype=dtype,
+        ),
+        latent_shape=(args.num_latents, args.num_latent_channels),
+        num_layers=args.num_encoder_layers,
+        num_cross_attention_heads=args.num_cross_attention_heads,
+        num_self_attention_heads=args.num_self_attention_heads,
+        num_self_attention_layers_per_block=args.num_self_attention_layers_per_block,
+        dropout=args.dropout,
+        dtype=dtype,
+        attn_impl=args.attn_impl,
+        remat=args.remat,
+    )
+
+
+def build_mlm(args, vocab_size: int, max_seq_len: int) -> pit.PerceiverMLM:
+    """MLM model (reference ``lightning.py:108-120``)."""
+    dtype = DTYPES[args.dtype]
+    return pit.PerceiverMLM(
+        encoder=build_text_encoder(args, vocab_size, max_seq_len),
+        decoder=pit.PerceiverDecoder(
+            output_adapter=pit.TextOutputAdapter(
+                vocab_size=vocab_size,
+                max_seq_len=max_seq_len,
+                num_output_channels=args.num_latent_channels,
+                dtype=dtype,
+            ),
+            latent_shape=(args.num_latents, args.num_latent_channels),
+            num_cross_attention_heads=args.num_cross_attention_heads,
+            dropout=args.dropout,
+            dtype=dtype,
+            attn_impl=args.attn_impl,
+        ),
+        masking=TextMasking(
+            vocab_size=vocab_size, unk_token_id=1, mask_token_id=2,
+            num_special_tokens=3,
+        ),
+    )
+
+
+def build_text_classifier(args, vocab_size: int, max_seq_len: int,
+                          num_classes: int = 2) -> pit.PerceiverIO:
+    """Sequence classifier (reference ``lightning.py:186-200``)."""
+    dtype = DTYPES[args.dtype]
+    return pit.PerceiverIO(
+        encoder=build_text_encoder(args, vocab_size, max_seq_len),
+        decoder=pit.PerceiverDecoder(
+            output_adapter=pit.ClassificationOutputAdapter(
+                num_classes=num_classes,
+                num_output_channels=args.num_latent_channels,
+                dtype=dtype,
+            ),
+            latent_shape=(args.num_latents, args.num_latent_channels),
+            num_cross_attention_heads=args.num_cross_attention_heads,
+            dropout=args.dropout,
+            dtype=dtype,
+            attn_impl=args.attn_impl,
+        ),
+    )
+
+
+def build_image_classifier(
+    args, image_shape: Tuple[int, ...], num_classes: int,
+    num_frequency_bands: int = 32,
+) -> pit.PerceiverIO:
+    """Image classifier (reference ``lightning.py:222-244``)."""
+    dtype = DTYPES[args.dtype]
+    return pit.PerceiverIO(
+        encoder=pit.PerceiverEncoder(
+            input_adapter=pit.ImageInputAdapter(
+                image_shape=tuple(image_shape),
+                num_frequency_bands=num_frequency_bands,
+                dtype=dtype,
+            ),
+            latent_shape=(args.num_latents, args.num_latent_channels),
+            num_layers=args.num_encoder_layers,
+            num_cross_attention_heads=args.num_cross_attention_heads,
+            num_self_attention_heads=args.num_self_attention_heads,
+            num_self_attention_layers_per_block=args.num_self_attention_layers_per_block,
+            dropout=args.dropout,
+            dtype=dtype,
+            attn_impl=args.attn_impl,
+            remat=args.remat,
+        ),
+        decoder=pit.PerceiverDecoder(
+            output_adapter=pit.ClassificationOutputAdapter(
+                num_classes=num_classes,
+                num_output_channels=args.num_latent_channels,
+                dtype=dtype,
+            ),
+            latent_shape=(args.num_latents, args.num_latent_channels),
+            num_cross_attention_heads=args.num_cross_attention_heads,
+            dropout=args.dropout,
+            dtype=dtype,
+            attn_impl=args.attn_impl,
+        ),
+    )
+
+
+MODEL_HPARAM_KEYS = (
+    "num_latents", "num_latent_channels", "num_encoder_layers",
+    "num_self_attention_layers_per_block", "num_cross_attention_heads",
+    "num_self_attention_heads", "vocab_size", "max_seq_len",
+)
+
+
+def override_model_args(args, hparams: dict) -> None:
+    """Overwrite shape-determining model args from a checkpoint's embedded
+    hparams so a restored encoder fits (reference ``load_from_checkpoint``
+    rebuilds the model from saved hyperparameters, ``lightning.py:46``)."""
+    for key in MODEL_HPARAM_KEYS:
+        if key in hparams:
+            setattr(args, key, hparams[key])

@@ -1,0 +1,84 @@
+"""MNIST image-classification entry point (reference ``train/train_img_clf.py``).
+
+Reference per-task defaults (``train_img_clf.py:42-55``): 32 latents × 128
+channels, 3 encoder layers × 3 self-attention layers per block, batch 128.
+The model is built from the data module's ``dims``/``num_classes``
+(``train_img_clf.py:15-17``).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+import jax
+
+from perceiver_io_tpu.cli import common
+from perceiver_io_tpu.data.mnist import MNISTDataModule
+from perceiver_io_tpu.training import TrainState, make_classifier_steps
+from perceiver_io_tpu.training.trainer import Trainer
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    common.add_trainer_args(parser)
+    common.add_mesh_args(parser)
+    common.add_compute_args(parser)
+    common.add_model_args(parser)
+    common.add_optimizer_args(parser)
+    common.add_mnist_args(parser)
+    g = parser.add_argument_group("task (image classification)")
+    g.add_argument("--num_frequency_bands", type=int, default=32)
+    # reference per-task defaults (train_img_clf.py:42-55)
+    parser.set_defaults(experiment="img_clf", num_latents=32,
+                        num_latent_channels=128, num_encoder_layers=3,
+                        num_self_attention_layers_per_block=3)
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None):
+    args = build_parser().parse_args(argv)
+
+    data = MNISTDataModule(
+        root=args.root,
+        batch_size=args.batch_size,
+        random_crop=args.random_crop,
+        synthetic=args.synthetic,
+        synthetic_size=args.synthetic_size,
+        seed=args.seed,
+        shard_id=jax.process_index(),
+        num_shards=jax.process_count(),
+    )
+    data.prepare_data()
+    data.setup()
+
+    model = common.build_image_classifier(
+        args, data.dims, data.num_classes,
+        num_frequency_bands=args.num_frequency_bands,
+    )
+    example = next(iter(data.val_dataloader()))
+    variables = model.init(
+        {"params": jax.random.key(args.seed)}, example["image"][:1]
+    )
+    tx, schedule = common.optimizer_from_args(args)
+    state = TrainState.create(variables["params"], tx, jax.random.key(args.seed + 2))
+
+    train_step, eval_step = make_classifier_steps(model, schedule, input_kind="image")
+    mesh = common.mesh_from_args(args)
+
+    trainer = Trainer(
+        train_step,
+        lambda s, b, k: eval_step(s, b),
+        state,
+        common.trainer_config(args),
+        example_batch={k: example[k] for k in ("image", "label")},
+        mesh=mesh,
+        hparams=vars(args),
+    )
+    with trainer:
+        trainer.fit(data.train_dataloader(), data.val_dataloader())
+    return trainer.run_dir
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+"""IMDB sequence-classification entry point (reference ``train/train_seq_clf.py``).
+
+Three init modes, mirroring ``train_seq_clf.py:18-28``:
+
+- ``--mlm_checkpoint <run_dir/checkpoints>``: rebuild the encoder from the
+  checkpoint's embedded hparams, graft its pretrained params subtree into a
+  fresh classifier (the reference's checkpoint surgery as a pure pytree swap),
+  optionally ``--freeze_encoder`` (no updates + encoder runs in eval mode —
+  ``freeze()`` parity, reference ``train/utils.py:5-8``);
+- ``--clf_checkpoint <run_dir/checkpoints>``: resume a classifier run;
+- neither: train from scratch.
+
+Reference per-task defaults (``train_seq_clf.py:56-68``): batch 128,
+weight_decay 1e-3, dropout 0.1.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+import jax
+
+from perceiver_io_tpu.cli import common
+from perceiver_io_tpu.data.imdb import IMDBDataModule
+from perceiver_io_tpu.training import TrainState, make_classifier_steps
+from perceiver_io_tpu.training.checkpoint import (
+    load_hparams,
+    restore_encoder_params,
+    restore_train_state,
+)
+from perceiver_io_tpu.training.steps import freeze_subtrees
+from perceiver_io_tpu.training.trainer import Trainer
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    common.add_trainer_args(parser)
+    common.add_mesh_args(parser)
+    common.add_compute_args(parser)
+    common.add_model_args(parser)
+    common.add_optimizer_args(parser)
+    common.add_imdb_args(parser)
+    g = parser.add_argument_group("task (sequence classification)")
+    g.add_argument("--mlm_checkpoint", default=None,
+                   help="checkpoints dir of a train_mlm run: transfer its encoder")
+    g.add_argument("--clf_checkpoint", default=None,
+                   help="checkpoints dir of a train_seq_clf run: resume")
+    g.add_argument("--freeze_encoder", action="store_true")
+    # reference per-task defaults (train_seq_clf.py:56-68)
+    parser.set_defaults(experiment="seq_clf", batch_size=128, weight_decay=1e-3,
+                        dropout=0.1, num_latents=64, num_latent_channels=64,
+                        num_encoder_layers=3)
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None):
+    args = build_parser().parse_args(argv)
+    if args.mlm_checkpoint and args.clf_checkpoint:
+        raise SystemExit("--mlm_checkpoint and --clf_checkpoint are exclusive")
+
+    # a restored encoder must be rebuilt with the shapes it was trained with
+    source_ckpt = args.mlm_checkpoint or args.clf_checkpoint
+    if source_ckpt:
+        common.override_model_args(args, load_hparams(source_ckpt))
+    if args.clf_checkpoint:
+        # resume also restores the training setup: the optimizer-state pytree
+        # structure depends on these (load_from_checkpoint parity,
+        # reference lightning.py:46 + train_seq_clf.py:26)
+        hparams = load_hparams(args.clf_checkpoint)
+        for key in ("optimizer", "weight_decay", "one_cycle_lr", "freeze_encoder"):
+            if key in hparams:
+                setattr(args, key, hparams[key])
+
+    data = IMDBDataModule(
+        root=args.root,
+        max_seq_len=args.max_seq_len,
+        vocab_size=args.vocab_size,
+        batch_size=args.batch_size,
+        synthetic=args.synthetic,
+        synthetic_size=args.synthetic_size,
+        seed=args.seed,
+        shard_id=jax.process_index(),
+        num_shards=jax.process_count(),
+    )
+    data.prepare_data()
+    data.setup()
+    vocab_size = data.tokenizer.get_vocab_size()
+
+    model = common.build_text_classifier(args, vocab_size, args.max_seq_len)
+    example = next(iter(data.val_dataloader()))
+    variables = model.init(
+        {"params": jax.random.key(args.seed)},
+        example["token_ids"][:1], pad_mask=example["pad_mask"][:1],
+    )
+    params = variables["params"]
+
+    if args.mlm_checkpoint:
+        params = dict(params)
+        params["encoder"] = restore_encoder_params(
+            args.mlm_checkpoint, params["encoder"]
+        )
+
+    tx, schedule = common.optimizer_from_args(args)
+    if args.freeze_encoder:
+        tx = freeze_subtrees(tx, params, ["encoder"])
+    state = TrainState.create(params, tx, jax.random.key(args.seed + 2))
+
+    if args.clf_checkpoint:
+        state = restore_train_state(args.clf_checkpoint, state)
+
+    train_step, eval_step = make_classifier_steps(
+        model, schedule, input_kind="text", frozen_encoder=args.freeze_encoder
+    )
+    mesh = common.mesh_from_args(args)
+
+    trainer = Trainer(
+        train_step,
+        lambda s, b, k: eval_step(s, b),
+        state,
+        common.trainer_config(args),
+        example_batch={k: example[k] for k in ("token_ids", "pad_mask", "label")},
+        mesh=mesh,
+        shard_seq=args.shard_seq,
+        hparams=vars(args),
+        tokens_per_example=args.max_seq_len,
+    )
+    with trainer:
+        trainer.fit(data.train_dataloader(), data.val_dataloader())
+    return trainer.run_dir
+
+
+if __name__ == "__main__":
+    main()

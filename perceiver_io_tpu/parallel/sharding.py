@@ -158,4 +158,6 @@ def make_sharded_train_step(
     def step(state, batch):
         return jitted(state, {k: batch[k] for k in keys})
 
+    # expose the underlying jit wrapper for lowering/cost-analysis reuse
+    step.jitted = jitted
     return step, sharded_state, b_shardings

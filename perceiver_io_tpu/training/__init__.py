@@ -16,8 +16,15 @@ from perceiver_io_tpu.training.checkpoint import (
     restore_params,
     restore_train_state,
 )
+from perceiver_io_tpu.training.metrics import MetricsLogger, next_version_dir, read_metrics
+from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
 
 __all__ = [
+    "MetricsLogger",
+    "next_version_dir",
+    "read_metrics",
+    "Trainer",
+    "TrainerConfig",
     "CheckpointManager",
     "load_hparams",
     "restore_encoder_params",

@@ -1,0 +1,120 @@
+"""Host-side metrics logging: TensorBoard + JSONL.
+
+The replacement for the reference's Lightning/TensorBoard observability
+(reference ``train_mlm.py:69``: ``TensorBoardLogger('logs', name=experiment)``
+with scalar logging via ``self.log`` and free-text sample predictions via
+``add_text``). Keeps the same on-disk layout — ``logs/<experiment>/version_n``
+— so existing TensorBoard workflows carry over unchanged.
+
+TensorBoard events are written through ``torch.utils.tensorboard`` when
+available (torch is host-side only here — nothing touches the device path);
+every scalar is also appended to ``metrics.jsonl`` so runs remain greppable
+and the logger degrades gracefully on boxes without a TB writer.
+
+Only process 0 writes (multi-host safe).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, Optional
+
+import jax
+
+
+def next_version_dir(logdir: str, experiment: str) -> str:
+    """``<logdir>/<experiment>/version_n`` with the smallest unused n —
+    the Lightning layout (reference ``README.md:123-144``). Multi-host: the
+    index chosen by process 0 is broadcast so every process agrees even when
+    their directory scans race."""
+    base = os.path.join(logdir, experiment)
+    n = 0
+    if os.path.isdir(base):
+        versions = [
+            int(m.group(1))
+            for name in os.listdir(base)
+            if (m := re.fullmatch(r"version_(\d+)", name))
+        ]
+        n = max(versions) + 1 if versions else 0
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        import numpy as np
+
+        n = int(multihost_utils.broadcast_one_to_all(np.int32(n)))
+    run_dir = os.path.join(base, f"version_{n}")
+    if jax.process_index() == 0:
+        os.makedirs(run_dir, exist_ok=True)
+    return run_dir
+
+
+class MetricsLogger:
+    """Scalar + text logging to TensorBoard events and ``metrics.jsonl``."""
+
+    def __init__(self, run_dir: str, use_tensorboard: bool = True):
+        self.run_dir = run_dir
+        self._is_writer = jax.process_index() == 0
+        self._jsonl = None
+        self._tb = None
+        if not self._is_writer:
+            return
+        os.makedirs(run_dir, exist_ok=True)
+        self._jsonl = open(os.path.join(run_dir, "metrics.jsonl"), "a")
+        if use_tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir=run_dir)
+            except Exception:
+                self._tb = None
+
+    def log_scalars(self, step: int, metrics: Dict[str, float]) -> None:
+        if not self._is_writer:
+            return
+        values = {k: float(v) for k, v in metrics.items()}
+        self._jsonl.write(json.dumps({"step": int(step), **values}) + "\n")
+        if self._tb is not None:
+            for k, v in values.items():
+                self._tb.add_scalar(k, v, int(step))
+
+    def log_text(self, tag: str, step: int, text: str) -> None:
+        """Free-text logging — the sample-prediction channel (reference
+        ``train_mlm.py:55-56``)."""
+        if not self._is_writer:
+            return
+        self._jsonl.write(
+            json.dumps({"step": int(step), "tag": tag, "text": text}) + "\n"
+        )
+        if self._tb is not None:
+            self._tb.add_text(tag, text, int(step))
+
+    def flush(self) -> None:
+        if self._jsonl is not None:
+            self._jsonl.flush()
+        if self._tb is not None:
+            self._tb.flush()
+
+    def close(self) -> None:
+        if self._jsonl is not None:
+            self.flush()
+            self._jsonl.close()
+            self._jsonl = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_metrics(run_dir: str) -> list:
+    """Parse ``metrics.jsonl`` back (tests / analysis)."""
+    path = os.path.join(run_dir, "metrics.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]

@@ -1,0 +1,90 @@
+"""Tracing / profiling utilities — the observability layer the reference lacks.
+
+The reference ships nothing beyond Lightning's progress bar (SURVEY.md §5);
+here profiling is first-class and TPU-native:
+
+- ``start_profiler_server`` / ``trace``: the ``jax.profiler`` trace server and
+  programmatic trace capture, viewable in TensorBoard's profile plugin or
+  Perfetto.
+- ``annotate_step``: ``StepTraceAnnotation`` wrapper so each training step
+  shows up as a named step in the trace timeline.
+- ``compiled_flops`` + ``device_peak_flops`` + ``mfu``: model-FLOPs-utilization
+  accounting from XLA's own cost analysis of the compiled step — the number
+  the BASELINE.md target (≥45% MFU on v5e) is measured in.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+# Peak dense matmul throughput per chip, bf16, FLOP/s. Public figures from
+# cloud.google.com/tpu/docs (v2/v3 are per-chip = 2 cores).
+_PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def start_profiler_server(port: int = 9012) -> None:
+    """Start the profiler server so TensorBoard can capture live traces."""
+    jax.profiler.start_server(port)
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture a profiler trace into ``logdir`` (TensorBoard-compatible)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate_step(step_num: int) -> jax.profiler.StepTraceAnnotation:
+    """Mark a training step in the trace timeline."""
+    return jax.profiler.StepTraceAnnotation("train", step_num=step_num)
+
+
+def compiled_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
+    """Total FLOPs of one invocation, from XLA's cost analysis of the lowered
+    computation. None when the backend doesn't expose an estimate."""
+    try:
+        cost = jitted_fn.lower(*args, **kwargs).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax: one dict per device
+            cost = cost[0]
+        flops = cost.get("flops")
+        return float(flops) if flops else None
+    except Exception:
+        return None
+
+
+def device_peak_flops(device: Optional[jax.Device] = None) -> Optional[float]:
+    """Peak bf16 FLOP/s for a device, or None when unknown (e.g. CPU)."""
+    device = device or jax.devices()[0]
+    return _PEAK_FLOPS.get(getattr(device, "device_kind", ""))
+
+
+def mfu(
+    flops_per_step: float,
+    step_time_s: float,
+    num_devices: int = 1,
+    device: Optional[jax.Device] = None,
+) -> Optional[float]:
+    """Model FLOPs utilization in [0, 1]: achieved / peak.
+
+    ``flops_per_step`` is the whole program's FLOPs (all devices), so peak is
+    scaled by ``num_devices``.
+    """
+    peak = device_peak_flops(device)
+    if peak is None or step_time_s <= 0:
+        return None
+    return flops_per_step / step_time_s / (peak * num_devices)

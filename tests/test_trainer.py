@@ -1,0 +1,193 @@
+"""Trainer loop: logging, checkpointing, eval averaging, mesh mode."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import perceiver_io_tpu as pit
+from perceiver_io_tpu.data.pipeline import DataLoader
+from perceiver_io_tpu.parallel.mesh import make_mesh
+from perceiver_io_tpu.training import (
+    OptimizerConfig,
+    TrainState,
+    Trainer,
+    TrainerConfig,
+    make_classifier_steps,
+    make_optimizer,
+    read_metrics,
+    restore_train_state,
+)
+
+
+class _Blobs:
+    """Tiny deterministic image dataset (class-dependent mean)."""
+
+    def __init__(self, n, seed=0):
+        rng = np.random.default_rng(seed)
+        self.labels = rng.integers(0, 2, size=n).astype(np.int32)
+        base = self.labels.astype(np.float32)[:, None, None] * 0.8 - 0.4
+        self.images = base[..., None] + rng.normal(0, 0.1, (n, 8, 8, 1)).astype(
+            np.float32
+        )
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, i):
+        return self.images[i], int(self.labels[i])
+
+
+def _collate(batch):
+    return {
+        "image": np.stack([x for x, _ in batch]),
+        "label": np.asarray([y for _, y in batch], dtype=np.int32),
+    }
+
+
+def _make_parts(tmp_path, mesh=None):
+    model = pit.PerceiverIO(
+        encoder=pit.PerceiverEncoder(
+            input_adapter=pit.ImageInputAdapter(image_shape=(8, 8, 1),
+                                               num_frequency_bands=4),
+            latent_shape=(4, 16),
+            num_layers=1,
+            num_self_attention_layers_per_block=1,
+        ),
+        decoder=pit.PerceiverDecoder(
+            output_adapter=pit.ClassificationOutputAdapter(
+                num_classes=2, num_output_channels=16
+            ),
+            latent_shape=(4, 16),
+        ),
+    )
+    example = _collate([_Blobs(2)[i] for i in range(2)])
+    params = model.init({"params": jax.random.key(0)}, example["image"])["params"]
+    tx, schedule = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    state = TrainState.create(params, tx, jax.random.key(1))
+    train_step, eval_step = make_classifier_steps(model, schedule)
+    config = TrainerConfig(
+        max_epochs=2,
+        log_every_n_steps=2,
+        logdir=str(tmp_path / "logs"),
+        experiment="t",
+        use_tensorboard=False,
+        compute_mfu=False,
+    )
+    trainer = Trainer(
+        train_step,
+        lambda s, b, k: eval_step(s, b),
+        state,
+        config,
+        example_batch=example,
+        mesh=mesh,
+    )
+    loaders = (
+        DataLoader(_Blobs(64), 16, _collate, shuffle=True, prefetch=0),
+        DataLoader(_Blobs(32, seed=1), 16, _collate, prefetch=0),
+    )
+    return trainer, loaders
+
+
+def test_fit_logs_and_checkpoints(tmp_path):
+    trainer, (train_loader, val_loader) = _make_parts(tmp_path)
+    with trainer:
+        state = trainer.fit(train_loader, val_loader)
+        assert int(jax.device_get(state.step)) == 8  # 2 epochs × 4 batches
+        rows = read_metrics(trainer.run_dir)
+        train_rows = [r for r in rows if "train_loss" in r]
+        val_rows = [r for r in rows if "val_loss" in r]
+        assert len(train_rows) == 4  # every 2 steps
+        assert len(val_rows) == 2  # per epoch
+        assert all("lr" in r and "examples_per_sec" in r for r in train_rows)
+        best = trainer.checkpoints.best_step
+        losses = {r["step"]: r["val_loss"] for r in val_rows}
+        assert best == min(losses, key=losses.get)
+
+
+def test_fit_max_steps_and_resume(tmp_path):
+    trainer, (train_loader, val_loader) = _make_parts(tmp_path)
+    cfg = TrainerConfig(
+        max_steps=3,
+        log_every_n_steps=1,
+        logdir=str(tmp_path / "logs2"),
+        experiment="t",
+        use_tensorboard=False,
+        compute_mfu=False,
+    )
+    trainer2 = Trainer(
+        trainer._raw_train_step,
+        trainer._eval_step and (lambda s, b, k: {"loss": s.step * 0.0}),
+        trainer.state,
+        cfg,
+        example_batch=trainer._example_batch,
+    )
+    with trainer2:
+        state = trainer2.fit(train_loader, val_loader)
+    assert int(jax.device_get(state.step)) == 3
+    # resume from the checkpoint directory
+    like = trainer2.state
+    restored = restore_train_state(
+        os.path.join(trainer2.run_dir, "checkpoints"), like
+    )
+    assert int(jax.device_get(restored.step)) == 3
+
+
+def test_fit_sharded_mesh(tmp_path):
+    mesh = make_mesh(dp=4, tp=2)
+    trainer, (train_loader, val_loader) = _make_parts(tmp_path, mesh=mesh)
+    with trainer:
+        state = trainer.fit(train_loader, val_loader)
+    assert int(jax.device_get(state.step)) == 8
+    rows = read_metrics(trainer.run_dir)
+    assert any("val_loss" in r for r in rows)
+
+
+def test_eval_weighted_average(tmp_path):
+    trainer, _ = _make_parts(tmp_path)
+    # two batches of different size: mean must be weighted by batch size
+    loader = [
+        _collate([_Blobs(8)[i] for i in range(8)]),
+        _collate([_Blobs(4, seed=2)[i] for i in range(4)]),
+    ]
+    with trainer:
+        out = trainer._run_eval(loader)
+    assert set(out) == {"val_loss", "val_acc"}
+
+    per_batch = [trainer._eval_step(trainer.state, b, jax.random.key(0)) for b in loader]
+    expected = (float(per_batch[0]["loss"]) * 8 + float(per_batch[1]["loss"]) * 4) / 12
+    assert out["val_loss"] == pytest.approx(expected, rel=1e-5)
+
+
+def test_eval_every_n_steps_checkpoints_tail(tmp_path):
+    """A run ending between eval intervals must still validate + checkpoint."""
+    trainer, (train_loader, val_loader) = _make_parts(tmp_path)
+    cfg = TrainerConfig(
+        max_steps=5,
+        eval_every_n_steps=3,
+        log_every_n_steps=1,
+        logdir=str(tmp_path / "logs3"),
+        experiment="t",
+        use_tensorboard=False,
+        compute_mfu=False,
+    )
+    trainer3 = Trainer(
+        trainer._raw_train_step,
+        trainer._eval_step and (lambda s, b, k: trainer._eval_step(s, b, k)),
+        trainer.state,
+        cfg,
+        example_batch=trainer._example_batch,
+    )
+    with trainer3:
+        trainer3.fit(train_loader, val_loader)
+        steps = trainer3.checkpoints.all_steps
+    rows = read_metrics(trainer3.run_dir)
+    val_steps = sorted({r["step"] for r in rows if "val_loss" in r})
+    assert val_steps == [3, 5]  # interval hit + final tail
+    assert 5 in steps or 3 in steps  # best-of kept one of them
+
+
+def test_config_requires_limit():
+    with pytest.raises(ValueError):
+        TrainerConfig()

@@ -1,0 +1,12 @@
+#!/usr/bin/env python
+"""MLM pretraining (reference ``train/train_mlm.py`` CLI surface)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from perceiver_io_tpu.cli.train_mlm import main
+
+if __name__ == "__main__":
+    main()
