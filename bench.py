@@ -10,7 +10,8 @@ The reference publishes no throughput numbers (BASELINE.md), so
 ``published`` map when present, else 1.0.
 
 Env knobs: PIT_BENCH_CPU=1 forces CPU; PIT_BENCH_STEPS / PIT_BENCH_BATCH
-override defaults.
+override defaults; PIT_BENCH_ATTN selects the attention impl
+('xla' | 'pallas', default 'pallas' on TPU).
 """
 
 from __future__ import annotations
@@ -44,6 +45,11 @@ def main() -> None:
     batch_size = int(os.environ.get("PIT_BENCH_BATCH", "64"))
     steps = int(os.environ.get("PIT_BENCH_STEPS", "20"))
     compute_dtype = jnp.bfloat16
+    attn_impl = os.environ.get(
+        "PIT_BENCH_ATTN", "pallas" if jax.default_backend() == "tpu" else "xla"
+    )
+    if attn_impl not in ("xla", "pallas"):
+        raise SystemExit(f"PIT_BENCH_ATTN must be 'xla' or 'pallas', got {attn_impl!r}")
 
     latent_shape = (num_latents, channels)
     model = pit.PerceiverMLM(
@@ -56,6 +62,7 @@ def main() -> None:
             num_layers=3,
             num_self_attention_layers_per_block=6,
             dtype=compute_dtype,
+            attn_impl=attn_impl,
         ),
         decoder=pit.PerceiverDecoder(
             output_adapter=pit.TextOutputAdapter(
@@ -64,6 +71,7 @@ def main() -> None:
             ),
             latent_shape=latent_shape,
             dtype=compute_dtype,
+            attn_impl=attn_impl,
         ),
         masking=TextMasking(vocab_size=vocab, unk_token_id=1, mask_token_id=2,
                             num_special_tokens=3),

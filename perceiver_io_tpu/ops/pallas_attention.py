@@ -1,10 +1,26 @@
 """Fused latent-attention Pallas kernel (TPU).
 
-Covers the Perceiver hot path: cross-attention of a small resident latent/query
-block against a long KV stream (blockwise over M so the input never fully
-materializes in VMEM), and latent self-attention — the TPU-native replacement
-for the reference's ``torch.nn.MultiheadAttention`` CUDA kernels
-(reference ``perceiver/model.py:66-74``).
+Covers the Perceiver hot path — attention of a small resident query block
+(latents or output queries) against a KV stream — as one fused kernel:
+QK^T, masking, online softmax, and PV accumulation never round-trip to HBM,
+and the KV sequence is streamed block-by-block so the input length M is never
+fully resident in VMEM (the blockwise cross-attention called for in
+SURVEY.md §5). This replaces the reference's ``torch.nn.MultiheadAttention``
+CUDA kernels (reference ``perceiver/model.py:66-74``).
+
+Design:
+
+- grid ``(B, H, S/S_blk)``; the KV axis is the innermost (sequential) grid
+  dimension, so the running max / denominator / PV accumulator live in VMEM
+  scratch across KV blocks (the standard TPU flash-attention recurrence).
+- logits and the accumulator are f32 regardless of input dtype; the P·V
+  matmul feeds the MXU in the input dtype with f32 accumulation.
+- padding (``pad_mask`` True = masked out) enters as a finite additive bias,
+  reproducing the XLA path's semantics including the fully-masked-row case
+  (uniform probabilities) without NaNs.
+- backward: ``jax.custom_vjp`` recomputing attention gradients with the XLA
+  einsum path (flash-style recompute-in-backward; the fused forward still
+  saves the HBM round-trips where inference/eval spend their time).
 
 Contract (enforced by the dispatcher in ``ops.attention``): no attention-prob
 dropout, optional key padding mask only.
@@ -12,22 +28,209 @@ dropout, optional key padding mask only.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 Array = jax.Array
 
+# Finite stand-in for -inf: exp() underflows to exactly 0 against any live
+# logit, while a fully-masked row still softmaxes to uniform (XLA-path parity).
+MASK_VALUE = -1e30
+# Bias for keys the kernel itself padded in: strictly below MASK_VALUE so that
+# even a fully-masked row's uniform softmax excludes them (exp(PAD - MASK) = 0).
+PAD_BIAS = 2.0 * MASK_VALUE
+
+_LANES = 128
+DEFAULT_KV_BLOCK = 512
+
+
+def _kv_block_size(s: int, requested: int, alignment: int) -> int:
+    """KV length to stream per grid step: a divisor of S, aligned to the TPU
+    tile constraint (Mosaic requires block dims to be lane/sublane multiples
+    or equal to the full array dim). Returns 0 when S must be padded instead."""
+    requested = max(requested - requested % alignment, alignment)
+    if s <= requested:
+        return s  # single block — full-dim blocks are always legal
+    best = 0
+    for cand in range(requested, alignment - 1, -alignment):
+        if s % cand == 0:
+            best = cand
+            break
+    # a tiny block (many grid steps) is worse than padding to a full block
+    return best if best * 2 >= requested else 0
+
+
+def _attention_kernel(bias_ref, q_ref, k_ref, v_ref, out_ref,
+                      m_ref, l_ref, acc_ref, *, scale: float):
+    s_idx = pl.program_id(2)
+
+    @pl.when(s_idx == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, MASK_VALUE)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0]  # (T, D)
+    k = k_ref[0, 0]  # (S_blk, D)
+    logits = jax.lax.dot_general(
+        q, k,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # (T, S_blk)
+    logits += bias_ref[0]  # (1, S_blk) broadcasts over T
+
+    m_prev = m_ref[:, :1]  # (T, 1)
+    l_prev = l_ref[:, :1]
+    m_cur = jnp.max(logits, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(logits - m_new)  # (T, S_blk)
+
+    l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0, 0],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # (T, D)
+    acc_ref[:] = acc_ref[:] * alpha + pv
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(s_idx == pl.num_programs(2) - 1)
+    def _finish():
+        out_ref[0, 0] = (acc_ref[:] / l_ref[:, :1]).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("s_blk", "interpret"))
+def _fused_attention_fwd_impl(
+    q: Array, k: Array, v: Array, bias: Array,
+    s_blk: int, interpret: bool,
+) -> Array:
+    """(B, H, T, D) q against (B, H, S, D) k/v with (B, S) additive bias.
+    ``s_blk`` must divide S (the wrapper guarantees it)."""
+    b, h, t, d = q.shape
+    s = k.shape[2]
+    scale = d**-0.5
+    grid = (b, h, s // s_blk)
+
+    bias = bias[:, None, :]  # (B, 1, S)
+    kernel = pl.pallas_call(
+        functools.partial(_attention_kernel, scale=scale),
+        grid=grid,
+        in_specs=[
+            # (B, 1, S) so the block's trailing dims satisfy TPU tiling
+            pl.BlockSpec((1, 1, s_blk), lambda bi, hi, si: (bi, 0, si)),
+            pl.BlockSpec((1, 1, t, d), lambda bi, hi, si: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s_blk, d), lambda bi, hi, si: (bi, hi, si, 0)),
+            pl.BlockSpec((1, 1, s_blk, d), lambda bi, hi, si: (bi, hi, si, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, t, d), lambda bi, hi, si: (bi, hi, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((t, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((t, _LANES), jnp.float32),  # running denominator
+            pltpu.VMEM((t, d), jnp.float32),  # PV accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            # batch/head grid steps are independent; only the KV axis carries
+            # the softmax recurrence
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )
+    return kernel(bias, q, k, v)
+
+
+def _reference_attention(q, k, v, bias):
+    """XLA attention over (B, H, T, D) — the backward-pass recompute.
+
+    Masking uses ``where`` on the (non-differentiable) mask recovered from the
+    bias, exactly like the production XLA path (``ops.attention``): masked
+    positions contribute zero gradient to q/k — in particular a fully padded
+    row yields dq = dk = 0, not gradients through its uniform softmax.
+    """
+    d = q.shape[-1]
+    logits = jnp.einsum(
+        "bhtd,bhsd->bhts", q * (d**-0.5), k, preferred_element_type=jnp.float32
+    )
+    masked = (bias < 0.5 * MASK_VALUE)[:, None, None, :]  # True = masked out
+    logits = jnp.where(masked, jnp.finfo(logits.dtype).min, logits)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhts,bhsd->bhtd", probs, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _fused_attention(q, k, v, bias, s_blk, interpret):
+    return _fused_attention_fwd_impl(q, k, v, bias, s_blk, interpret)
+
+
+def _fwd(q, k, v, bias, s_blk, interpret):
+    out = _fused_attention_fwd_impl(q, k, v, bias, s_blk, interpret)
+    return out, (q, k, v, bias)
+
+
+def _bwd(s_blk, interpret, residuals, g):
+    q, k, v, bias = residuals
+    _, vjp = jax.vjp(_reference_attention, q, k, v, bias)
+    dq, dk, dv, _ = vjp(g)
+    return dq, dk, dv, jnp.zeros_like(bias)
+
+
+_fused_attention.defvjp(_fwd, _bwd)
+
 
 def fused_attention(
-    q: Array, k: Array, v: Array, pad_mask: Optional[Array] = None
+    q: Array,
+    k: Array,
+    v: Array,
+    pad_mask: Optional[Array] = None,
+    kv_block_size: int = DEFAULT_KV_BLOCK,
+    interpret: Optional[bool] = None,
 ) -> Array:
     """Fused multi-head attention over (B, T, H, D) q and (B, S, H, D) k/v.
 
-    Not yet implemented — the XLA einsum path in ``ops.attention`` is the
-    current production path; use ``attn_impl='xla'``.
+    ``pad_mask``: optional (B, S) bool, True = key position masked out (the
+    torch ``key_padding_mask`` convention). Off-TPU backends run the kernel in
+    interpreter mode (slow — for tests), overridable via ``interpret``.
     """
-    raise NotImplementedError(
-        "The fused Pallas attention kernel has not landed yet; "
-        "construct modules with attn_impl='xla'."
-    )
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        raise ValueError(f"expected (B, T/S, H, D) tensors, got {q.shape=} {k.shape=}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    b, t, h, d = q.shape
+    s = k.shape[1]
+    if pad_mask is None:
+        bias = jnp.zeros((b, s), jnp.float32)
+    else:
+        bias = jnp.where(pad_mask, MASK_VALUE, 0.0).astype(jnp.float32)
+
+    # heads-major layout so each (b, h) grid step reads contiguous KV rows
+    q = jnp.transpose(q, (0, 2, 1, 3))
+    k = jnp.transpose(k, (0, 2, 1, 3))
+    v = jnp.transpose(v, (0, 2, 1, 3))
+
+    # Stream the KV axis in blocks. Compiled TPU blocks must be lane-aligned
+    # (a multiple of 128, or the full dim); when S has no aligned divisor, pad
+    # it up to a block multiple with PAD_BIAS keys (excluded from the softmax
+    # even on fully-masked rows).
+    alignment = 1 if interpret else _LANES
+    s_blk = _kv_block_size(s, kv_block_size, alignment)
+    if s_blk == 0:
+        if s <= 4 * kv_block_size:
+            s_blk = s  # full-dim blocks are always tiling-legal; skip padding
+        else:
+            block = max(kv_block_size - kv_block_size % alignment, alignment)
+            s_pad = -s % block
+            k = jnp.pad(k, ((0, 0), (0, 0), (0, s_pad), (0, 0)))
+            v = jnp.pad(v, ((0, 0), (0, 0), (0, s_pad), (0, 0)))
+            bias = jnp.pad(bias, ((0, 0), (0, s_pad)), constant_values=PAD_BIAS)
+            s_blk = block
+
+    out = _fused_attention(q, k, v, bias, s_blk, interpret)
+    return jnp.transpose(out, (0, 2, 1, 3))

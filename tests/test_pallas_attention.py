@@ -1,0 +1,154 @@
+"""Fused Pallas attention: parity vs the XLA einsum path (interpret mode on
+CPU; the same kernel compiles on TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.ops.attention import MultiHeadAttention, _dot_product_attention
+from perceiver_io_tpu.ops.pallas_attention import fused_attention
+
+
+def _rand(rng, *shape, dtype=jnp.float32):
+    return jnp.asarray(rng.normal(0, 1, shape), dtype=dtype)
+
+
+def _xla(q, k, v, pad_mask=None):
+    return _dot_product_attention(
+        q, k, v, pad_mask, None, 0.0, None, True
+    )
+
+
+@pytest.mark.parametrize("masked", [False, True])
+@pytest.mark.parametrize("t,s", [(16, 64), (8, 30)])
+def test_matches_xla_path(rng, masked, t, s):
+    b, h, d = 2, 2, 8
+    q, k, v = (_rand(rng, b, n, h, d) for n in (t, s, s))
+    pad_mask = jnp.asarray(rng.random((b, s)) < 0.3) if masked else None
+    out = fused_attention(q, k, v, pad_mask, kv_block_size=16)
+    ref = _xla(q, k, v, pad_mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_kv_streaming_multiblock(rng):
+    """Online softmax across many KV blocks equals single-pass softmax."""
+    b, t, s, h, d = 1, 4, 128, 1, 8
+    q, k, v = (_rand(rng, b, n, h, d) for n in (t, s, s))
+    blocked = fused_attention(q, k, v, kv_block_size=16)  # 8 blocks
+    single = fused_attention(q, k, v, kv_block_size=128)  # 1 block
+    ref = _xla(q, k, v)
+    np.testing.assert_allclose(np.asarray(blocked), np.asarray(ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(blocked), np.asarray(single), atol=1e-6)
+
+
+def test_padding_path(rng):
+    """S with no good divisor gets padded with masked keys — results equal."""
+    b, t, s, h, d = 2, 4, 17, 1, 8
+    q, k, v = (_rand(rng, b, n, h, d) for n in (t, s, s))
+    pad_mask = jnp.zeros((b, s), bool).at[:, -3:].set(True)
+    out = fused_attention(q, k, v, pad_mask, kv_block_size=4)  # pads 17 → 20
+    ref = _xla(q, k, v, pad_mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_padding_path_fully_masked_row(rng):
+    """Kernel-padded keys must stay excluded even when a row is fully masked
+    (the uniform softmax covers only the real S keys, as on the XLA path)."""
+    b, t, s, h, d = 1, 4, 17, 1, 8
+    q, k, v = (_rand(rng, b, n, h, d) for n in (t, s, s))
+    pad_mask = jnp.ones((b, s), bool)
+    out = fused_attention(q, k, v, pad_mask, kv_block_size=4)  # pads 17 → 20
+    ref = _xla(q, k, v, pad_mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_block_size_selection():
+    from perceiver_io_tpu.ops.pallas_attention import _kv_block_size
+
+    # TPU alignment: blocks must be multiples of 128 (or the full dim)
+    assert _kv_block_size(4096, 512, 128) == 512
+    assert _kv_block_size(512, 512, 128) == 512  # single full block
+    assert _kv_block_size(1000, 512, 128) == 0  # no aligned divisor → pad/full
+    assert _kv_block_size(1024, 768, 128) == 512  # largest aligned divisor
+    # interpret mode: any divisor goes
+    assert _kv_block_size(30, 16, 1) == 15
+    assert _kv_block_size(17, 16, 1) == 0
+
+
+def test_fully_masked_row_uniform(rng):
+    """A fully padded sequence softmaxes to uniform — XLA-path parity, no NaN."""
+    b, t, s, h, d = 2, 4, 8, 1, 4
+    q, k, v = (_rand(rng, b, n, h, d) for n in (t, s, s))
+    pad_mask = jnp.zeros((b, s), bool).at[0].set(True)  # row 0 fully masked
+    out = fused_attention(q, k, v, pad_mask, kv_block_size=8)
+    ref = _xla(q, k, v, pad_mask)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_bfloat16(rng):
+    b, t, s, h, d = 2, 8, 32, 2, 8
+    q, k, v = (_rand(rng, b, n, h, d, dtype=jnp.bfloat16) for n in (t, s, s))
+    out = fused_attention(q, k, v, kv_block_size=16)
+    ref = _xla(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=2e-2
+    )
+
+
+def test_gradients_match_xla(rng):
+    b, t, s, h, d = 2, 4, 32, 2, 8
+    q, k, v = (_rand(rng, b, n, h, d) for n in (t, s, s))
+    pad_mask = jnp.asarray(rng.random((b, s)) < 0.25)
+
+    def loss_fused(q, k, v):
+        return jnp.sum(fused_attention(q, k, v, pad_mask, kv_block_size=16) ** 2)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(_xla(q, k, v, pad_mask) ** 2)
+
+    g_fused = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+    g_xla = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for gf, gx in zip(g_fused, g_xla):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gx), atol=1e-5)
+
+
+def test_fully_masked_row_zero_qk_grads(rng):
+    """XLA-path parity: a fully padded sequence contributes no q/k gradient
+    (masking is where-style, not a differentiable additive bias)."""
+    b, t, s, h, d = 2, 4, 8, 1, 4
+    q, k, v = (_rand(rng, b, n, h, d) for n in (t, s, s))
+    pad_mask = jnp.zeros((b, s), bool).at[0].set(True)  # batch row 0 fully masked
+
+    def loss(q, k, v):
+        return jnp.sum(fused_attention(q, k, v, pad_mask, kv_block_size=8) ** 2)
+
+    dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    ref = jax.grad(lambda q, k, v: jnp.sum(_xla(q, k, v, pad_mask) ** 2),
+                   argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(dq[0]), 0.0, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(dk[0]), 0.0, atol=1e-7)
+    for g, gr in zip((dq, dk, dv), ref):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gr), atol=1e-5)
+
+
+def test_module_dispatch_parity(rng):
+    """MultiHeadAttention(attn_impl='pallas') == attn_impl='xla' with the same
+    params (the production dispatch path, reference ``model.py:66-74``)."""
+    b, t, s = 2, 8, 24
+    x_q = _rand(rng, b, t, 16)
+    x_kv = _rand(rng, b, s, 12)
+    pad_mask = jnp.asarray(rng.random((b, s)) < 0.2)
+
+    mha_xla = MultiHeadAttention(num_q_channels=16, num_kv_channels=12, num_heads=4)
+    mha_pallas = MultiHeadAttention(
+        num_q_channels=16, num_kv_channels=12, num_heads=4, attn_impl="pallas"
+    )
+    params = mha_xla.init(jax.random.key(0), x_q, x_kv)["params"]
+    out_xla = mha_xla.apply({"params": params}, x_q, x_kv, pad_mask=pad_mask)
+    out_pallas = mha_pallas.apply({"params": params}, x_q, x_kv, pad_mask=pad_mask)
+    np.testing.assert_allclose(
+        np.asarray(out_pallas), np.asarray(out_xla), atol=1e-5
+    )
