@@ -22,6 +22,11 @@ from perceiver_io_tpu.models.adapters import (
     ClassificationOutputAdapter,
     TextOutputAdapter,
 )
+from perceiver_io_tpu.models.flow import (
+    DenseSpatialOutputAdapter,
+    OpticalFlowInputAdapter,
+    build_optical_flow_model,
+)
 from perceiver_io_tpu.models.perceiver import (
     PerceiverEncoder,
     PerceiverDecoder,
@@ -33,6 +38,9 @@ from perceiver_io_tpu.ops.masking import TextMasking
 __version__ = "0.1.0"
 
 __all__ = [
+    "DenseSpatialOutputAdapter",
+    "OpticalFlowInputAdapter",
+    "build_optical_flow_model",
     "InputAdapter",
     "OutputAdapter",
     "ImageInputAdapter",

@@ -133,7 +133,14 @@ def optimizer_from_args(args):
 
 
 def mesh_from_args(args):
-    return make_mesh(dp=args.dp, tp=args.tp, sp=args.sp)
+    mesh = make_mesh(dp=args.dp, tp=args.tp, sp=args.sp)
+    dp = mesh.shape["data"]
+    if args.batch_size % dp != 0:
+        raise SystemExit(
+            f"batch_size {args.batch_size} must be divisible by the data-"
+            f"parallel mesh axis ({dp}); pass --batch_size or --dp/--tp/--sp"
+        )
+    return mesh
 
 
 def build_text_encoder(args, vocab_size: int, max_seq_len: int) -> pit.PerceiverEncoder:

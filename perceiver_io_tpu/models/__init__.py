@@ -6,6 +6,12 @@ from perceiver_io_tpu.models.adapters import (
     ClassificationOutputAdapter,
     TextOutputAdapter,
 )
+from perceiver_io_tpu.models.flow import (
+    DenseSpatialOutputAdapter,
+    OpticalFlowInputAdapter,
+    build_optical_flow_model,
+    end_point_error,
+)
 from perceiver_io_tpu.models.perceiver import (
     PerceiverEncoder,
     PerceiverDecoder,
@@ -14,6 +20,10 @@ from perceiver_io_tpu.models.perceiver import (
 )
 
 __all__ = [
+    "DenseSpatialOutputAdapter",
+    "OpticalFlowInputAdapter",
+    "build_optical_flow_model",
+    "end_point_error",
     "InputAdapter",
     "OutputAdapter",
     "ImageInputAdapter",

@@ -1,0 +1,199 @@
+"""Optical-flow adapters: frame-pair input, dense per-pixel output queries.
+
+The reference repo implements no optical flow; these adapters cover the
+Perceiver IO paper's flow task (BASELINE.md's Sintel config) and double as the
+proof that the injected-adapter contract (reference ``perceiver/adapter.py:9-32``)
+generalizes to dense 2D outputs:
+
+- ``OpticalFlowInputAdapter``: a frame pair (B, 2, H, W, C) becomes one token
+  per pixel carrying both frames' local patch context (k×k neighborhood,
+  extracted with static shifted slices XLA folds into gathers) plus Fourier
+  position encodings — the paper's per-pixel patch featurization.
+- ``DenseSpatialOutputAdapter``: one decoder query per output pixel,
+  ``output_shape = (H·W, C)``; a linear head maps decoder output to
+  ``num_output_features`` per pixel, reshaped to (B, H, W, F). For flow,
+  F = 2 (dx, dy). Queries are learned arrays, consistent with this
+  framework's decoder (reference ``model.py:222``).
+
+Both compose with the unchanged ``PerceiverEncoder``/``PerceiverDecoder``;
+``build_optical_flow_model`` assembles the full model.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from perceiver_io_tpu.models.adapters import InputAdapter, OutputAdapter
+from perceiver_io_tpu.ops.attention import (
+    torch_linear_bias_init,
+    torch_linear_kernel_init,
+)
+from perceiver_io_tpu.ops.fourier import (
+    fourier_position_encodings,
+    num_position_encoding_channels,
+    spatial_positions,
+)
+
+Array = jax.Array
+
+
+def extract_patches(x: Array, patch_size: int) -> Array:
+    """Per-pixel k×k neighborhoods: (..., H, W, C) → (..., H, W, k*k*C).
+
+    Zero-padded at the borders. Implemented as static shifted slices of one
+    padded array — XLA fuses these into cheap strided reads (no gather op).
+    """
+    if patch_size % 2 != 1:
+        raise ValueError(f"patch_size must be odd, got {patch_size}")
+    r = patch_size // 2
+    *lead, h, w, c = x.shape
+    pad = [(0, 0)] * len(lead) + [(r, r), (r, r), (0, 0)]
+    xp = jnp.pad(x, pad)
+    shifts = [
+        xp[..., i : i + h, j : j + w, :]
+        for i in range(patch_size)
+        for j in range(patch_size)
+    ]
+    return jnp.concatenate(shifts, axis=-1)
+
+
+class OpticalFlowInputAdapter(InputAdapter):
+    """Frame pair → per-pixel patch features + Fourier position encodings.
+
+    Input: (B, 2, H, W, C) — two frames stacked on axis 1. Output:
+    (B, H·W, 2·k²·C + pos_channels).
+    """
+
+    image_shape: Tuple[int, int, int] = (368, 496, 3)  # (H, W, C)
+    patch_size: int = 3
+    num_frequency_bands: int = 64
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def spatial_shape(self) -> Tuple[int, int]:
+        return self.image_shape[:2]
+
+    @property
+    def num_patch_channels(self) -> int:
+        return 2 * self.patch_size**2 * self.image_shape[-1]
+
+    @property
+    def num_input_channels(self) -> int:
+        return self.num_patch_channels + num_position_encoding_channels(
+            2, self.num_frequency_bands
+        )
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b, *rest = x.shape
+        if tuple(rest) != (2, *self.image_shape):
+            raise ValueError(
+                f"Input shape {tuple(rest)} != required (2, *{self.image_shape})"
+            )
+        h, w, _ = self.image_shape
+
+        patches = extract_patches(x.astype(self.dtype), self.patch_size)
+        # both frames' patches side by side per pixel: (B, H, W, 2*k²*C)
+        patches = jnp.moveaxis(patches, 1, -2).reshape(
+            b, h, w, self.num_patch_channels
+        )
+
+        pos = spatial_positions((h, w))
+        enc = fourier_position_encodings(pos, self.num_frequency_bands)
+        enc = jnp.broadcast_to(enc.astype(self.dtype), (b, *enc.shape))
+        out = jnp.concatenate([patches, enc], axis=-1)
+        return out.reshape(b, h * w, self.num_input_channels)
+
+
+class DenseSpatialOutputAdapter(OutputAdapter):
+    """One decoder query per output pixel; linear head to F features/pixel.
+
+    ``output_shape = (H·W, num_output_channels)`` sizes the decoder's learned
+    query array; the head maps to (B, H, W, num_output_features).
+    """
+
+    spatial_shape: Tuple[int, int] = (368, 496)
+    num_output_features: int = 2  # optical flow: (dx, dy)
+    num_output_channels: int = 64
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def output_shape(self) -> Tuple[int, int]:
+        h, w = self.spatial_shape
+        return (h * w, self.num_output_channels)
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        b = x.shape[0]
+        x = nn.Dense(
+            self.num_output_features,
+            dtype=self.dtype,
+            kernel_init=torch_linear_kernel_init,
+            bias_init=torch_linear_bias_init(self.num_output_channels),
+            name="linear",
+        )(x)
+        h, w = self.spatial_shape
+        return x.reshape(b, h, w, self.num_output_features)
+
+
+def build_optical_flow_model(
+    image_shape: Tuple[int, int, int] = (368, 496, 3),
+    latent_shape: Tuple[int, int] = (2048, 512),
+    num_layers: int = 1,
+    num_self_attention_layers_per_block: int = 24,
+    num_cross_attention_heads: int = 1,
+    num_self_attention_heads: int = 8,
+    patch_size: int = 3,
+    num_frequency_bands: int = 64,
+    dtype: jnp.dtype = jnp.float32,
+    attn_impl: str = "xla",
+    remat: bool = False,
+):
+    """PerceiverIO for optical flow (defaults sized after the Perceiver IO
+    paper's flow configuration; shrink everything for tests)."""
+    from perceiver_io_tpu.models.perceiver import (
+        PerceiverDecoder,
+        PerceiverEncoder,
+        PerceiverIO,
+    )
+
+    h, w, _ = image_shape
+    return PerceiverIO(
+        encoder=PerceiverEncoder(
+            input_adapter=OpticalFlowInputAdapter(
+                image_shape=image_shape,
+                patch_size=patch_size,
+                num_frequency_bands=num_frequency_bands,
+                dtype=dtype,
+            ),
+            latent_shape=latent_shape,
+            num_layers=num_layers,
+            num_cross_attention_heads=num_cross_attention_heads,
+            num_self_attention_heads=num_self_attention_heads,
+            num_self_attention_layers_per_block=num_self_attention_layers_per_block,
+            dtype=dtype,
+            attn_impl=attn_impl,
+            remat=remat,
+        ),
+        decoder=PerceiverDecoder(
+            output_adapter=DenseSpatialOutputAdapter(
+                spatial_shape=(h, w),
+                num_output_features=2,
+                num_output_channels=latent_shape[1],
+                dtype=dtype,
+            ),
+            latent_shape=latent_shape,
+            num_cross_attention_heads=num_cross_attention_heads,
+            dtype=dtype,
+            attn_impl=attn_impl,
+        ),
+    )
+
+
+def end_point_error(pred: Array, target: Array) -> Array:
+    """Mean Euclidean end-point error — the standard optical-flow metric."""
+    return jnp.mean(jnp.linalg.norm(pred - target, axis=-1))

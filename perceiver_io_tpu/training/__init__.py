@@ -7,6 +7,7 @@ from perceiver_io_tpu.training.train_state import TrainState
 from perceiver_io_tpu.training.steps import (
     make_mlm_steps,
     make_classifier_steps,
+    make_flow_steps,
     freeze_subtrees,
 )
 from perceiver_io_tpu.training.checkpoint import (
@@ -37,5 +38,6 @@ __all__ = [
     "TrainState",
     "make_mlm_steps",
     "make_classifier_steps",
+    "make_flow_steps",
     "freeze_subtrees",
 ]

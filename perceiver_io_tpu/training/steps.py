@@ -149,3 +149,28 @@ def make_classifier_steps(
         return {"loss": loss, "acc": acc}
 
     return train_step, eval_step
+
+
+def make_flow_steps(model, schedule: Optional[Schedule] = None):
+    """(train_step, eval_step) for an optical-flow ``PerceiverIO`` (dense
+    2D-query decoder): batches ``{'frames': (B, 2, H, W, C), 'flow':
+    (B, H, W, 2)}``, loss = mean end-point error."""
+    from perceiver_io_tpu.models.flow import end_point_error
+
+    def loss_fn(params, batch, rngs, deterministic):
+        pred = model.apply(
+            {"params": params}, batch["frames"], rngs=rngs,
+            deterministic=deterministic,
+        )
+        return end_point_error(pred, batch["flow"])
+
+    def train_step(state: TrainState, batch) -> Tuple[TrainState, Metrics]:
+        rngs = state.step_rngs("dropout")
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch, rngs, False)
+        metrics = {"loss": loss, **_lr_metric(schedule, state.step)}
+        return state.apply_gradients(grads), metrics
+
+    def eval_step(state: TrainState, batch) -> Metrics:
+        return {"loss": loss_fn(state.params, batch, {}, True)}
+
+    return train_step, eval_step

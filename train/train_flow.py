@@ -1,0 +1,12 @@
+#!/usr/bin/env python
+"""Optical-flow training (framework extension; Sintel layout or synthetic)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from perceiver_io_tpu.cli.train_flow import main
+
+if __name__ == "__main__":
+    main()
