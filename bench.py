@@ -11,7 +11,8 @@ The reference publishes no throughput numbers (BASELINE.md), so
 
 Env knobs: PIT_BENCH_CPU=1 forces CPU; PIT_BENCH_STEPS / PIT_BENCH_BATCH
 override defaults; PIT_BENCH_ATTN selects the attention impl
-('xla' | 'pallas', default 'pallas' on TPU).
+('xla' | 'pallas', default 'xla' — measured faster at these skinny head dims);
+PIT_BENCH_GATHER sets the masked-decode capacity (-1 auto, 0 full decode).
 """
 
 from __future__ import annotations
@@ -38,6 +39,7 @@ def main() -> None:
         TrainState,
         make_mlm_steps,
         make_optimizer,
+        mlm_gather_capacity,
     )
 
     vocab, seq_len = 10003, 512
@@ -45,11 +47,15 @@ def main() -> None:
     batch_size = int(os.environ.get("PIT_BENCH_BATCH", "64"))
     steps = int(os.environ.get("PIT_BENCH_STEPS", "20"))
     compute_dtype = jnp.bfloat16
-    attn_impl = os.environ.get(
-        "PIT_BENCH_ATTN", "pallas" if jax.default_backend() == "tpu" else "xla"
-    )
+    attn_impl = os.environ.get("PIT_BENCH_ATTN", "xla")
     if attn_impl not in ("xla", "pallas"):
         raise SystemExit(f"PIT_BENCH_ATTN must be 'xla' or 'pallas', got {attn_impl!r}")
+    # Full decode by default: at this vocab/seq the gathered decode is
+    # wall-time-neutral on v5e (XLA fuses the CE; the win is memory, not time),
+    # so the bench measures the reference-shaped full step. -1 = auto capacity.
+    gather = int(os.environ.get("PIT_BENCH_GATHER", "0"))
+    if gather < 0:
+        gather = mlm_gather_capacity(seq_len)
 
     latent_shape = (num_latents, channels)
     model = pit.PerceiverMLM(
@@ -90,7 +96,7 @@ def main() -> None:
     )
     tx, schedule = make_optimizer(OptimizerConfig(learning_rate=1e-3))
     state = TrainState.create(variables["params"], tx, jax.random.key(2))
-    train_step, _, _ = make_mlm_steps(model, schedule)
+    train_step, _, _ = make_mlm_steps(model, schedule, loss_gather_capacity=gather or None)
     step = jax.jit(train_step, donate_argnums=(0,))
 
     # warmup / compile

@@ -75,6 +75,7 @@ def main(argv: Optional[Sequence[str]] = None):
         num_self_attention_heads=args.num_self_attention_heads,
         patch_size=args.patch_size,
         num_frequency_bands=args.num_frequency_bands,
+        dropout=args.dropout,
         dtype=common.DTYPES[args.dtype],
         attn_impl=args.attn_impl,
         remat=args.remat,

@@ -23,7 +23,7 @@ import numpy as np
 from perceiver_io_tpu.cli import common
 from perceiver_io_tpu.data.imdb import IMDBDataModule
 from perceiver_io_tpu.data.tokenizer import MASK_TOKEN
-from perceiver_io_tpu.training import TrainState, make_mlm_steps
+from perceiver_io_tpu.training import TrainState, make_mlm_steps, mlm_gather_capacity
 from perceiver_io_tpu.training.trainer import Trainer
 
 DEFAULT_PREDICT_SAMPLES = (
@@ -44,6 +44,10 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--num_predictions", type=int, default=5,
                    help="top-k predictions logged per [MASK] position")
     g.add_argument("--predict_samples", nargs="*", default=list(DEFAULT_PREDICT_SAMPLES))
+    g.add_argument("--loss_gather_capacity", type=int, default=-1,
+                   help="decode only the masked positions, up to this many per "
+                        "row (gradient-equivalent, skips most vocab-projection "
+                        "FLOPs). -1 = auto (2·mask_p·seq_len), 0 = full decode")
     # reference per-task defaults (train_mlm.py:93-106)
     parser.set_defaults(experiment="mlm", batch_size=64, num_latents=64,
                         num_latent_channels=64, num_encoder_layers=3)
@@ -129,7 +133,12 @@ def main(argv: Optional[Sequence[str]] = None):
     tx, schedule = common.optimizer_from_args(args)
     state = TrainState.create(variables["params"], tx, jax.random.key(args.seed + 2))
 
-    train_step, eval_step, predict_fn = make_mlm_steps(model, schedule)
+    capacity = args.loss_gather_capacity
+    if capacity < 0:
+        capacity = mlm_gather_capacity(args.max_seq_len)
+    train_step, eval_step, predict_fn = make_mlm_steps(
+        model, schedule, loss_gather_capacity=capacity or None
+    )
     mesh = common.mesh_from_args(args)
 
     trainer = Trainer(

@@ -127,11 +127,16 @@ def load_sintel(
             f"no Sintel scenes under {root}/training/{split} — place the "
             "MPI-Sintel tree there, or use synthetic=True"
         )
+    split_dir = os.path.join(root, "training", split)
+    flow_dir = os.path.join(root, "training", "flow")
     for scene in scenes:
         pngs = sorted(glob.glob(os.path.join(scene, "frame_*.png")))
         for first, second in zip(pngs, pngs[1:]):
-            flo = first.replace(f"{os.sep}{split}{os.sep}", f"{os.sep}flow{os.sep}")
-            flo = flo[: -len(".png")] + ".flo"
+            # map <root>/training/<split>/<scene>/frame_X.png to the flow tree
+            # by relative path, so a root that itself contains '/clean/' or
+            # '/flow/' segments can't corrupt the substitution
+            rel = os.path.relpath(first, split_dir)
+            flo = os.path.join(flow_dir, rel[: -len(".png")] + ".flo")
             if not os.path.exists(flo):
                 continue
             img1 = np.asarray(Image.open(first), np.float32) / 255.0
@@ -144,6 +149,12 @@ def load_sintel(
             sl = np.s_[top : top + h, left : left + w]
             frames_list.append(np.stack([img1[sl], img2[sl]]))
             flows_list.append(flow[sl])
+    if not frames_list:
+        raise FileNotFoundError(
+            f"no usable Sintel pairs under {split_dir}: every frame pair was "
+            f"skipped (missing .flo under {flow_dir}, or source frames smaller "
+            f"than the requested {h}x{w} crop)"
+        )
     return np.stack(frames_list), np.stack(flows_list)
 
 
@@ -192,6 +203,11 @@ class FlowDataModule:
                 os.path.join(self.root, "Sintel"), self.image_shape
             )
             val = max(len(frames) // 10, 1)
+        if len(frames) < 2:
+            raise ValueError(
+                f"need at least 2 flow pairs to split train/val, got {len(frames)}"
+            )
+        val = min(val, len(frames) - 1)  # keep the training set non-empty
         split = len(frames) - val
         self.ds_train = FlowDataset(frames[:split], flows[:split])
         self.ds_valid = FlowDataset(frames[split:], flows[split:])

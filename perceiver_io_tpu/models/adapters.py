@@ -177,7 +177,10 @@ class ClassificationOutputAdapter(OutputAdapter):
             bias_init=torch_linear_bias_init(c_in),
             name="linear",
         )(x)
-        if x.shape[1] == 1:
+        # Squeeze on the CONFIGURED query count, not the runtime shape: a
+        # positions-gathered decode (PerceiverDecoder positions=...) may pass
+        # K=1 rows of a multi-query adapter, which must stay (B, 1, C).
+        if self.num_outputs == 1 and x.shape[1] == 1:
             x = jnp.squeeze(x, axis=1)
         return x
 

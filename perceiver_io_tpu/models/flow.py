@@ -149,6 +149,7 @@ def build_optical_flow_model(
     num_self_attention_heads: int = 8,
     patch_size: int = 3,
     num_frequency_bands: int = 64,
+    dropout: float = 0.0,
     dtype: jnp.dtype = jnp.float32,
     attn_impl: str = "xla",
     remat: bool = False,
@@ -175,6 +176,7 @@ def build_optical_flow_model(
             num_cross_attention_heads=num_cross_attention_heads,
             num_self_attention_heads=num_self_attention_heads,
             num_self_attention_layers_per_block=num_self_attention_layers_per_block,
+            dropout=dropout,
             dtype=dtype,
             attn_impl=attn_impl,
             remat=remat,
@@ -188,6 +190,7 @@ def build_optical_flow_model(
             ),
             latent_shape=latent_shape,
             num_cross_attention_heads=num_cross_attention_heads,
+            dropout=dropout,
             dtype=dtype,
             attn_impl=attn_impl,
         ),

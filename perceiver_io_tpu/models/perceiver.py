@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from perceiver_io_tpu.ops.attention import CrossAttentionLayer, SelfAttentionBlock
-from perceiver_io_tpu.ops.masking import TextMasking
+from perceiver_io_tpu.ops.masking import IGNORE_LABEL, TextMasking
 
 Array = jax.Array
 
@@ -154,7 +154,16 @@ class PerceiverDecoder(nn.Module):
     attn_impl: str = "xla"
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=True, positions: Optional[Array] = None):
+        """``positions``: optional (B, K) int — decode only these rows of the
+        learned output-query array. Each output query attends to the latents
+        independently (no query-query interaction anywhere in the decoder), so
+        decoding a subset is exactly the corresponding rows of the full decode.
+        This is the TPU-first answer to the reference's decoder memory hot spot
+        (the (B, 512, vocab) logits, SURVEY.md §3.1): callers that only need a
+        few positions (e.g. the ~15% masked MLM positions) skip the dominant
+        vocab-projection FLOPs for the rest.
+        """
         b, *d = x.shape
         if tuple(d) != tuple(self.latent_shape):
             raise ValueError(
@@ -164,7 +173,11 @@ class PerceiverDecoder(nn.Module):
 
         output_shape = self.output_adapter.output_shape
         output = self.param("output", latent_init(), tuple(output_shape))
-        x_output = jnp.broadcast_to(output.astype(self.dtype), (b, *output_shape))
+        if positions is not None:
+            # (B, K, C): per-batch rows of the learned query array
+            x_output = jnp.take(output, positions, axis=0).astype(self.dtype)
+        else:
+            x_output = jnp.broadcast_to(output.astype(self.dtype), (b, *output_shape))
 
         x_output = CrossAttentionLayer(
             num_q_channels=output_shape[-1],
@@ -215,7 +228,20 @@ class PerceiverMLM(nn.Module):
         pad_mask: Optional[Array] = None,
         masking: bool = True,
         deterministic: bool = True,
+        loss_gather_capacity: Optional[int] = None,
     ) -> Tuple[Array, Optional[Array]]:
+        """``loss_gather_capacity``: when set (and ``masking=True``), decode
+        only the masked positions — up to that many per row — instead of all L.
+
+        CE ignores label-(-100) positions entirely, and un-decoded output
+        queries receive zero gradient in the full computation too (their logits
+        never touch the loss), so loss AND gradients are bit-equivalent to the
+        full decode as long as no row has more masked positions than the
+        capacity (use ≥ 2·mask_p·L; overflow odds are negligible — at the
+        reference config, Binomial(512, 0.15) > 154 is a >13σ event). Skips
+        ~(1 − K/L) of the vocab-projection FLOPs, the step's dominant matmul
+        (SURVEY.md §3.1 hot spots).
+        """
         _, l = x_input.shape
 
         if masking:
@@ -226,5 +252,17 @@ class PerceiverMLM(nn.Module):
             x_labels = None
 
         x_latent = self.encoder(x_masked, pad_mask=pad_mask, deterministic=deterministic)
+
+        if masking and loss_gather_capacity is not None and loss_gather_capacity < l:
+            # First-K masked indices per row (lax.top_k is index-stable), then
+            # earliest unmasked indices; the latter carry label -100 already,
+            # so gathered labels mark the padding slots ignored for free.
+            valid = (x_labels != IGNORE_LABEL).astype(jnp.float32)
+            _, positions = jax.lax.top_k(valid, loss_gather_capacity)
+            x_logits = self.decoder(
+                x_latent, deterministic=deterministic, positions=positions
+            )
+            return x_logits, jnp.take_along_axis(x_labels, positions, axis=1)
+
         x_logits = self.decoder(x_latent, deterministic=deterministic)[:, :l, :]
         return x_logits, x_labels

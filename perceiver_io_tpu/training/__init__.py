@@ -9,6 +9,7 @@ from perceiver_io_tpu.training.steps import (
     make_classifier_steps,
     make_flow_steps,
     freeze_subtrees,
+    mlm_gather_capacity,
 )
 from perceiver_io_tpu.training.checkpoint import (
     CheckpointManager,
@@ -37,6 +38,7 @@ __all__ = [
     "make_optimizer",
     "TrainState",
     "make_mlm_steps",
+    "mlm_gather_capacity",
     "make_classifier_steps",
     "make_flow_steps",
     "freeze_subtrees",

@@ -58,7 +58,19 @@ def _lr_metric(schedule: Optional[Schedule], step: Array) -> dict:
     return {} if schedule is None else {"lr": schedule(step)}
 
 
-def make_mlm_steps(model, schedule: Optional[Schedule] = None):
+def mlm_gather_capacity(seq_len: int, mask_p: float = 0.15) -> int:
+    """Default masked-decode capacity: 2·mask_p·L rounded up to a multiple of
+    32 (sublane-friendly), capped at L. At 2× the expected masked count the
+    odds of a row overflowing are negligible (>13σ at the reference config)."""
+    cap = -(-int(2 * mask_p * seq_len) // 32) * 32
+    return min(seq_len, max(cap, 32))
+
+
+def make_mlm_steps(
+    model,
+    schedule: Optional[Schedule] = None,
+    loss_gather_capacity: Optional[int] = None,
+):
     """(train_step, eval_step, predict_fn) for a ``PerceiverMLM``.
 
     - train: masking RNG + dropout, CE over selected positions
@@ -67,6 +79,11 @@ def make_mlm_steps(model, schedule: Optional[Schedule] = None):
       corrupted inputs, as in the reference), dropout off.
     - predict: ``masking=False`` forward returning logits — the
       ``predict_samples`` path (reference ``train_mlm.py:14-35``).
+
+    ``loss_gather_capacity``: decode only the masked positions (up to this many
+    per row) in train/eval — gradient-equivalent to the full decode but skips
+    most of the dominant vocab-projection FLOPs (see ``PerceiverMLM``). The
+    predict path always decodes every position.
     """
 
     def loss_fn(params, batch, rngs, deterministic):
@@ -76,6 +93,7 @@ def make_mlm_steps(model, schedule: Optional[Schedule] = None):
             batch["pad_mask"],
             rngs=rngs,
             deterministic=deterministic,
+            loss_gather_capacity=loss_gather_capacity,
         )
         return cross_entropy_with_ignore(logits, labels)
 

@@ -1,0 +1,117 @@
+"""Profiling helper: MFU + per-impl timing for the flagship MLM step.
+
+Not part of the library API — a developer tool. Computes compiled-graph FLOPs
+via XLA cost analysis and reports model FLOPs utilisation against the chip's
+peak, for each attention impl.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import perceiver_io_tpu as pit
+from perceiver_io_tpu.ops.masking import TextMasking
+from perceiver_io_tpu.training import (
+    OptimizerConfig,
+    TrainState,
+    make_mlm_steps,
+    make_optimizer,
+    mlm_gather_capacity,
+)
+
+# bf16 peak FLOP/s per chip
+PEAK = {
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,
+}
+
+
+def peak_flops() -> float:
+    kind = jax.devices()[0].device_kind
+    for name, val in PEAK.items():
+        if kind.startswith(name):
+            return val
+    return 197e12
+
+
+def build(attn_impl: str, vocab=10003, seq_len=512, num_latents=256, channels=64):
+    latent_shape = (num_latents, channels)
+    return pit.PerceiverMLM(
+        encoder=pit.PerceiverEncoder(
+            input_adapter=pit.TextInputAdapter(
+                vocab_size=vocab, max_seq_len=seq_len, num_channels=channels,
+                dtype=jnp.bfloat16,
+            ),
+            latent_shape=latent_shape,
+            num_layers=3,
+            num_self_attention_layers_per_block=6,
+            dtype=jnp.bfloat16,
+            attn_impl=attn_impl,
+        ),
+        decoder=pit.PerceiverDecoder(
+            output_adapter=pit.TextOutputAdapter(
+                vocab_size=vocab, max_seq_len=seq_len, num_output_channels=channels,
+                dtype=jnp.bfloat16,
+            ),
+            latent_shape=latent_shape,
+            dtype=jnp.bfloat16,
+            attn_impl=attn_impl,
+        ),
+        masking=TextMasking(vocab_size=vocab, unk_token_id=1, mask_token_id=2,
+                            num_special_tokens=3),
+    )
+
+
+def run(attn_impl: str, batch_size=64, steps=20, gather=None):
+    model = build(attn_impl)
+    rng = np.random.default_rng(0)
+    batch = {
+        "token_ids": jnp.asarray(rng.integers(3, 10003, (batch_size, 512)).astype(np.int32)),
+        "pad_mask": jnp.zeros((batch_size, 512), dtype=bool),
+    }
+    variables = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        batch["token_ids"], batch["pad_mask"],
+    )
+    tx, schedule = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    state = TrainState.create(variables["params"], tx, jax.random.key(2))
+    train_step, _, _ = make_mlm_steps(model, schedule, loss_gather_capacity=gather)
+    step = jax.jit(train_step, donate_argnums=(0,))
+
+    lowered = step.lower(state, batch)
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis()
+    flops = cost.get("flops", 0.0) if cost else 0.0
+
+    for _ in range(3):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = (time.perf_counter() - t0) / steps
+
+    toks = batch_size * 512 / dt
+    mfu = flops / dt / peak_flops()
+    tag = f"{attn_impl}+g{gather}" if gather else attn_impl
+    print(f"{tag:12s} step {dt*1e3:7.2f} ms  {toks/1e6:6.2f} Mtok/s  "
+          f"flops/step {flops/1e9:.1f} G  MFU {mfu*100:.1f}%")
+
+
+if __name__ == "__main__":
+    print(f"device: {jax.devices()[0].device_kind}, peak {peak_flops()/1e12:.0f} TF/s")
+    cap = mlm_gather_capacity(512)
+    for impl in ("xla", "pallas"):
+        run(impl)
+        run(impl, gather=cap)
