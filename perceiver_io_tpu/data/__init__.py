@@ -23,6 +23,12 @@ from perceiver_io_tpu.data.mnist import (
     load_mnist,
     synthetic_digits,
 )
+from perceiver_io_tpu.data.imagefolder import (
+    ImageFolderDataModule,
+    ImageFolderDataset,
+    SyntheticImageDataset,
+    list_image_folder,
+)
 
 __all__ = [
     "PAD_TOKEN",
@@ -45,4 +51,8 @@ __all__ = [
     "MNISTDataset",
     "load_mnist",
     "synthetic_digits",
+    "ImageFolderDataModule",
+    "ImageFolderDataset",
+    "SyntheticImageDataset",
+    "list_image_folder",
 ]

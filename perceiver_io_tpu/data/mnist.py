@@ -20,7 +20,7 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-from perceiver_io_tpu.data.pipeline import DataLoader
+from perceiver_io_tpu.data.pipeline import DataLoader, image_label_collate
 
 _FILES = {
     "train_images": "train-images-idx3-ubyte",
@@ -130,12 +130,6 @@ class MNISTDataset:
         return img[..., None], int(self.labels[i])
 
 
-def _collate(batch) -> Dict[str, np.ndarray]:
-    images = np.stack([img for img, _ in batch])
-    labels = np.asarray([y for _, y in batch], dtype=np.int32)
-    return {"image": images, "label": labels}
-
-
 class MNISTDataModule:
     """create/setup/loader surface mirroring the reference module
     (``data/mnist.py:17-82``): val_split=10000, Normalize(0.5, 0.5),
@@ -209,7 +203,7 @@ class MNISTDataModule:
         return DataLoader(
             self.ds_train,
             batch_size=self.batch_size,
-            collate=_collate,
+            collate=image_label_collate,
             shuffle=True,
             seed=self.seed,
             shard_id=self.shard_id,
@@ -220,7 +214,7 @@ class MNISTDataModule:
         return DataLoader(
             self.ds_valid,
             batch_size=self.batch_size,
-            collate=_collate,
+            collate=image_label_collate,
             shuffle=False,
             # evaluate the full set when single-host (multi-host must drop for
             # lockstep collectives)

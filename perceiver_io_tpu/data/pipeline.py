@@ -19,11 +19,20 @@ from __future__ import annotations
 
 import queue
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, Iterator, Optional, Sequence
 
 import numpy as np
 
 Batch = Dict[str, np.ndarray]
+
+
+def image_label_collate(batch) -> Batch:
+    """(image, label) examples → {'image': (B, ...), 'label': (B,) int32} —
+    the classifier step-function contract, shared by the image data modules."""
+    images = np.stack([img for img, _ in batch])
+    labels = np.asarray([y for _, y in batch], dtype=np.int32)
+    return {"image": images, "label": labels}
 
 
 class DataLoader:
@@ -44,6 +53,7 @@ class DataLoader:
         shard_id: int = 0,
         num_shards: int = 1,
         prefetch: int = 2,
+        num_workers: int = 0,
     ):
         if not (0 <= shard_id < num_shards):
             raise ValueError(f"shard_id {shard_id} out of range for {num_shards} shards")
@@ -64,6 +74,11 @@ class DataLoader:
         self.shard_id = shard_id
         self.num_shards = num_shards
         self.prefetch = prefetch
+        # Decode pool for datasets whose __getitem__ is expensive (JPEG
+        # decode + resize for ImageNet-scale folders). Threads, not processes:
+        # PIL/numpy release the GIL in the hot parts, and threads share the
+        # dataset's page cache / mmap state for free.
+        self.num_workers = num_workers
         self.epoch = 0
 
     def __len__(self) -> int:
@@ -88,13 +103,26 @@ class DataLoader:
         n = len(idx)
         per_shard = self.batch_size // self.num_shards
         stop = n - self.batch_size + 1 if self.drop_last else n
-        for start in range(0, max(stop, 0), self.batch_size):
-            batch_idx = idx[start : start + self.batch_size]
-            # this host's contiguous slice of the global batch
-            local = batch_idx[self.shard_id * per_shard : (self.shard_id + 1) * per_shard]
-            if len(local) == 0:
-                continue
-            yield self.collate([self.dataset[int(i)] for i in local])
+        pool = (
+            ThreadPoolExecutor(self.num_workers, thread_name_prefix="loader")
+            if self.num_workers > 0
+            else None
+        )
+        try:
+            for start in range(0, max(stop, 0), self.batch_size):
+                batch_idx = idx[start : start + self.batch_size]
+                # this host's contiguous slice of the global batch
+                local = batch_idx[self.shard_id * per_shard : (self.shard_id + 1) * per_shard]
+                if len(local) == 0:
+                    continue
+                if pool is not None:
+                    examples = list(pool.map(self.dataset.__getitem__, map(int, local)))
+                else:
+                    examples = [self.dataset[int(i)] for i in local]
+                yield self.collate(examples)
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=False, cancel_futures=True)
 
     def __iter__(self) -> Iterator[Batch]:
         if self.prefetch <= 0:

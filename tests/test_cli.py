@@ -101,3 +101,20 @@ def test_encode_masked_samples(tmp_path):
     assert ids.shape == (1, 16)
     assert (ids[0] == mask_id).sum() == 2
     assert pad.dtype == bool
+
+
+def test_train_imagenet(tmp_path):
+    from perceiver_io_tpu.cli import train_imagenet
+
+    run_dir = train_imagenet.main(
+        _common(tmp_path, "imagenet") + TINY_MODEL + [
+            "--synthetic_size", "64", "--synthetic_classes", "4",
+            "--image_size", "16", "--batch_size", "8", "--num_workers", "2",
+            "--num_frequency_bands", "4",
+            "--max_epochs", "1", "--log_every_n_steps", "2",
+        ]
+    )
+    rows = read_metrics(run_dir)
+    assert any("train_loss" in r for r in rows)
+    assert any("val_loss" in r for r in rows)
+    assert os.path.isdir(os.path.join(run_dir, "checkpoints"))

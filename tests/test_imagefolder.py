@@ -1,0 +1,154 @@
+"""ImageFolder data module: folder scanning, transforms, synthetic mode."""
+
+import os
+
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.data.imagefolder import (
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    ImageFolderDataModule,
+    ImageFolderDataset,
+    SyntheticImageDataset,
+    list_image_folder,
+)
+
+
+def _write_tree(base, split, classes, per_class=3, size=40):
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    for cls in classes:
+        d = os.path.join(base, split, cls)
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            arr = rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"img_{i}.jpeg"))
+
+
+def test_list_image_folder(tmp_path):
+    _write_tree(tmp_path, "train", ["cat", "dog"])
+    samples, classes = list_image_folder(str(tmp_path / "train"))
+    assert classes == ["cat", "dog"]
+    assert len(samples) == 6
+    assert all(os.path.exists(p) for p, _ in samples)
+    labels = {lbl for _, lbl in samples}
+    assert labels == {0, 1}
+
+
+def test_list_image_folder_empty_raises(tmp_path):
+    os.makedirs(tmp_path / "train" / "cat")
+    with pytest.raises(FileNotFoundError):
+        list_image_folder(str(tmp_path / "train"))
+
+
+def test_dataset_shapes_and_normalization(tmp_path):
+    _write_tree(tmp_path, "train", ["a"], per_class=2, size=48)
+    samples, _ = list_image_folder(str(tmp_path / "train"))
+    for train in (True, False):
+        ds = ImageFolderDataset(samples, image_size=32, train=train)
+        img, label = ds[0]
+        assert img.shape == (32, 32, 3)
+        assert img.dtype == np.float32
+        assert label == 0
+        # normalized: plausible standardized range
+        assert np.abs(img).max() < 5
+
+
+def test_train_augmentation_varies_but_eval_is_deterministic(tmp_path):
+    _write_tree(tmp_path, "train", ["a"], per_class=1, size=64)
+    samples, _ = list_image_folder(str(tmp_path / "train"))
+    train_ds = ImageFolderDataset(samples, image_size=32, train=True)
+    a, _ = train_ds[0]
+    b, _ = train_ds[0]
+    assert not np.allclose(a, b)  # random crop/flip differ across draws
+    val_ds = ImageFolderDataset(samples, image_size=32, train=False)
+    c, _ = val_ds[0]
+    d, _ = val_ds[0]
+    np.testing.assert_array_equal(c, d)
+
+
+def test_synthetic_dataset_is_lazy_and_learnable():
+    ds = SyntheticImageDataset(64, num_classes=4, image_size=32, seed=0)
+    img, label = ds[0]
+    assert img.shape == (32, 32, 3)
+    assert 0 <= label < 4
+    # deterministic per index
+    img2, label2 = ds[0]
+    np.testing.assert_array_equal(img, img2)
+    assert label == label2
+    # same class, different index → same template, different noise
+    same = [i for i in range(64) if int(ds.labels[i]) == label and i != 0]
+    if same:
+        other, _ = ds[same[0]]
+        assert not np.allclose(img, other)
+        # denormalize: class template should correlate strongly
+        raw1 = img * IMAGENET_STD + IMAGENET_MEAN
+        raw2 = other * IMAGENET_STD + IMAGENET_MEAN
+        corr = np.corrcoef(raw1.ravel(), raw2.ravel())[0, 1]
+        assert corr > 0.5
+
+
+def test_datamodule_synthetic_loaders():
+    dm = ImageFolderDataModule(
+        synthetic=True, synthetic_size=64, synthetic_classes=3,
+        image_size=16, batch_size=8, num_workers=2,
+    )
+    dm.prepare_data()
+    dm.setup()
+    assert dm.num_classes == 3
+    batch = next(iter(dm.train_dataloader()))
+    assert batch["image"].shape == (8, 16, 16, 3)
+    assert batch["label"].shape == (8,)
+    assert batch["label"].dtype == np.int32
+
+
+def test_datamodule_folder_with_val_split(tmp_path):
+    _write_tree(tmp_path / "imagenet", "train", ["a", "b"], per_class=4)
+    _write_tree(tmp_path / "imagenet", "val", ["a", "b"], per_class=2)
+    dm = ImageFolderDataModule(root=str(tmp_path), image_size=24,
+                               batch_size=2, num_workers=0)
+    dm.prepare_data()
+    dm.setup()
+    assert dm.num_classes == 2
+    assert len(dm.ds_train) == 8
+    assert len(dm.ds_valid) == 4
+    batch = next(iter(dm.val_dataloader()))
+    assert batch["image"].shape == (2, 24, 24, 3)
+
+
+def test_datamodule_carves_val_from_train_when_missing(tmp_path):
+    _write_tree(tmp_path / "imagenet", "train", ["a", "b"], per_class=10)
+    dm = ImageFolderDataModule(root=str(tmp_path), image_size=24, batch_size=2)
+    dm.prepare_data()
+    dm.setup()
+    assert len(dm.ds_train) + len(dm.ds_valid) == 20
+    assert len(dm.ds_valid) >= 1
+
+
+def test_datamodule_class_mismatch_raises(tmp_path):
+    _write_tree(tmp_path / "imagenet", "train", ["a", "b"])
+    _write_tree(tmp_path / "imagenet", "val", ["a"])
+    dm = ImageFolderDataModule(root=str(tmp_path))
+    with pytest.raises(ValueError):
+        dm.setup()
+
+
+def test_datamodule_missing_tree_raises(tmp_path):
+    dm = ImageFolderDataModule(root=str(tmp_path))
+    with pytest.raises(FileNotFoundError):
+        dm.prepare_data()
+
+
+def test_loader_num_workers_matches_serial():
+    dm_args = dict(synthetic=True, synthetic_size=32, synthetic_classes=2,
+                   image_size=8, batch_size=4)
+    serial = ImageFolderDataModule(num_workers=0, **dm_args)
+    pooled = ImageFolderDataModule(num_workers=4, **dm_args)
+    for dm in (serial, pooled):
+        dm.setup()
+    b1 = next(iter(serial.val_dataloader()))
+    b2 = next(iter(pooled.val_dataloader()))
+    np.testing.assert_array_equal(b1["image"], b2["image"])
+    np.testing.assert_array_equal(b1["label"], b2["label"])

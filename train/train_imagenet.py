@@ -1,0 +1,13 @@
+#!/usr/bin/env python
+"""ImageNet-1k classification (Perceiver-paper config; extends the reference's
+image path beyond MNIST — BASELINE.md tracked config)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from perceiver_io_tpu.cli.train_imagenet import main
+
+if __name__ == "__main__":
+    main()
