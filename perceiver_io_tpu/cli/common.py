@@ -70,7 +70,9 @@ def add_mesh_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel size (shards the input axis M)")
     g.add_argument("--shard_seq", action="store_true",
-                   help="shard text batches over the seq mesh axis")
+                   help="shard batches over the seq mesh axis: token axis for "
+                        "text, first spatial axis for image/frames (must be "
+                        "divisible by sp)")
 
 
 def add_compute_args(parser: argparse.ArgumentParser) -> None:

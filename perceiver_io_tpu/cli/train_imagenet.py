@@ -102,6 +102,7 @@ def main(argv: Optional[Sequence[str]] = None):
         common.trainer_config(args),
         example_batch={k: example[k] for k in ("image", "label")},
         mesh=mesh,
+        shard_seq=args.shard_seq,
         hparams=vars(args),
     )
     with trainer:

@@ -16,7 +16,7 @@ from __future__ import annotations
 import gzip
 import os
 import struct
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 

@@ -10,9 +10,11 @@ CUDA kernels (reference ``perceiver/model.py:66-74``).
 
 Design:
 
-- grid ``(B, H, S/S_blk)``; the KV axis is the innermost (sequential) grid
-  dimension, so the running max / denominator / PV accumulator live in VMEM
-  scratch across KV blocks (the standard TPU flash-attention recurrence).
+- grid ``(B, H, T/T_blk, S/S_blk)``; the KV axis is the innermost (sequential)
+  grid dimension, so the running max / denominator / PV accumulator live in
+  VMEM scratch across KV blocks (the standard TPU flash-attention recurrence).
+  The query axis is blocked too, so large query counts (e.g. the flow
+  decoder's dense 2D queries) stay inside the ~16MB VMEM scoped limit.
 - logits and the accumulator are f32 regardless of input dtype; the P·V
   matmul feeds the MXU in the input dtype with f32 accumulation.
 - padding (``pad_mask`` True = masked out) enters as a finite additive bias,
@@ -47,6 +49,7 @@ PAD_BIAS = 2.0 * MASK_VALUE
 
 _LANES = 128
 DEFAULT_KV_BLOCK = 512
+DEFAULT_Q_BLOCK = 512
 
 
 def _kv_block_size(s: int, requested: int, alignment: int) -> int:
@@ -67,7 +70,7 @@ def _kv_block_size(s: int, requested: int, alignment: int) -> int:
 
 def _attention_kernel(bias_ref, q_ref, k_ref, v_ref, out_ref,
                       m_ref, l_ref, acc_ref, *, scale: float):
-    s_idx = pl.program_id(2)
+    s_idx = pl.program_id(3)
 
     @pl.when(s_idx == 0)
     def _init():
@@ -75,48 +78,48 @@ def _attention_kernel(bias_ref, q_ref, k_ref, v_ref, out_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0, 0]  # (T, D)
+    q = q_ref[0, 0]  # (T_blk, D)
     k = k_ref[0, 0]  # (S_blk, D)
     logits = jax.lax.dot_general(
         q, k,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
-    ) * scale  # (T, S_blk)
-    logits += bias_ref[0]  # (1, S_blk) broadcasts over T
+    ) * scale  # (T_blk, S_blk)
+    logits += bias_ref[0]  # (1, S_blk) broadcasts over T_blk
 
-    m_prev = m_ref[:, :1]  # (T, 1)
+    m_prev = m_ref[:, :1]  # (T_blk, 1)
     l_prev = l_ref[:, :1]
     m_cur = jnp.max(logits, axis=-1, keepdims=True)
     m_new = jnp.maximum(m_prev, m_cur)
     alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(logits - m_new)  # (T, S_blk)
+    p = jnp.exp(logits - m_new)  # (T_blk, S_blk)
 
     l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
     pv = jax.lax.dot_general(
         p.astype(v_ref.dtype), v_ref[0, 0],
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
-    )  # (T, D)
+    )  # (T_blk, D)
     acc_ref[:] = acc_ref[:] * alpha + pv
     m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
     l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    @pl.when(s_idx == pl.num_programs(2) - 1)
+    @pl.when(s_idx == pl.num_programs(3) - 1)
     def _finish():
         out_ref[0, 0] = (acc_ref[:] / l_ref[:, :1]).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("s_blk", "interpret"))
+@functools.partial(jax.jit, static_argnames=("t_blk", "s_blk", "interpret"))
 def _fused_attention_fwd_impl(
     q: Array, k: Array, v: Array, bias: Array,
-    s_blk: int, interpret: bool,
+    t_blk: int, s_blk: int, interpret: bool,
 ) -> Array:
     """(B, H, T, D) q against (B, H, S, D) k/v with (B, S) additive bias.
-    ``s_blk`` must divide S (the wrapper guarantees it)."""
+    ``t_blk``/``s_blk`` must divide T/S (the wrapper guarantees it)."""
     b, h, t, d = q.shape
     s = k.shape[2]
     scale = d**-0.5
-    grid = (b, h, s // s_blk)
+    grid = (b, h, t // t_blk, s // s_blk)
 
     bias = bias[:, None, :]  # (B, 1, S)
     kernel = pl.pallas_call(
@@ -124,22 +127,22 @@ def _fused_attention_fwd_impl(
         grid=grid,
         in_specs=[
             # (B, 1, S) so the block's trailing dims satisfy TPU tiling
-            pl.BlockSpec((1, 1, s_blk), lambda bi, hi, si: (bi, 0, si)),
-            pl.BlockSpec((1, 1, t, d), lambda bi, hi, si: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s_blk, d), lambda bi, hi, si: (bi, hi, si, 0)),
-            pl.BlockSpec((1, 1, s_blk, d), lambda bi, hi, si: (bi, hi, si, 0)),
+            pl.BlockSpec((1, 1, s_blk), lambda bi, hi, ti, si: (bi, 0, si)),
+            pl.BlockSpec((1, 1, t_blk, d), lambda bi, hi, ti, si: (bi, hi, ti, 0)),
+            pl.BlockSpec((1, 1, s_blk, d), lambda bi, hi, ti, si: (bi, hi, si, 0)),
+            pl.BlockSpec((1, 1, s_blk, d), lambda bi, hi, ti, si: (bi, hi, si, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, t, d), lambda bi, hi, si: (bi, hi, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, t_blk, d), lambda bi, hi, ti, si: (bi, hi, ti, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
         scratch_shapes=[
-            pltpu.VMEM((t, _LANES), jnp.float32),  # running max
-            pltpu.VMEM((t, _LANES), jnp.float32),  # running denominator
-            pltpu.VMEM((t, d), jnp.float32),  # PV accumulator
+            pltpu.VMEM((t_blk, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((t_blk, _LANES), jnp.float32),  # running denominator
+            pltpu.VMEM((t_blk, d), jnp.float32),  # PV accumulator
         ],
         compiler_params=pltpu.CompilerParams(
-            # batch/head grid steps are independent; only the KV axis carries
-            # the softmax recurrence
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            # batch/head/query-block grid steps are independent; only the KV
+            # axis carries the softmax recurrence
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )
@@ -164,17 +167,17 @@ def _reference_attention(q, k, v, bias):
     return jnp.einsum("bhts,bhsd->bhtd", probs, v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _fused_attention(q, k, v, bias, s_blk, interpret):
-    return _fused_attention_fwd_impl(q, k, v, bias, s_blk, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _fused_attention(q, k, v, bias, t_blk, s_blk, interpret):
+    return _fused_attention_fwd_impl(q, k, v, bias, t_blk, s_blk, interpret)
 
 
-def _fwd(q, k, v, bias, s_blk, interpret):
-    out = _fused_attention_fwd_impl(q, k, v, bias, s_blk, interpret)
+def _fwd(q, k, v, bias, t_blk, s_blk, interpret):
+    out = _fused_attention_fwd_impl(q, k, v, bias, t_blk, s_blk, interpret)
     return out, (q, k, v, bias)
 
 
-def _bwd(s_blk, interpret, residuals, g):
+def _bwd(t_blk, s_blk, interpret, residuals, g):
     q, k, v, bias = residuals
     _, vjp = jax.vjp(_reference_attention, q, k, v, bias)
     dq, dk, dv, _ = vjp(g)
@@ -190,6 +193,7 @@ def fused_attention(
     v: Array,
     pad_mask: Optional[Array] = None,
     kv_block_size: int = DEFAULT_KV_BLOCK,
+    q_block_size: int = DEFAULT_Q_BLOCK,
     interpret: Optional[bool] = None,
 ) -> Array:
     """Fused multi-head attention over (B, T, H, D) q and (B, S, H, D) k/v.
@@ -232,5 +236,21 @@ def fused_attention(
             bias = jnp.pad(bias, ((0, 0), (0, s_pad)), constant_values=PAD_BIAS)
             s_blk = block
 
-    out = _fused_attention(q, k, v, bias, s_blk, interpret)
+    # Block the query axis too: a fully resident query block (plus its f32
+    # accumulator and double-buffered output) blows the VMEM scoped limit once
+    # T reaches a few thousand (e.g. dense flow decoder queries). Padded query
+    # rows attend normally and are sliced off after.
+    t_pad = 0
+    t_blk = _kv_block_size(t, q_block_size, alignment)
+    if t_blk == 0:
+        if t <= 2 * q_block_size:
+            t_blk = t
+        else:
+            t_blk = max(q_block_size - q_block_size % alignment, alignment)
+            t_pad = -t % t_blk
+            q = jnp.pad(q, ((0, 0), (0, 0), (0, t_pad), (0, 0)))
+
+    out = _fused_attention(q, k, v, bias, t_blk, s_blk, interpret)
+    if t_pad:
+        out = out[:, :, :t]
     return jnp.transpose(out, (0, 2, 1, 3))

@@ -89,8 +89,11 @@ def sharding_for_tree(tree: Any, mesh: Mesh, rules: Sequence[Tuple[str, P]] = PA
 
 
 def batch_pspecs(batch: Dict[str, Any], mesh: Mesh, shard_seq: bool = False) -> Dict[str, P]:
-    """PartitionSpecs for a batch dict: leading axis over ``data``; for text
-    tensors (token_ids/pad_mask), optionally the sequence axis over ``seq``.
+    """PartitionSpecs for a batch dict: leading axis over ``data``, and
+    optionally the sequence axis over ``seq`` — axis 1 for text tensors
+    (token_ids/pad_mask) and for images/frames ('image': (B, H, W, C),
+    'frames': (B, 2, H, W, C) → axis 2), whose first spatial axis maps
+    contiguously onto the flattened input axis M = H·W the encoder consumes.
 
     Sequence sharding is the Perceiver sequence-parallel scheme: the encoder
     cross-attention KV stream (derived from these tensors) is sharded over
@@ -103,6 +106,10 @@ def batch_pspecs(batch: Dict[str, Any], mesh: Mesh, shard_seq: bool = False) -> 
         ndim = np.ndim(value) if not hasattr(value, "ndim") else value.ndim
         if key in ("token_ids", "pad_mask") and ndim >= 2:
             specs[key] = P(AXIS_DATA, seq_axis, *([None] * (ndim - 2)))
+        elif key == "image" and ndim >= 3:
+            specs[key] = P(AXIS_DATA, seq_axis, *([None] * (ndim - 2)))
+        elif key == "frames" and ndim >= 4:
+            specs[key] = P(AXIS_DATA, None, seq_axis, *([None] * (ndim - 3)))
         else:
             specs[key] = P(AXIS_DATA, *([None] * (ndim - 1)))
     return specs

@@ -152,3 +152,34 @@ def test_module_dispatch_parity(rng):
     np.testing.assert_allclose(
         np.asarray(out_pallas), np.asarray(out_xla), atol=1e-5
     )
+
+
+@pytest.mark.parametrize("t,s,q_blk", [(16, 32, 4), (12, 32, 4), (7, 32, 3)])
+def test_query_blocking_matches_xla(rng, t, s, q_blk):
+    """Multi-query-block grid (t_blk < T), including the pad-then-slice path
+    when T has no usable divisor (t=7, q_blk=3 → pads to 9)."""
+    q = _rand(rng, 2, t, 2, 8)
+    k = _rand(rng, 2, s, 2, 8)
+    v = _rand(rng, 2, s, 2, 8)
+    pad = jnp.asarray(rng.random((2, s)) < 0.2)
+    out = fused_attention(q, k, v, pad, kv_block_size=16, q_block_size=q_blk)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_xla(q, k, v, pad)), atol=2e-5
+    )
+
+
+def test_query_blocking_gradients(rng):
+    q = _rand(rng, 1, 12, 1, 8)
+    k = _rand(rng, 1, 24, 1, 8)
+    v = _rand(rng, 1, 24, 1, 8)
+
+    def loss_fused(q, k, v):
+        return jnp.sum(fused_attention(q, k, v, kv_block_size=8, q_block_size=4) ** 2)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(_xla(q, k, v) ** 2)
+
+    g_fused = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+    g_xla = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_fused, g_xla):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)

@@ -163,9 +163,15 @@ def test_batch_pspecs():
     assert specs["token_ids"] == P(AXIS_DATA, "seq")
     assert specs["pad_mask"] == P(AXIS_DATA, "seq")
     assert specs["label"] == P(AXIS_DATA)
-    assert specs["image"] == P(AXIS_DATA, None, None, None)
+    # image/frames: first spatial axis (contiguous prefix of flattened M)
+    assert specs["image"] == P(AXIS_DATA, "seq", None, None)
+    frames = {"frames": np.zeros((8, 2, 16, 16, 3), np.float32)}
+    assert batch_pspecs(frames, mesh, shard_seq=True)["frames"] == P(
+        AXIS_DATA, None, "seq", None, None
+    )
     specs = batch_pspecs(batch, mesh, shard_seq=False)
     assert specs["token_ids"] == P(AXIS_DATA, None)
+    assert specs["image"] == P(AXIS_DATA, None, None, None)
 
 
 def test_image_classifier_sharded(rng):
@@ -187,8 +193,21 @@ def test_image_classifier_sharded(rng):
     state = TrainState.create(variables["params"], tx, jax.random.key(1))
     train_step, _ = make_classifier_steps(model, input_kind="image")
 
-    _, ref = _run(jax.jit(train_step), state, batch)
+    # Sharded steps donate their state and device_put can alias the source
+    # buffers, so give each sharded run its own copy.
+    fresh = lambda: jax.tree.map(jnp.copy, state)
+
+    _, ref = _run(jax.jit(train_step), fresh(), batch)
     mesh = make_mesh(dp=4, tp=2, sp=1)
-    step, sstate, bshard = make_sharded_train_step(train_step, mesh, state, batch)
+    step, sstate, bshard = make_sharded_train_step(train_step, mesh, fresh(), batch)
+    _, sharded = _run(step, sstate, jax.device_put(batch, bshard))
+    np.testing.assert_allclose(sharded, ref, atol=1e-5)
+
+    # sequence-parallel over the image's first spatial axis (KV stream)
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    step, sstate, bshard = make_sharded_train_step(
+        train_step, mesh, fresh(), batch, shard_seq=True
+    )
+    assert bshard["image"].spec == P(AXIS_DATA, "seq", None, None)
     _, sharded = _run(step, sstate, jax.device_put(batch, bshard))
     np.testing.assert_allclose(sharded, ref, atol=1e-5)
