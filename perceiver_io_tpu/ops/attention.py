@@ -81,9 +81,14 @@ def _dot_product_attention(
     d = q.shape[-1]
     scale = d**-0.5
     # (B, H, T, S) logits: contract head dim. Keep accumulation in f32 so
-    # bf16 activations don't lose the softmax.
+    # bf16 activations don't lose the softmax. For f32 operands request
+    # HIGHEST precision: the TPU MXU's default single bf16 pass costs ~3
+    # decimal digits (the Pallas kernel does the same — ops/pallas_attention).
+    precision = (jax.lax.Precision.HIGHEST
+                 if q.dtype == jnp.float32 else None)
     logits = jnp.einsum(
-        "bthd,bshd->bhts", q * scale, k, preferred_element_type=jnp.float32
+        "bthd,bshd->bhts", q * scale, k,
+        preferred_element_type=jnp.float32, precision=precision,
     )
 
     neg = jnp.finfo(logits.dtype).min
@@ -101,7 +106,7 @@ def _dot_product_attention(
         probs = jnp.where(keep, probs / (1.0 - dropout_rate), 0.0)
 
     probs = probs.astype(v.dtype)
-    return jnp.einsum("bhts,bshd->bthd", probs, v)
+    return jnp.einsum("bhts,bshd->bthd", probs, v, precision=precision)
 
 
 class MultiHeadAttention(nn.Module):

@@ -20,9 +20,18 @@ Design:
 - padding (``pad_mask`` True = masked out) enters as a finite additive bias,
   reproducing the XLA path's semantics including the fully-masked-row case
   (uniform probabilities) without NaNs.
-- backward: ``jax.custom_vjp`` recomputing attention gradients with the XLA
-  einsum path (flash-style recompute-in-backward; the fused forward still
-  saves the HBM round-trips where inference/eval spend their time).
+- backward: fused flash backward — two Pallas kernels (dq; dk/dv) recompute
+  the probabilities blockwise as exp(logits − m)/l from the saved softmax
+  max ``m`` and denominator ``l``, so the (T, S) logits never materialize in
+  HBM in either direction. ``m``/``l`` are saved lane-broadcast as
+  (B, H, T, 128) f32 (the layout jax's own TPU flash-attention kernel uses —
+  sublane↔lane moves are not free on Mosaic) and kept separate rather than
+  folded into a logsumexp, which would absorb log l on fully padded rows
+  (m = -1e30 in f32); ``delta = Σ_d g·out`` is computed in XLA and passed in
+  the same layout. On a fully padded row the probabilities recompute as
+  uniform 1/l (the -1e30 bias absorbs the logits in f32 rounding), ``dv``
+  keeps the uniform contribution, and ``ds`` is zeroed so dq/dk match the
+  XLA path's where-style masking (zero grads through the mask).
 
 Contract (enforced by the dispatcher in ``ops.attention``): no attention-prob
 dropout, optional key padding mask only.
@@ -52,6 +61,24 @@ DEFAULT_KV_BLOCK = 512
 DEFAULT_Q_BLOCK = 512
 
 
+def _dot(a, b, contract):
+    """MXU matmul contracting ``contract`` = (a_dim, b_dim), f32 accumulation.
+
+    The MXU multiplies in bf16; for f32 operands a single pass loses ~3
+    decimal digits vs XLA's einsum (which defaults to multi-pass for f32), so
+    request HIGHEST precision there. bf16 operands keep the fast single pass —
+    the production bf16 training path pays nothing for this.
+    """
+    precision = (jax.lax.Precision.HIGHEST
+                 if a.dtype == jnp.float32 and b.dtype == jnp.float32 else None)
+    return jax.lax.dot_general(
+        a, b,
+        dimension_numbers=(((contract[0],), (contract[1],)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=precision,
+    )
+
+
 def _kv_block_size(s: int, requested: int, alignment: int) -> int:
     """KV length to stream per grid step: a divisor of S, aligned to the TPU
     tile constraint (Mosaic requires block dims to be lane/sublane multiples
@@ -68,8 +95,13 @@ def _kv_block_size(s: int, requested: int, alignment: int) -> int:
     return best if best * 2 >= requested else 0
 
 
-def _attention_kernel(bias_ref, q_ref, k_ref, v_ref, out_ref,
-                      m_ref, l_ref, acc_ref, *, scale: float):
+def _attention_kernel(bias_ref, q_ref, k_ref, v_ref, out_ref, *rest,
+                      scale: float, with_lse: bool):
+    if with_lse:
+        m_out, l_out, m_ref, l_ref, acc_ref = rest
+        lse_ref = (m_out, l_out)
+    else:
+        lse_ref, (m_ref, l_ref, acc_ref) = None, rest
     s_idx = pl.program_id(3)
 
     @pl.when(s_idx == 0)
@@ -80,11 +112,7 @@ def _attention_kernel(bias_ref, q_ref, k_ref, v_ref, out_ref,
 
     q = q_ref[0, 0]  # (T_blk, D)
     k = k_ref[0, 0]  # (S_blk, D)
-    logits = jax.lax.dot_general(
-        q, k,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale  # (T_blk, S_blk)
+    logits = _dot(q, k, (1, 1)) * scale  # (T_blk, S_blk)
     logits += bias_ref[0]  # (1, S_blk) broadcasts over T_blk
 
     m_prev = m_ref[:, :1]  # (T_blk, 1)
@@ -95,11 +123,7 @@ def _attention_kernel(bias_ref, q_ref, k_ref, v_ref, out_ref,
     p = jnp.exp(logits - m_new)  # (T_blk, S_blk)
 
     l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-    pv = jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0, 0],
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )  # (T_blk, D)
+    pv = _dot(p.astype(v_ref.dtype), v_ref[0, 0], (1, 0))  # (T_blk, D)
     acc_ref[:] = acc_ref[:] * alpha + pv
     m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
     l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -107,23 +131,43 @@ def _attention_kernel(bias_ref, q_ref, k_ref, v_ref, out_ref,
     @pl.when(s_idx == pl.num_programs(3) - 1)
     def _finish():
         out_ref[0, 0] = (acc_ref[:] / l_ref[:, :1]).astype(out_ref.dtype)
+        if with_lse:
+            m_out_ref, l_out_ref = lse_ref
+            m_out_ref[0, 0] = m_ref[:]
+            l_out_ref[0, 0] = l_ref[:]
 
 
-@functools.partial(jax.jit, static_argnames=("t_blk", "s_blk", "interpret"))
+@functools.partial(
+    jax.jit, static_argnames=("t_blk", "s_blk", "interpret", "with_lse")
+)
 def _fused_attention_fwd_impl(
     q: Array, k: Array, v: Array, bias: Array,
-    t_blk: int, s_blk: int, interpret: bool,
-) -> Array:
+    t_blk: int, s_blk: int, interpret: bool, with_lse: bool = False,
+):
     """(B, H, T, D) q against (B, H, S, D) k/v with (B, S) additive bias.
-    ``t_blk``/``s_blk`` must divide T/S (the wrapper guarantees it)."""
+    ``t_blk``/``s_blk`` must divide T/S (the wrapper guarantees it).
+    With ``with_lse`` also returns the softmax running max ``m`` and
+    denominator ``l``, each lane-broadcast to (B, H, T, LANES) f32, for the
+    fused backward. They are saved separately — not as ``m + log l`` — so a
+    fully padded row (m pinned at MASK_VALUE, which absorbs log l in f32)
+    still recomputes exactly as exp(logits − m)/l."""
     b, h, t, d = q.shape
     s = k.shape[2]
     scale = d**-0.5
     grid = (b, h, t // t_blk, s // s_blk)
 
+    out_shape = jax.ShapeDtypeStruct((b, h, t, d), q.dtype)
+    out_specs = pl.BlockSpec((1, 1, t_blk, d), lambda bi, hi, ti, si: (bi, hi, ti, 0))
+    if with_lse:
+        lm_shape = jax.ShapeDtypeStruct((b, h, t, _LANES), jnp.float32)
+        lm_spec = pl.BlockSpec((1, 1, t_blk, _LANES),
+                               lambda bi, hi, ti, si: (bi, hi, ti, 0))
+        out_shape = (out_shape, lm_shape, lm_shape)
+        out_specs = (out_specs, lm_spec, lm_spec)
+
     bias = bias[:, None, :]  # (B, 1, S)
     kernel = pl.pallas_call(
-        functools.partial(_attention_kernel, scale=scale),
+        functools.partial(_attention_kernel, scale=scale, with_lse=with_lse),
         grid=grid,
         in_specs=[
             # (B, 1, S) so the block's trailing dims satisfy TPU tiling
@@ -132,8 +176,8 @@ def _fused_attention_fwd_impl(
             pl.BlockSpec((1, 1, s_blk, d), lambda bi, hi, ti, si: (bi, hi, si, 0)),
             pl.BlockSpec((1, 1, s_blk, d), lambda bi, hi, ti, si: (bi, hi, si, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, t_blk, d), lambda bi, hi, ti, si: (bi, hi, ti, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((t_blk, _LANES), jnp.float32),  # running max
             pltpu.VMEM((t_blk, _LANES), jnp.float32),  # running denominator
@@ -149,22 +193,126 @@ def _fused_attention_fwd_impl(
     return kernel(bias, q, k, v)
 
 
-def _reference_attention(q, k, v, bias):
-    """XLA attention over (B, H, T, D) — the backward-pass recompute.
+def _recompute_probs_and_ds(bias_ref, q_ref, k_ref, v_ref, g_ref,
+                            m_ref, l_ref, di_ref, *, scale: float):
+    """Shared backward tile math: recompute p = exp(logits − m)/l for this
+    (T_blk, S_blk) tile and the softmax gradient ds = p·(dp − delta).
 
-    Masking uses ``where`` on the (non-differentiable) mask recovered from the
-    bias, exactly like the production XLA path (``ops.attention``): masked
-    positions contribute zero gradient to q/k — in particular a fully padded
-    row yields dq = dk = 0, not gradients through its uniform softmax.
-    """
-    d = q.shape[-1]
-    logits = jnp.einsum(
-        "bhtd,bhsd->bhts", q * (d**-0.5), k, preferred_element_type=jnp.float32
+    ds is zeroed on fully padded rows (m pinned at MASK_VALUE) so dq/dk
+    reproduce the XLA path's where-masking; p is left intact there (uniform
+    1/l) because dv keeps the uniform contribution on that path."""
+    q = q_ref[0, 0]  # (T_blk, D)
+    k = k_ref[0, 0]  # (S_blk, D)
+    g = g_ref[0, 0]  # (T_blk, D)
+    logits = _dot(q, k, (1, 1)) * scale  # (T_blk, S_blk)
+    logits += bias_ref[0]  # (1, S_blk) broadcasts over T_blk
+    m = m_ref[0, 0][:, :1]  # (T_blk, 1)
+    l = l_ref[0, 0][:, :1]
+    p = jnp.exp(logits - m) / l
+    dp = _dot(g, v_ref[0, 0], (1, 1))  # (T_blk, S_blk)
+    ds = p * (dp - di_ref[0, 0][:, :1])
+    ds = jnp.where(m <= 0.5 * MASK_VALUE, 0.0, ds)
+    return p, ds, q, k, g
+
+
+def _bwd_dq_kernel(bias_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, di_ref,
+                   dq_ref, acc_ref, *, scale: float):
+    s_idx = pl.program_id(3)
+
+    @pl.when(s_idx == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    _, ds, _, k, _ = _recompute_probs_and_ds(
+        bias_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, di_ref, scale=scale
     )
-    masked = (bias < 0.5 * MASK_VALUE)[:, None, None, :]  # True = masked out
-    logits = jnp.where(masked, jnp.finfo(logits.dtype).min, logits)
-    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhts,bhsd->bhtd", probs, v)
+    acc_ref[:] += _dot(ds.astype(k.dtype), k, (1, 0))  # (T_blk, D)
+
+    @pl.when(s_idx == pl.num_programs(3) - 1)
+    def _finish():
+        dq_ref[0, 0] = (acc_ref[:] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(bias_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, di_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float):
+    t_idx = pl.program_id(3)
+
+    @pl.when(t_idx == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    p, ds, q, _, g = _recompute_probs_and_ds(
+        bias_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, di_ref, scale=scale
+    )
+    # contract the query axis: (T_blk, S_blk)ᵀ·(T_blk, D) → (S_blk, D)
+    dv_acc[:] += _dot(p.astype(g.dtype), g, (0, 0))
+    dk_acc[:] += _dot(ds.astype(q.dtype), q, (0, 0))
+
+    @pl.when(t_idx == pl.num_programs(3) - 1)
+    def _finish():
+        dk_ref[0, 0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("t_blk", "s_blk", "interpret"))
+def _fused_attention_bwd_impl(
+    q: Array, k: Array, v: Array, bias: Array, out: Array,
+    m: Array, l: Array,
+    g: Array, t_blk: int, s_blk: int, interpret: bool,
+):
+    b, h, t, d = q.shape
+    s = k.shape[2]
+    scale = d**-0.5
+
+    # delta = Σ_d g·out per query row, lane-broadcast like lse
+    di = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    di = jnp.broadcast_to(di[..., None], (b, h, t, _LANES))
+
+    bias = bias[:, None, :]  # (B, 1, S)
+    qo_spec = pl.BlockSpec((1, 1, t_blk, d), lambda bi, hi, ti, si: (bi, hi, ti, 0))
+    kv_spec = pl.BlockSpec((1, 1, s_blk, d), lambda bi, hi, ti, si: (bi, hi, si, 0))
+    lm_spec = pl.BlockSpec((1, 1, t_blk, _LANES),
+                           lambda bi, hi, ti, si: (bi, hi, ti, 0))
+    bias_spec = pl.BlockSpec((1, 1, s_blk), lambda bi, hi, ti, si: (bi, 0, si))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale),
+        grid=(b, h, t // t_blk, s // s_blk),  # KV axis sequential
+        in_specs=[bias_spec, qo_spec, kv_spec, kv_spec, qo_spec,
+                  lm_spec, lm_spec, lm_spec],
+        out_specs=qo_spec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((t_blk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(bias, q, k, v, g, m, l, di)
+
+    # dkv grid puts the query axis innermost (sequential): same index maps
+    # apply, with ti/si read from swapped grid positions
+    qo_spec2 = pl.BlockSpec((1, 1, t_blk, d), lambda bi, hi, si, ti: (bi, hi, ti, 0))
+    kv_spec2 = pl.BlockSpec((1, 1, s_blk, d), lambda bi, hi, si, ti: (bi, hi, si, 0))
+    lm_spec2 = pl.BlockSpec((1, 1, t_blk, _LANES),
+                            lambda bi, hi, si, ti: (bi, hi, ti, 0))
+    bias_spec2 = pl.BlockSpec((1, 1, s_blk), lambda bi, hi, si, ti: (bi, 0, si))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale),
+        grid=(b, h, s // s_blk, t // t_blk),  # query axis sequential
+        in_specs=[bias_spec2, qo_spec2, kv_spec2, kv_spec2, qo_spec2,
+                  lm_spec2, lm_spec2, lm_spec2],
+        out_specs=(kv_spec2, kv_spec2),
+        out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)),
+        scratch_shapes=[pltpu.VMEM((s_blk, d), jnp.float32),
+                        pltpu.VMEM((s_blk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(bias, q, k, v, g, m, l, di)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
@@ -173,14 +321,17 @@ def _fused_attention(q, k, v, bias, t_blk, s_blk, interpret):
 
 
 def _fwd(q, k, v, bias, t_blk, s_blk, interpret):
-    out = _fused_attention_fwd_impl(q, k, v, bias, t_blk, s_blk, interpret)
-    return out, (q, k, v, bias)
+    out, m, l = _fused_attention_fwd_impl(
+        q, k, v, bias, t_blk, s_blk, interpret, with_lse=True
+    )
+    return out, (q, k, v, bias, out, m, l)
 
 
 def _bwd(t_blk, s_blk, interpret, residuals, g):
-    q, k, v, bias = residuals
-    _, vjp = jax.vjp(_reference_attention, q, k, v, bias)
-    dq, dk, dv, _ = vjp(g)
+    q, k, v, bias, out, m, l = residuals
+    dq, dk, dv = _fused_attention_bwd_impl(
+        q, k, v, bias, out, m, l, g, t_blk, s_blk, interpret
+    )
     return dq, dk, dv, jnp.zeros_like(bias)
 
 

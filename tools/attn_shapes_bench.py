@@ -38,32 +38,67 @@ def xla_attn(q, k, v):
 
 
 def timeit(fn, args, steps=20):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / steps
+    """Honest step time on tunneled backends, where per-dispatch latency is
+    ~ms, block_until_ready can return early, and dispatches whose outputs go
+    unreferenced are elided: run the whole loop device-side in ONE dispatch
+    (fori_loop), chaining each iteration's input on a reduction of EVERY
+    output leaf (so no part of the computation is dead code — carrying just
+    one element lets XLA DCE the rest of the body), then sync with a host
+    scalar fetch. A 1-iteration run is subtracted to remove the fetch
+    round-trip and loop overheads."""
+
+    @jax.jit
+    def loop(n, q0, *rest):
+        def body(_, q):
+            out = fn(q, *rest)
+            dep = sum(jnp.sum(lf.astype(jnp.float32)) for lf in jax.tree.leaves(out))
+            return q0 + (dep * 1e-30).astype(q0.dtype)
+
+        return jnp.sum(jax.lax.fori_loop(0, n, body, q0).astype(jnp.float32))
+
+    float(loop(1, *args))  # compile + warm
+
+    def run(n):
+        t0 = time.perf_counter()
+        float(loop(n, *args))
+        return time.perf_counter() - t0
+
+    # grow the iteration count until the run dwarfs the ~100ms fetch noise
+    t1 = run(1)
+    n = steps
+    while True:
+        tn = run(n + 1)
+        if tn > 1.0 or n >= 4096:
+            return (tn - t1) / n
+        n *= 4
+
+
+def grad_of(attn):
+    """fwd+bwd step: value_and_grad keeps the primal live so nothing DCEs."""
+    def loss(q, k, v):
+        return jnp.sum(attn(q, k, v).astype(jnp.float32) ** 2)
+    return jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
 
 
 def main():
+    with_grad = "--grad" in sys.argv
     rng = np.random.default_rng(0)
     for name, b, t, s, h, d in SHAPES:
         q = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.bfloat16)
         k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
         v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
-        try:
-            t_xla = timeit(jax.jit(xla_attn), (q, k, v))
-        except Exception as e:
-            t_xla = float("nan")
-            print(f"{name}: xla failed: {type(e).__name__}")
-        try:
-            t_pal = timeit(jax.jit(fused_attention), (q, k, v))
-        except Exception as e:
-            t_pal = float("nan")
-            print(f"{name}: pallas failed: {type(e).__name__}: {e}")
-        flops = 4 * b * h * t * s * d
+        fns = ((grad_of(xla_attn), grad_of(fused_attention)) if with_grad
+               else (jax.jit(xla_attn), jax.jit(fused_attention)))
+        times = []
+        for impl, fn in zip(("xla", "pallas"), fns):
+            try:
+                times.append(timeit(fn, (q, k, v)))
+            except Exception as e:
+                times.append(float("nan"))
+                print(f"{name}: {impl} failed: {type(e).__name__}: {e}")
+        t_xla, t_pal = times
+        # fwd: QKᵀ + PV; bwd adds dq/dk/ds/dp/dv tile matmuls (~2.5x more)
+        flops = 4 * b * h * t * s * d * (3.5 if with_grad else 1.0)
         print(f"{name:10s} xla {t_xla*1e3:8.3f} ms ({flops/t_xla/1e12:6.1f} TF/s)   "
               f"pallas {t_pal*1e3:8.3f} ms ({flops/t_pal/1e12:6.1f} TF/s)")
 
