@@ -151,7 +151,7 @@ def build_optical_flow_model(
     num_frequency_bands: int = 64,
     dropout: float = 0.0,
     dtype: jnp.dtype = jnp.float32,
-    attn_impl: str = "xla",
+    attn_impl: str = "auto",
     remat: bool = False,
 ):
     """PerceiverIO for optical flow (defaults sized after the Perceiver IO

@@ -58,7 +58,7 @@ class PerceiverLayer(nn.Module):
     num_self_attention_layers_per_block: int
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
-    attn_impl: str = "xla"
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x_latent, x_input, pad_mask=None, deterministic=True):
@@ -98,7 +98,7 @@ class PerceiverEncoder(nn.Module):
     num_self_attention_layers_per_block: int = 2
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
-    attn_impl: str = "xla"
+    attn_impl: str = "auto"
     remat: bool = False
 
     def _make_layer(self, name: str) -> nn.Module:
@@ -151,7 +151,7 @@ class PerceiverDecoder(nn.Module):
     num_cross_attention_heads: int = 4
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
-    attn_impl: str = "xla"
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, deterministic=True, positions: Optional[Array] = None):

@@ -25,7 +25,9 @@ normalized output by ~0.1%.
 
 The attention inner product is pluggable: ``attn_impl='xla'`` uses pure
 jnp/einsum (XLA fuses this well on the MXU); ``attn_impl='pallas'`` dispatches
-to the fused Pallas kernel in ``perceiver_io_tpu.ops.pallas_attention``.
+to the fused Pallas kernel in ``perceiver_io_tpu.ops.pallas_attention``;
+``'auto'`` (default) picks per call site by KV-stream length — the fused
+kernel for long streams (image/flow inputs), XLA for short ones (text).
 """
 
 from __future__ import annotations
@@ -47,6 +49,17 @@ torch_linear_kernel_init = nn.initializers.variance_scaling(
 
 # torch nn.LayerNorm default epsilon (flax defaults to 1e-6)
 LN_EPS = 1e-5
+
+# 'auto' attention dispatch (v5e measurements, tools/attn_shapes_bench.py).
+# The XLA path materializes (B, H, T, S) logits, so its cost per logit byte is
+# ~d/2 FLOPs: deep-contraction heads (d >= 1024) are compute-bound and XLA's
+# matmul emitter wins (1.4x at ImageNet's 1-head d=1024 cross-attn); shallow
+# heads are HBM-bound on the logits and the fused kernel wins (2.4x fwd+bwd
+# at d=128, S=50k). d=512 measures a wash on time, where the kernel's O(S)
+# memory breaks the tie. Short streams (text, S<=512 latents) are always XLA:
+# those MXU-hostile d=16 shapes express worse in Mosaic than in the einsum.
+AUTO_PALLAS_MIN_KV = 4096
+AUTO_PALLAS_MAX_HEAD_DIM = 512
 
 
 def layer_norm(dtype, name: str) -> nn.LayerNorm:
@@ -122,7 +135,7 @@ class MultiHeadAttention(nn.Module):
     num_heads: int
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
-    attn_impl: str = "xla"  # 'xla' | 'pallas'
+    attn_impl: str = "auto"  # 'auto' | 'xla' | 'pallas'
 
     @nn.compact
     def __call__(
@@ -162,7 +175,20 @@ class MultiHeadAttention(nn.Module):
         # The fused kernel covers the Perceiver hot path: pad-masked or
         # unmasked attention without prob-dropout. attn_mask / prob-dropout
         # fall back to the XLA path (never silently dropped).
-        if self.attn_impl == "pallas" and attn_mask is None and not dropout_active:
+        #
+        # 'auto' (the default) picks per call site — long KV stream with
+        # shallow heads → fused kernel; everything else → XLA einsum. See the
+        # constants' comment for the measurements behind the thresholds.
+        impl = self.attn_impl
+        if impl == "auto":
+            # TPU-only: off-TPU the kernel would run in interpreter mode
+            # (orders of magnitude slower); explicit 'pallas' keeps that
+            # fallback for tests.
+            long_kv = (s >= AUTO_PALLAS_MIN_KV
+                       and d <= AUTO_PALLAS_MAX_HEAD_DIM
+                       and jax.default_backend() == "tpu")
+            impl = "pallas" if long_kv else "xla"
+        if impl == "pallas" and attn_mask is None and not dropout_active:
             from perceiver_io_tpu.ops.pallas_attention import fused_attention
 
             out = fused_attention(q, k, v, pad_mask=pad_mask)
@@ -195,7 +221,7 @@ class CrossAttention(nn.Module):
     num_heads: int
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
-    attn_impl: str = "xla"
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x_q, x_kv, pad_mask=None, attn_mask=None, deterministic=True):
@@ -219,7 +245,7 @@ class SelfAttention(nn.Module):
     num_heads: int
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
-    attn_impl: str = "xla"
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, pad_mask=None, attn_mask=None, deterministic=True):
@@ -278,7 +304,7 @@ class CrossAttentionLayer(nn.Module):
     num_heads: int
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
-    attn_impl: str = "xla"
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x_q, x_kv, pad_mask=None, deterministic=True):
@@ -306,7 +332,7 @@ class SelfAttentionLayer(nn.Module):
     num_heads: int
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
-    attn_impl: str = "xla"
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, deterministic=True):
@@ -337,7 +363,7 @@ class SelfAttentionBlock(nn.Module):
     num_heads: int
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
-    attn_impl: str = "xla"
+    attn_impl: str = "auto"
 
     @nn.compact
     def __call__(self, x, deterministic=True):

@@ -183,3 +183,37 @@ def test_query_blocking_gradients(rng):
     g_xla = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g_fused, g_xla):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
+
+def test_auto_dispatch_threshold(rng, monkeypatch):
+    """'auto' picks the fused kernel iff the KV stream is long (>= 4096),
+    the heads are shallow, AND the backend is a real TPU (off-TPU the kernel
+    would run in interpreter mode)."""
+    import perceiver_io_tpu.ops.pallas_attention as pa
+    from perceiver_io_tpu.ops import attention as attn_mod
+
+    calls = []
+    real = pa.fused_attention
+
+    def spy(*args, **kwargs):
+        calls.append(args[1].shape[1])
+        kwargs["interpret"] = True  # test runs on CPU
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(pa, "fused_attention", spy)
+
+    mha = MultiHeadAttention(num_q_channels=16, num_kv_channels=16, num_heads=2)
+    assert mha.attn_impl == "auto"
+    short = _rand(rng, 1, 8, 16)
+    long_kv = _rand(rng, 1, attn_mod.AUTO_PALLAS_MIN_KV, 16)
+    params = mha.init(jax.random.key(0), short, short)["params"]
+
+    # off-TPU: always xla, even at long KV
+    mha.apply({"params": params}, short, long_kv)
+    assert calls == []
+
+    monkeypatch.setattr(attn_mod.jax, "default_backend", lambda: "tpu")
+    mha.apply({"params": params}, short, short)
+    assert calls == []  # S=8 -> xla
+    mha.apply({"params": params}, short, long_kv)
+    assert calls == [attn_mod.AUTO_PALLAS_MIN_KV]
