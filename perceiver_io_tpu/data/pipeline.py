@@ -80,6 +80,7 @@ class DataLoader:
         # dataset's page cache / mmap state for free.
         self.num_workers = num_workers
         self.epoch = 0
+        self._skip = 0
 
     def __len__(self) -> int:
         n = len(self.dataset)
@@ -94,11 +95,19 @@ class DataLoader:
             return rng.permutation(n)
         return np.arange(n)
 
+    def skip_next(self, num_batches: int) -> None:
+        """Skip the first ``num_batches`` of the NEXT iteration — deterministic
+        mid-epoch resume: the skipped examples are never loaded, and the
+        remaining batches are exactly what an uninterrupted run would yield."""
+        self._skip = num_batches
+
     def _batches(self) -> Iterator[Batch]:
         # consume the epoch number up front so an early `break` (fixed-step
         # training loops) still advances the shuffle for the next iteration
         epoch = self.epoch
         self.epoch += 1
+        skip = self._skip
+        self._skip = 0
         idx = self._epoch_indices(epoch)
         n = len(idx)
         per_shard = self.batch_size // self.num_shards
@@ -109,7 +118,7 @@ class DataLoader:
             else None
         )
         try:
-            for start in range(0, max(stop, 0), self.batch_size):
+            for start in range(skip * self.batch_size, max(stop, 0), self.batch_size):
                 batch_idx = idx[start : start + self.batch_size]
                 # this host's contiguous slice of the global batch
                 local = batch_idx[self.shard_id * per_shard : (self.shard_id + 1) * per_shard]

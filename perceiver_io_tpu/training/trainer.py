@@ -220,6 +220,24 @@ class Trainer:
         self.logger.flush()
         return val_metrics
 
+    def test(self, test_loader) -> Dict[str, float]:
+        """One evaluation pass over a held-out split, logged as ``test_*``
+        (the reference's ``test_step``/``test_epoch`` path,
+        ``lightning.py:141-147`` — there the IMDB test split doubles as val,
+        ``imdb.py:133``, so this is the explicit variant)."""
+        if self._eval_step is None:
+            raise ValueError("Trainer.test() needs an eval_step; this trainer "
+                             "was constructed with eval_step=None")
+        metrics = {
+            k.replace("val_", "test_", 1): v
+            for k, v in self._run_eval(test_loader).items()
+        }
+        if metrics:
+            step_i = int(jax.device_get(self.state.step))
+            self.logger.log_scalars(step_i, metrics)
+            self.logger.flush()
+        return metrics
+
     # -- the loop ------------------------------------------------------------
 
     def fit(self, train_loader, val_loader=None):
@@ -233,6 +251,27 @@ class Trainer:
         epoch = 0
         done = False
         self._last_train_loss = float("nan")
+
+        # restoring a completed run is a no-op, not one extra step
+        if cfg.max_steps is not None and step_i >= cfg.max_steps:
+            return self.state
+
+        # Deterministic resume (SURVEY.md §5, failure detection): a restored
+        # state starts at step > 0 — fast-forward the loader to the epoch and
+        # in-epoch offset that step corresponds to, so the resumed run sees
+        # exactly the batches the uninterrupted run would have (the loader
+        # shuffles by seed ⊕ epoch, so epoch alignment is all it takes).
+        if step_i > 0:
+            try:
+                steps_per_epoch = len(train_loader)
+            except TypeError:
+                steps_per_epoch = 0
+            if steps_per_epoch > 0 and hasattr(train_loader, "epoch"):
+                epoch = step_i // steps_per_epoch
+                train_loader.epoch = epoch
+                skip = step_i % steps_per_epoch
+                if skip and hasattr(train_loader, "skip_next"):
+                    train_loader.skip_next(skip)
 
         window_start = time.perf_counter()
         window_steps = 0

@@ -247,3 +247,21 @@ def test_val_loader_keeps_partial_batches():
     batches = list(dm.val_dataloader())
     total = sum(len(b["label"]) for b in batches)
     assert total == len(dm.ds_valid)
+
+
+def test_loader_skip_next_resume_parity():
+    """skip_next(k) + epoch alignment reproduces an uninterrupted run's
+    stream exactly — the trainer's deterministic mid-epoch resume."""
+    mk = lambda: DataLoader(RangeDataset(40), batch_size=10, collate=collate_ids,
+                            shuffle=True, seed=7, prefetch=0)
+    full = mk()
+    stream = [b["x"] for _ in range(2) for b in full]  # 2 epochs, 8 batches
+
+    resumed = mk()
+    resumed.epoch = 1      # crash at global step 6 -> epoch 1, offset 2
+    resumed.skip_next(2)
+    tail = [b["x"] for b in resumed]
+    np.testing.assert_array_equal(np.stack(tail), np.stack(stream[6:8]))
+    # next epoch is clean (skip consumed once)
+    again = [b["x"] for b in resumed]
+    assert len(again) == 4

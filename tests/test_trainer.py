@@ -1,8 +1,10 @@
 """Trainer loop: logging, checkpointing, eval averaging, mesh mode."""
 
 import os
+import dataclasses
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -191,3 +193,75 @@ def test_eval_every_n_steps_checkpoints_tail(tmp_path):
 def test_config_requires_limit():
     with pytest.raises(ValueError):
         TrainerConfig()
+
+
+def test_resume_fast_forwards_data_stream(tmp_path):
+    """A restored trainer continues with exactly the batches the
+    uninterrupted run would have seen (loader epoch + offset fast-forward)."""
+    from perceiver_io_tpu.data.pipeline import DataLoader
+
+    class Records(list):
+        pass
+
+    def make_loader(log):
+        class Ds:
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return i
+
+        def collate(items):
+            log.append(tuple(items))
+            return {"x": np.asarray(items, np.float32)[:, None]}
+
+        return DataLoader(Ds(), batch_size=2, collate=collate,
+                          shuffle=True, seed=3, prefetch=0)
+
+    def make_trainer(logdir):
+        def train_step(state, batch):
+            new_params = jax.tree.map(lambda p: p - 0.0, state.params)
+            return state.replace(step=state.step + 1, params=new_params), {
+                "loss": jnp.sum(batch["x"]) * 0.0
+            }
+
+        tx, _ = make_optimizer(OptimizerConfig(learning_rate=1e-2))
+        state = TrainState.create({"w": jnp.zeros((1,))}, tx, jax.random.key(0))
+        cfg = TrainerConfig(max_steps=6, log_every_n_steps=100,
+                            logdir=logdir, experiment="r",
+                            use_tensorboard=False, compute_mfu=False)
+        return Trainer(train_step, None, state, cfg,
+                       example_batch={"x": np.zeros((2, 1), np.float32)})
+
+    # uninterrupted: 6 steps (epoch 0: 4 batches, epoch 1: 2 batches)
+    log_full = Records()
+    t1 = make_trainer(str(tmp_path / "full"))
+    with t1:
+        t1.fit(make_loader(log_full))
+
+    # interrupted at step 5 (mid-epoch-1), then resumed for step 6
+    log_a = Records()
+    t2 = make_trainer(str(tmp_path / "a"))
+    t2.config = dataclasses.replace(t2.config, max_steps=5)
+    with t2:
+        state5 = t2.fit(make_loader(log_a))
+
+    log_b = Records()
+    t3 = make_trainer(str(tmp_path / "b"))
+    t3.state = state5  # restored checkpoint
+    with t3:
+        t3.fit(make_loader(log_b))
+
+    np.testing.assert_array_equal(
+        np.asarray(log_a + log_b, object), np.asarray(log_full, object)
+    )
+
+
+def test_test_pass_logs_test_metrics(tmp_path):
+    trainer, (train_loader, val_loader) = _make_parts(tmp_path)
+    with trainer:
+        trainer.fit(train_loader, val_loader)
+        metrics = trainer.test(val_loader)
+    assert "test_loss" in metrics
+    logged = read_metrics(trainer.run_dir)
+    assert any("test_loss" in row for row in logged)

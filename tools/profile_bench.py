@@ -93,14 +93,22 @@ def run(attn_impl: str, batch_size=64, steps=20, gather=None):
     cost = compiled.cost_analysis()
     flops = cost.get("flops", 0.0) if cost else 0.0
 
+    # float() fetch is the only reliable sync on tunneled backends (PERF.md);
+    # the 1-step run subtracts the fetch round-trip.
     for _ in range(3):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
-    dt = (time.perf_counter() - t0) / steps
+    float(metrics["loss"])
+
+    def timed(n):
+        nonlocal state, metrics
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, metrics = step(state, batch)
+        float(metrics["loss"])
+        return time.perf_counter() - t0
+
+    t_one = timed(1)
+    dt = (timed(steps + 1) - t_one) / steps
 
     toks = batch_size * 512 / dt
     mfu = flops / dt / peak_flops()
