@@ -83,6 +83,11 @@ def add_compute_args(parser: argparse.ArgumentParser) -> None:
                         "Pallas kernel for long KV streams, XLA otherwise")
     g.add_argument("--remat", action="store_true",
                    help="rematerialize encoder layers (HBM for FLOPs)")
+    g.add_argument("--pad_vocab_multiple", type=int, default=None,
+                   help="round the vocab/class projection width up to this "
+                        "multiple (padded logits pinned to -1e30) so it "
+                        "divides the model mesh axis and tensor-shards under "
+                        "--tp; applies to MLM and classifier heads")
     g.add_argument("--seed", type=int, default=0)
 
 
@@ -182,6 +187,7 @@ def build_mlm(args, vocab_size: int, max_seq_len: int) -> pit.PerceiverMLM:
                 max_seq_len=max_seq_len,
                 num_output_channels=args.num_latent_channels,
                 dtype=dtype,
+                pad_classes_to=getattr(args, "pad_vocab_multiple", None),
             ),
             latent_shape=(args.num_latents, args.num_latent_channels),
             num_cross_attention_heads=args.num_cross_attention_heads,
@@ -207,6 +213,7 @@ def build_text_classifier(args, vocab_size: int, max_seq_len: int,
                 num_classes=num_classes,
                 num_output_channels=args.num_latent_channels,
                 dtype=dtype,
+                pad_classes_to=getattr(args, "pad_vocab_multiple", None),
             ),
             latent_shape=(args.num_latents, args.num_latent_channels),
             num_cross_attention_heads=args.num_cross_attention_heads,
@@ -245,6 +252,7 @@ def build_image_classifier(
                 num_classes=num_classes,
                 num_output_channels=args.num_latent_channels,
                 dtype=dtype,
+                pad_classes_to=getattr(args, "pad_vocab_multiple", None),
             ),
             latent_shape=(args.num_latents, args.num_latent_channels),
             num_cross_attention_heads=args.num_cross_attention_heads,

@@ -155,28 +155,53 @@ class ClassificationOutputAdapter(OutputAdapter):
 
     Reference ``adapter.py:136-149``: output_shape = (num_outputs, C_out) with
     C_out defaulting to num_classes; torch-default Linear init.
+
+    ``pad_classes_to``: round the projection width up to a multiple (e.g. 128
+    — one TPU lane tile), emitting logits of that padded width with the extra
+    entries pinned to a large negative so softmax/CE/argmax/top-k ignore
+    them. This is what makes the vocab projection *tensor-shardable*: the
+    reference vocab (10,003) divides no mesh axis, so without padding the
+    framework's biggest matmul stays replicated under tp > 1 (the
+    ``sharding_for_tree`` divisibility fallback). SURVEY.md §7's
+    "vocab-sharded output projection" hard part.
     """
 
     num_classes: int = 2
     num_outputs: int = 1
     num_output_channels: Optional[int] = None
     dtype: jnp.dtype = jnp.float32
+    pad_classes_to: Optional[int] = None
 
     @property
     def output_shape(self) -> Tuple[int, int]:
         c = self.num_output_channels if self.num_output_channels is not None else self.num_classes
         return (self.num_outputs, c)
 
+    @property
+    def padded_num_classes(self) -> int:
+        if self.pad_classes_to is None:
+            return self.num_classes
+        m = self.pad_classes_to
+        if m < 1:
+            raise ValueError(f"pad_classes_to must be >= 1, got {m}")
+        return ((self.num_classes + m - 1) // m) * m
+
     @nn.compact
     def __call__(self, x: Array) -> Array:
         c_in = self.output_shape[-1]
+        n_out = self.padded_num_classes
         x = nn.Dense(
-            self.num_classes,
+            n_out,
             dtype=self.dtype,
             kernel_init=torch_linear_kernel_init,
             bias_init=torch_linear_bias_init(c_in),
             name="linear",
         )(x)
+        if n_out != self.num_classes:
+            # finite stand-in for -inf: exp() underflows to exactly 0 in the
+            # downstream softmax/logsumexp, and no argmax/top-k can pick it
+            pad = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
+            x = jnp.where(pad < self.num_classes, x, jnp.asarray(-1e30, x.dtype))
         # Squeeze on the CONFIGURED query count, not the runtime shape: a
         # positions-gathered decode (PerceiverDecoder positions=...) may pass
         # K=1 rows of a multi-query adapter, which must stay (B, 1, C).
@@ -190,6 +215,7 @@ def TextOutputAdapter(
     max_seq_len: int,
     num_output_channels: Optional[int] = None,
     dtype: jnp.dtype = jnp.float32,
+    pad_classes_to: Optional[int] = None,
 ) -> ClassificationOutputAdapter:
     """Per-position vocab logits: a classification adapter with one output
     query per sequence position (reference ``adapter.py:152-159``)."""
@@ -198,4 +224,5 @@ def TextOutputAdapter(
         num_outputs=max_seq_len,
         num_output_channels=num_output_channels,
         dtype=dtype,
+        pad_classes_to=pad_classes_to,
     )

@@ -90,3 +90,49 @@ def test_text_output_adapter_keeps_positions(rng):
     x = rng.standard_normal((2, 12, 16)).astype(np.float32)
     out = adapter.apply(adapter.init(jax.random.key(0), x), x)
     assert out.shape == (2, 12, 100)
+
+
+def test_padded_classification_adapter_parity(rng):
+    """pad_classes_to pads the projection width; with the unpadded weights
+    embedded, logits/argmax/CE over the real classes are unchanged and the
+    padding can never win."""
+    from perceiver_io_tpu.training.losses import softmax_ce_integer
+
+    x = jnp.asarray(rng.standard_normal((2, 1, 32)).astype(np.float32))
+    base = ClassificationOutputAdapter(num_classes=10, num_output_channels=32)
+    padded = ClassificationOutputAdapter(
+        num_classes=10, num_output_channels=32, pad_classes_to=8
+    )
+    assert padded.padded_num_classes == 16
+
+    p_base = base.init(jax.random.key(0), x)["params"]
+    p_pad = padded.init(jax.random.key(1), x)["params"]
+    kernel = np.array(p_pad["linear"]["kernel"])
+    bias = np.array(p_pad["linear"]["bias"])
+    kernel[:, :10] = np.asarray(p_base["linear"]["kernel"])
+    bias[:10] = np.asarray(p_base["linear"]["bias"])
+    p_pad = {"linear": {"kernel": jnp.asarray(kernel), "bias": jnp.asarray(bias)}}
+
+    out_base = base.apply({"params": p_base}, x)
+    out_pad = padded.apply({"params": p_pad}, x)
+    assert out_pad.shape[-1] == 16
+    np.testing.assert_allclose(
+        np.asarray(out_pad[..., :10]), np.asarray(out_base), atol=1e-6
+    )
+    assert np.all(np.asarray(out_pad[..., 10:]) <= -1e29)
+
+    labels = jnp.asarray(rng.integers(0, 10, (2,)))
+    np.testing.assert_allclose(
+        np.asarray(softmax_ce_integer(out_pad, labels)),
+        np.asarray(softmax_ce_integer(out_base, labels)),
+        atol=1e-5,
+    )
+    assert np.array_equal(
+        np.argmax(np.asarray(out_pad), -1), np.argmax(np.asarray(out_base), -1)
+    )
+
+
+def test_pad_classes_to_validates():
+    bad = ClassificationOutputAdapter(num_classes=10, pad_classes_to=0)
+    with pytest.raises(ValueError, match="pad_classes_to"):
+        _ = bad.padded_num_classes

@@ -211,3 +211,47 @@ def test_image_classifier_sharded(rng):
     assert bshard["image"].spec == P(AXIS_DATA, "seq", None, None)
     _, sharded = _run(step, sstate, jax.device_put(batch, bshard))
     np.testing.assert_allclose(sharded, ref, atol=1e-5)
+
+
+def test_padded_vocab_projection_shards_under_tp(rng):
+    """pad_classes_to makes the vocab projection divisible by tp, so the
+    framework's biggest matmul tensor-shards instead of falling back to
+    replication (SURVEY.md §7 'vocab-sharded output projection')."""
+    vocab = 51  # divides nothing
+    enc = pit.PerceiverEncoder(
+        input_adapter=pit.TextInputAdapter(vocab_size=vocab, max_seq_len=L, num_channels=C),
+        latent_shape=(NLAT, C),
+        num_layers=2,
+    )
+    dec = pit.PerceiverDecoder(
+        output_adapter=pit.TextOutputAdapter(
+            vocab_size=vocab, max_seq_len=L, num_output_channels=C,
+            pad_classes_to=8,  # 51 -> 56 = 4 tp * 14
+        ),
+        latent_shape=(NLAT, C),
+    )
+    model = pit.PerceiverMLM(
+        encoder=enc, decoder=dec, masking=TextMasking(vocab, 1, 2, 3)
+    )
+    rng_np = np.random.default_rng(0)
+    ids = jnp.asarray(rng_np.integers(3, vocab, (16, L)).astype(np.int32))
+    pad = jnp.zeros((16, L), dtype=bool)
+    batch = {"token_ids": ids, "pad_mask": pad}
+    variables = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)}, ids, pad
+    )
+    tx, sched = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    train_step, _, _ = make_mlm_steps(model, sched)
+    state = TrainState.create(variables["params"], tx, jax.random.key(2))
+    fresh = lambda: jax.tree.map(jnp.copy, state)
+
+    _, ref = _run(jax.jit(train_step), fresh(), batch)
+
+    mesh = make_mesh(dp=2, tp=4, sp=1)
+    spec = sharding_for_tree(state.params, mesh)[
+        "decoder"]["output_adapter"]["linear"]["kernel"].spec
+    assert spec == P(None, AXIS_MODEL)  # 56 % 4 == 0: sharded, not replicated
+
+    step, sstate, bshard = make_sharded_train_step(train_step, mesh, fresh(), batch)
+    _, sharded = _run(step, sstate, jax.device_put(batch, bshard))
+    np.testing.assert_allclose(sharded, ref, atol=1e-5)
