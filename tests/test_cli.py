@@ -118,3 +118,20 @@ def test_train_imagenet(tmp_path):
     assert any("train_loss" in r for r in rows)
     assert any("val_loss" in r for r in rows)
     assert os.path.isdir(os.path.join(run_dir, "checkpoints"))
+
+
+def test_train_flow(tmp_path):
+    from perceiver_io_tpu.cli import train_flow
+
+    run_dir = train_flow.main(
+        _common(tmp_path, "flow") + TINY_MODEL + [
+            "--synthetic_size", "32", "--batch_size", "8",
+            "--image_height", "12", "--image_width", "16",
+            "--num_frequency_bands", "4",
+            "--max_epochs", "1", "--log_every_n_steps", "1",
+        ]
+    )
+    rows = read_metrics(run_dir)
+    assert any("train_loss" in r for r in rows)
+    assert any("val_loss" in r for r in rows)
+    assert os.path.isdir(os.path.join(run_dir, "checkpoints"))
