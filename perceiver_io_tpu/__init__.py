@@ -27,6 +27,15 @@ from perceiver_io_tpu.models.flow import (
     OpticalFlowInputAdapter,
     build_optical_flow_model,
 )
+from perceiver_io_tpu.models.multimodal import (
+    AudioInputAdapter,
+    AudioOutputAdapter,
+    MultimodalInputAdapter,
+    MultimodalOutputAdapter,
+    VideoInputAdapter,
+    VideoOutputAdapter,
+    build_multimodal_autoencoder,
+)
 from perceiver_io_tpu.models.perceiver import (
     PerceiverEncoder,
     PerceiverDecoder,
@@ -41,6 +50,13 @@ __all__ = [
     "DenseSpatialOutputAdapter",
     "OpticalFlowInputAdapter",
     "build_optical_flow_model",
+    "AudioInputAdapter",
+    "AudioOutputAdapter",
+    "MultimodalInputAdapter",
+    "MultimodalOutputAdapter",
+    "VideoInputAdapter",
+    "VideoOutputAdapter",
+    "build_multimodal_autoencoder",
     "InputAdapter",
     "OutputAdapter",
     "ImageInputAdapter",

@@ -23,6 +23,12 @@ from perceiver_io_tpu.data.mnist import (
     load_mnist,
     synthetic_digits,
 )
+from perceiver_io_tpu.data.av import (
+    AVDataModule,
+    AVDataset,
+    load_av_tree,
+    synthetic_av_clips,
+)
 from perceiver_io_tpu.data.imagefolder import (
     ImageFolderDataModule,
     ImageFolderDataset,
@@ -51,6 +57,10 @@ __all__ = [
     "MNISTDataset",
     "load_mnist",
     "synthetic_digits",
+    "AVDataModule",
+    "AVDataset",
+    "load_av_tree",
+    "synthetic_av_clips",
     "ImageFolderDataModule",
     "ImageFolderDataset",
     "SyntheticImageDataset",

@@ -12,6 +12,16 @@ from perceiver_io_tpu.models.flow import (
     build_optical_flow_model,
     end_point_error,
 )
+from perceiver_io_tpu.models.multimodal import (
+    AudioInputAdapter,
+    AudioOutputAdapter,
+    MultimodalInputAdapter,
+    MultimodalOutputAdapter,
+    VideoInputAdapter,
+    VideoOutputAdapter,
+    build_multimodal_autoencoder,
+    multimodal_autoencoding_loss,
+)
 from perceiver_io_tpu.models.perceiver import (
     PerceiverEncoder,
     PerceiverDecoder,
@@ -20,6 +30,14 @@ from perceiver_io_tpu.models.perceiver import (
 )
 
 __all__ = [
+    "AudioInputAdapter",
+    "AudioOutputAdapter",
+    "MultimodalInputAdapter",
+    "MultimodalOutputAdapter",
+    "VideoInputAdapter",
+    "VideoOutputAdapter",
+    "build_multimodal_autoencoder",
+    "multimodal_autoencoding_loss",
     "DenseSpatialOutputAdapter",
     "OpticalFlowInputAdapter",
     "build_optical_flow_model",

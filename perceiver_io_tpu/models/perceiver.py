@@ -117,9 +117,10 @@ class PerceiverEncoder(nn.Module):
 
     @nn.compact
     def __call__(self, x, pad_mask=None, deterministic=True):
-        b = x.shape[0]
-
+        # batch size comes from the adapted (B, M, C) stream, not the raw
+        # input — multimodal adapters take a dict of arrays
         x = self.input_adapter(x)
+        b = x.shape[0]
 
         latent = self.param("latent", latent_init(), self.latent_shape)
         x_latent = jnp.broadcast_to(latent.astype(self.dtype), (b, *self.latent_shape))

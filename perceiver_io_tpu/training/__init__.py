@@ -8,6 +8,7 @@ from perceiver_io_tpu.training.steps import (
     make_mlm_steps,
     make_classifier_steps,
     make_flow_steps,
+    make_multimodal_steps,
     freeze_subtrees,
     mlm_gather_capacity,
 )
@@ -41,5 +42,6 @@ __all__ = [
     "mlm_gather_capacity",
     "make_classifier_steps",
     "make_flow_steps",
+    "make_multimodal_steps",
     "freeze_subtrees",
 ]

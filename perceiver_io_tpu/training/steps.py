@@ -169,6 +169,44 @@ def make_classifier_steps(
     return train_step, eval_step
 
 
+def make_multimodal_steps(
+    model,
+    schedule: Optional[Schedule] = None,
+    video_weight: float = 1.0,
+    audio_weight: float = 1.0,
+    label_weight: float = 1.0,
+):
+    """(train_step, eval_step) for the multimodal autoencoder: batches
+    ``{'video': (B, T, H, W, C), 'audio': (B, S, C_a), 'label': (B,) int}``,
+    loss = weighted MSE(video) + MSE(audio) + CE(label)."""
+    from perceiver_io_tpu.models.multimodal import multimodal_autoencoding_loss
+
+    def loss_fn(params, batch, rngs, deterministic):
+        outputs = model.apply(
+            {"params": params},
+            {"video": batch["video"], "audio": batch["audio"]},
+            rngs=rngs,
+            deterministic=deterministic,
+        )
+        return multimodal_autoencoding_loss(
+            outputs, batch, video_weight, audio_weight, label_weight
+        )
+
+    def train_step(state: TrainState, batch) -> Tuple[TrainState, Metrics]:
+        rngs = state.step_rngs("dropout")
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch, rngs, False
+        )
+        metrics = {"loss": loss, **aux, **_lr_metric(schedule, state.step)}
+        return state.apply_gradients(grads), metrics
+
+    def eval_step(state: TrainState, batch) -> Metrics:
+        loss, aux = loss_fn(state.params, batch, {}, True)
+        return {"loss": loss, **aux}
+
+    return train_step, eval_step
+
+
 def make_flow_steps(model, schedule: Optional[Schedule] = None):
     """(train_step, eval_step) for an optical-flow ``PerceiverIO`` (dense
     2D-query decoder): batches ``{'frames': (B, 2, H, W, C), 'flow':

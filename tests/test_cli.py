@@ -120,6 +120,27 @@ def test_train_imagenet(tmp_path):
     assert os.path.isdir(os.path.join(run_dir, "checkpoints"))
 
 
+def test_train_multimodal(tmp_path):
+    from perceiver_io_tpu.cli import train_multimodal
+
+    run_dir = train_multimodal.main(
+        _common(tmp_path, "multimodal") + TINY_MODEL + [
+            "--synthetic_size", "32", "--batch_size", "8",
+            "--video_frames", "2", "--video_size", "8", "--video_channels", "1",
+            "--video_patch", "1", "4", "4",
+            "--audio_samples", "64", "--samples_per_patch", "8",
+            "--num_classes", "3", "--num_modality_channels", "4",
+            "--video_frequency_bands", "2", "--audio_frequency_bands", "2",
+            "--max_epochs", "1", "--log_every_n_steps", "1",
+        ]
+    )
+    rows = read_metrics(run_dir)
+    assert any("train_loss" in r for r in rows)
+    assert any("val_loss" in r for r in rows)
+    assert any("val_acc" in r for r in rows)
+    assert os.path.isdir(os.path.join(run_dir, "checkpoints"))
+
+
 def test_train_flow(tmp_path):
     from perceiver_io_tpu.cli import train_flow
 

@@ -1,0 +1,220 @@
+"""Multimodal audio/video adapters + Kinetics-style autoencoder (framework
+extension; second proof the adapter contract generalizes beyond the
+reference's text/image scope)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from perceiver_io_tpu.models.multimodal import (
+    AudioInputAdapter,
+    AudioOutputAdapter,
+    MultimodalInputAdapter,
+    MultimodalOutputAdapter,
+    VideoInputAdapter,
+    VideoOutputAdapter,
+    build_multimodal_autoencoder,
+    multimodal_autoencoding_loss,
+)
+from perceiver_io_tpu.models.adapters import ClassificationOutputAdapter
+from perceiver_io_tpu.training import (
+    TrainState,
+    make_multimodal_steps,
+)
+
+
+def test_audio_input_adapter_shape(rng):
+    adapter = AudioInputAdapter(
+        num_samples=64, samples_per_patch=8, num_audio_channels=2,
+        num_frequency_bands=4,
+    )
+    assert adapter.num_tokens == 8
+    assert adapter.num_input_channels == 8 * 2 + (2 * 4 + 1)
+    x = jnp.asarray(rng.normal(0, 1, (3, 64, 2)), jnp.float32)
+    out = adapter.apply({}, x)
+    assert out.shape == (3, 8, adapter.num_input_channels)
+    # first token's sample channels are the first 8 samples interleaved by channel
+    np.testing.assert_allclose(
+        np.asarray(out[0, 0, :16]), np.asarray(x[0, :8]).reshape(-1), atol=1e-6
+    )
+    with pytest.raises(ValueError):
+        adapter.apply({}, jnp.zeros((3, 65, 2)))
+    with pytest.raises(ValueError):
+        AudioInputAdapter(num_samples=65, samples_per_patch=8).num_tokens
+
+
+def test_video_input_adapter_patchify(rng):
+    adapter = VideoInputAdapter(
+        video_shape=(4, 8, 8, 3), patch_shape=(2, 4, 4), num_frequency_bands=4
+    )
+    assert adapter.grid_shape == (2, 2, 2)
+    assert adapter.num_tokens == 8
+    assert adapter.num_patch_channels == 2 * 4 * 4 * 3
+    x = jnp.asarray(rng.normal(0, 1, (2, 4, 8, 8, 3)), jnp.float32)
+    out = adapter.apply({}, x)
+    assert out.shape == (2, 8, adapter.num_input_channels)
+    # token 0 = voxels [t 0:2, h 0:4, w 0:4] in (t, h, w, c) order
+    expected = np.asarray(x[0, 0:2, 0:4, 0:4, :]).reshape(-1)
+    np.testing.assert_allclose(
+        np.asarray(out[0, 0, : adapter.num_patch_channels]), expected, atol=1e-6
+    )
+    with pytest.raises(ValueError):
+        adapter.apply({}, jnp.zeros((2, 4, 8, 9, 3)))
+
+
+def test_video_output_adapter_inverts_patchify(rng):
+    """VideoOutputAdapter's un-patchify must be the exact inverse of
+    VideoInputAdapter's patchify (identity head ⇒ reconstruction)."""
+    video_shape, patch_shape = (4, 8, 8, 3), (2, 4, 4)
+    in_adapter = VideoInputAdapter(
+        video_shape=video_shape, patch_shape=patch_shape, num_frequency_bands=2
+    )
+    voxels = int(np.prod(patch_shape)) * video_shape[-1]
+    out_adapter = VideoOutputAdapter(
+        video_shape=video_shape, patch_shape=patch_shape, num_output_channels=voxels
+    )
+    x = jnp.asarray(rng.normal(0, 1, (2, *video_shape)), jnp.float32)
+    tokens = in_adapter.apply({}, x)[..., :voxels]  # strip position encodings
+    params = {
+        "linear": {
+            "kernel": jnp.eye(voxels, dtype=jnp.float32),
+            "bias": jnp.zeros((voxels,), jnp.float32),
+        }
+    }
+    recon = out_adapter.apply({"params": params}, tokens)
+    np.testing.assert_allclose(np.asarray(recon), np.asarray(x), atol=1e-6)
+
+
+def test_multimodal_input_adapter_fuses_streams(rng):
+    video = VideoInputAdapter(
+        video_shape=(2, 4, 4, 1), patch_shape=(1, 2, 2), num_frequency_bands=2
+    )
+    audio = AudioInputAdapter(
+        num_samples=32, samples_per_patch=4, num_frequency_bands=2
+    )
+    fused = MultimodalInputAdapter(
+        adapters=(("video", video), ("audio", audio)), num_modality_channels=4
+    )
+    common = max(video.num_input_channels, audio.num_input_channels)
+    assert fused.num_input_channels == common + 4
+    assert fused.num_tokens == video.num_tokens + audio.num_tokens
+
+    batch = {
+        "video": jnp.asarray(rng.normal(0, 1, (2, 2, 4, 4, 1)), jnp.float32),
+        "audio": jnp.asarray(rng.normal(0, 1, (2, 32, 1)), jnp.float32),
+    }
+    params = fused.init({"params": jax.random.key(0)}, batch)["params"]
+    out = fused.apply({"params": params}, batch)
+    assert out.shape == (2, fused.num_tokens, fused.num_input_channels)
+    # modality embedding occupies the trailing channels of every token
+    v_emb = np.asarray(out[0, 0, -4:])
+    a_emb = np.asarray(out[0, video.num_tokens, -4:])
+    np.testing.assert_allclose(np.asarray(out[0, 1, -4:]), v_emb, atol=1e-6)
+    assert not np.allclose(v_emb, a_emb)
+
+
+def test_multimodal_output_adapter_routes_spans(rng):
+    audio = AudioOutputAdapter(
+        num_samples=32, samples_per_patch=4, num_output_channels=16
+    )
+    label = ClassificationOutputAdapter(
+        num_classes=5, num_outputs=1, num_output_channels=16
+    )
+    routed = MultimodalOutputAdapter(adapters=(("audio", audio), ("label", label)))
+    assert routed.output_shape == (8 + 1, 16)
+
+    x = jnp.asarray(rng.normal(0, 1, (2, 9, 16)), jnp.float32)
+    params = routed.init({"params": jax.random.key(0)}, x)["params"]
+    out = routed.apply({"params": params}, x)
+    assert out["audio"].shape == (2, 32, 1)
+    assert out["label"].shape == (2, 5)
+
+
+def test_multimodal_output_adapter_rejects_mixed_widths():
+    with pytest.raises(ValueError):
+        MultimodalOutputAdapter(
+            adapters=(
+                ("a", AudioOutputAdapter(num_samples=8, samples_per_patch=4,
+                                         num_output_channels=16)),
+                ("b", ClassificationOutputAdapter(num_classes=3, num_outputs=1,
+                                                  num_output_channels=8)),
+            )
+        ).output_shape
+
+
+def _tiny_autoencoder():
+    return build_multimodal_autoencoder(
+        video_shape=(2, 8, 8, 1),
+        num_audio_samples=64,
+        samples_per_patch=8,
+        num_classes=3,
+        latent_shape=(8, 32),
+        video_patch_shape=(1, 4, 4),
+        num_self_attention_layers_per_block=1,
+        num_self_attention_heads=2,
+        num_modality_channels=4,
+        video_frequency_bands=2,
+        audio_frequency_bands=2,
+    )
+
+
+def test_autoencoder_forward_shapes(rng):
+    model = _tiny_autoencoder()
+    batch = {
+        "video": jnp.asarray(rng.normal(0, 1, (2, 2, 8, 8, 1)), jnp.float32),
+        "audio": jnp.asarray(rng.normal(0, 1, (2, 64, 1)), jnp.float32),
+    }
+    params = model.init({"params": jax.random.key(0)}, batch)["params"]
+    out = model.apply({"params": params}, batch)
+    assert out["video"].shape == (2, 2, 8, 8, 1)
+    assert out["audio"].shape == (2, 64, 1)
+    assert out["label"].shape == (2, 3)
+    for v in out.values():
+        assert np.isfinite(np.asarray(v)).all()
+
+
+def test_autoencoder_learns(rng):
+    model = _tiny_autoencoder()
+    batch = {
+        "video": jnp.asarray(rng.normal(0, 1, (4, 2, 8, 8, 1)), jnp.float32),
+        "audio": jnp.asarray(rng.normal(0, 1, (4, 64, 1)), jnp.float32),
+        "label": jnp.asarray([0, 1, 2, 0], jnp.int32),
+    }
+    params = model.init(
+        {"params": jax.random.key(0)},
+        {"video": batch["video"], "audio": batch["audio"]},
+    )["params"]
+    state = TrainState.create(params, optax.adam(1e-3), jax.random.key(1))
+    train_step, eval_step = make_multimodal_steps(model)
+    step = jax.jit(train_step)
+
+    losses = []
+    for _ in range(15):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0]
+    assert {"video_loss", "audio_loss", "label_loss", "acc"} <= metrics.keys()
+
+    ev = eval_step(state, batch)
+    assert np.isfinite(float(ev["loss"]))
+
+
+def test_loss_weights():
+    outputs = {
+        "video": jnp.zeros((1, 1, 2, 2, 1)),
+        "audio": jnp.zeros((1, 4, 1)),
+        "label": jnp.asarray([[10.0, 0.0]]),
+    }
+    batch = {
+        "video": jnp.ones((1, 1, 2, 2, 1)),
+        "audio": jnp.ones((1, 4, 1)) * 2,
+        "label": jnp.asarray([0], jnp.int32),
+    }
+    loss, metrics = multimodal_autoencoding_loss(
+        outputs, batch, video_weight=2.0, audio_weight=0.5, label_weight=1.0
+    )
+    expected = 2.0 * 1.0 + 0.5 * 4.0 + float(metrics["label_loss"])
+    np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
+    assert float(metrics["acc"]) == 1.0

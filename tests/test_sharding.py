@@ -255,3 +255,44 @@ def test_padded_vocab_projection_shards_under_tp(rng):
     step, sstate, bshard = make_sharded_train_step(train_step, mesh, fresh(), batch)
     _, sharded = _run(step, sstate, jax.device_put(batch, bshard))
     np.testing.assert_allclose(sharded, ref, atol=1e-5)
+
+
+def test_multimodal_autoencoder_sharded(rng):
+    from perceiver_io_tpu.models.multimodal import build_multimodal_autoencoder
+    from perceiver_io_tpu.training import make_multimodal_steps
+
+    model = build_multimodal_autoencoder(
+        video_shape=(2, 8, 8, 1),
+        num_audio_samples=64,
+        samples_per_patch=8,
+        num_classes=3,
+        latent_shape=(8, 32),
+        video_patch_shape=(1, 4, 4),
+        num_self_attention_layers_per_block=1,
+        num_self_attention_heads=2,
+        num_modality_channels=4,
+        video_frequency_bands=2,
+        audio_frequency_bands=2,
+    )
+    batch = {
+        "video": jnp.asarray(rng.normal(0, 1, (8, 2, 8, 8, 1)).astype(np.float32)),
+        "audio": jnp.asarray(rng.normal(0, 1, (8, 64, 1)).astype(np.float32)),
+        "label": jnp.asarray(rng.integers(0, 3, 8).astype(np.int32)),
+    }
+    variables = model.init(
+        {"params": jax.random.key(0)},
+        {"video": batch["video"], "audio": batch["audio"]},
+    )
+    tx, _ = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    state = TrainState.create(variables["params"], tx, jax.random.key(1))
+    train_step, _ = make_multimodal_steps(model)
+    fresh = lambda: jax.tree.map(jnp.copy, state)
+
+    _, ref = _run(jax.jit(train_step), fresh(), batch)
+
+    # dict-input batches shard on the data axis; params/optimizer follow the
+    # standard tp rules (attention/MLP widths)
+    mesh = make_mesh(dp=4, tp=2, sp=1)
+    step, sstate, bshard = make_sharded_train_step(train_step, mesh, fresh(), batch)
+    _, sharded = _run(step, sstate, jax.device_put(batch, bshard))
+    np.testing.assert_allclose(sharded, ref, atol=1e-5)

@@ -1,0 +1,12 @@
+#!/usr/bin/env python
+"""Multimodal audio/video autoencoding + classification (framework extension)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from perceiver_io_tpu.cli.train_multimodal import main
+
+if __name__ == "__main__":
+    main()
