@@ -42,6 +42,12 @@ from perceiver_io_tpu.models.perceiver import (
     PerceiverIO,
     PerceiverMLM,
 )
+from perceiver_io_tpu.inference import (
+    MLMPredictor,
+    Predictor,
+    export_forward,
+    load_exported,
+)
 from perceiver_io_tpu.ops.masking import TextMasking
 
 __version__ = "0.1.0"
@@ -68,4 +74,8 @@ __all__ = [
     "PerceiverIO",
     "PerceiverMLM",
     "TextMasking",
+    "MLMPredictor",
+    "Predictor",
+    "export_forward",
+    "load_exported",
 ]

@@ -15,7 +15,7 @@ Usage (mirroring the reference README):
 from __future__ import annotations
 
 import argparse
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 import jax
 import numpy as np
@@ -55,25 +55,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def encode_masked_samples(collator, samples: Sequence[str]):
-    """Encode raw strings containing the ``[MASK]`` literal, splicing in the
-    mask token id (the tokenizer treats specials as plain text)."""
-    tokenizer = collator.tokenizer
-    mask_id = tokenizer.token_to_id(MASK_TOKEN)
-    width = collator.max_seq_len
-    rows: List[List[int]] = []
-    for text in samples:
-        ids: List[int] = []
-        pieces = text.split(MASK_TOKEN)
-        for i, piece in enumerate(pieces):
-            if i > 0:
-                ids.append(mask_id)
-            if piece.strip():
-                ids.extend(tokenizer.encode_ids(piece))
-        rows.append(ids[:width])
-    token_ids = np.full((len(rows), width), collator.pad_id, dtype=np.int32)
-    for i, ids in enumerate(rows):
-        token_ids[i, : len(ids)] = ids
-    return token_ids, token_ids == collator.pad_id
+    """Encode raw strings containing the ``[MASK]`` literal
+    (see :func:`perceiver_io_tpu.inference.encode_masked_texts`)."""
+    from perceiver_io_tpu.inference import encode_masked_texts
+
+    return encode_masked_texts(collator.tokenizer, samples, collator.max_seq_len)
 
 
 def make_predict_hook(predict_fn, collator, samples: Sequence[str], k: int):

@@ -198,10 +198,18 @@ class AVDataModule:
             )
             self.num_classes = len(classes)
             try:
-                vv, av_, lv, _ = load_av_tree(
+                vv, av_, lv, val_classes = load_av_tree(
                     av, "val", self.video_shape,
                     self.num_audio_samples, self.num_audio_channels,
                 )
+                # label ids come from each split's own sorted class dirs; a
+                # val split missing (or adding) a class would silently shift
+                # every val label
+                if val_classes != classes:
+                    raise ValueError(
+                        f"train/val class mismatch under {av}: "
+                        f"train={classes} val={val_classes}"
+                    )
             except FileNotFoundError:
                 # no val split on disk: hold out a seeded-shuffled tail (the
                 # tree reader returns clips class-by-class, so an unshuffled

@@ -1,0 +1,17 @@
+from perceiver_io_tpu.inference.predictor import Predictor, bucket_size
+from perceiver_io_tpu.inference.export import (
+    export_fn,
+    export_forward,
+    load_exported,
+)
+from perceiver_io_tpu.inference.mlm import MLMPredictor, encode_masked_texts
+
+__all__ = [
+    "Predictor",
+    "bucket_size",
+    "export_fn",
+    "export_forward",
+    "load_exported",
+    "MLMPredictor",
+    "encode_masked_texts",
+]

@@ -1,0 +1,63 @@
+"""Ahead-of-time model export via ``jax.export`` (StableHLO serialization).
+
+The reference has no export path (SURVEY.md §3.4); the TPU-native story is
+XLA's own portable artifact: lower the jitted forward once, serialize the
+StableHLO + calling convention to bytes, and reload it anywhere a JAX runtime
+exists — no Python model code, flax, or this framework needed at load time.
+``platforms`` allows cross-lowering (e.g. export for TPU from a CPU host).
+
+Params are baked into the artifact as constants, making it self-contained —
+the serving analogue of a frozen graph. For weight-hot-swap serving keep
+params as an argument instead: ``export_fn(fn, (params, *inputs), ...)``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax import export as jax_export
+
+
+def export_fn(
+    fn,
+    example_args: Tuple,
+    path: Optional[str] = None,
+    platforms: Optional[Sequence[str]] = None,
+):
+    """Lower ``fn(*example_args)`` and serialize. Returns the ``Exported``;
+    writes the serialized bytes to ``path`` when given."""
+    exported = jax_export.export(
+        jax.jit(fn), platforms=list(platforms) if platforms else None
+    )(*example_args)
+    if path is not None:
+        with open(path, "wb") as f:
+            f.write(exported.serialize())
+    return exported
+
+
+def export_forward(
+    model,
+    params,
+    example_inputs: Tuple,
+    path: Optional[str] = None,
+    platforms: Optional[Sequence[str]] = None,
+    **apply_kwargs,
+):
+    """Export ``model.apply`` in inference mode with ``params`` baked in as
+    constants (self-contained artifact)."""
+
+    def fn(*inputs):
+        return model.apply(
+            {"params": params}, *inputs, deterministic=True, **apply_kwargs
+        )
+
+    return export_fn(fn, example_inputs, path=path, platforms=platforms)
+
+
+def load_exported(path: str):
+    """Deserialize an exported artifact; returns a callable running it under
+    jit on the current backend."""
+    with open(path, "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    return exported.call

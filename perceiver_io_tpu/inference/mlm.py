@@ -1,0 +1,113 @@
+"""Fill-mask inference: the reference's ``predict_samples`` path
+(``train/train_mlm.py:14-35``) promoted from a training-loop logging hook to a
+standalone serving API, checkpoint-loadable.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from perceiver_io_tpu.data.tokenizer import MASK_TOKEN, PAD_TOKEN, WordPieceTokenizer
+from perceiver_io_tpu.inference.predictor import Predictor
+
+Array = jax.Array
+
+
+def encode_masked_texts(
+    tokenizer: WordPieceTokenizer, texts: Sequence[str], max_seq_len: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Encode raw strings containing the ``[MASK]`` literal, splicing in the
+    mask token id (the tokenizer treats specials as plain text). Returns
+    ``(token_ids, pad_mask)`` at fixed width ``max_seq_len``."""
+    mask_id = tokenizer.token_to_id(MASK_TOKEN)
+    pad_id = tokenizer.token_to_id(PAD_TOKEN)
+    rows: List[List[int]] = []
+    for text in texts:
+        ids: List[int] = []
+        pieces = text.split(MASK_TOKEN)
+        for i, piece in enumerate(pieces):
+            if i > 0:
+                ids.append(mask_id)
+            if piece.strip():
+                ids.extend(tokenizer.encode_ids(piece))
+        rows.append(ids[:max_seq_len])
+    token_ids = np.full((len(rows), max_seq_len), pad_id, dtype=np.int32)
+    for i, ids in enumerate(rows):
+        token_ids[i, : len(ids)] = ids
+    return token_ids, token_ids == pad_id
+
+
+class MLMPredictor:
+    """Top-k fill-mask predictions from a ``PerceiverMLM`` + tokenizer."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        tokenizer: WordPieceTokenizer,
+        max_seq_len: int,
+        max_batch: int = 64,
+    ):
+        self.model = model
+        self.tokenizer = tokenizer
+        self.max_seq_len = max_seq_len
+        self.mask_id = tokenizer.token_to_id(MASK_TOKEN)
+        self._predictor = Predictor.for_model(
+            model, params, max_batch=max_batch, masking=False
+        )
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        checkpoint_dir: str,
+        tokenizer: WordPieceTokenizer,
+        step: Optional[int] = None,
+        max_batch: int = 64,
+    ) -> "MLMPredictor":
+        """Rebuild the model from the hparams embedded in the checkpoint
+        (``save_hyperparameters`` parity) and restore its best/chosen step."""
+        from perceiver_io_tpu.cli import common
+        from perceiver_io_tpu.training.checkpoint import load_hparams, restore_params
+
+        hparams = load_hparams(checkpoint_dir)
+        args = SimpleNamespace(**hparams)
+        vocab_size = tokenizer.get_vocab_size()
+        max_seq_len = hparams["max_seq_len"]
+        model = common.build_mlm(args, vocab_size, max_seq_len)
+
+        ids = np.zeros((1, max_seq_len), np.int32)
+        pad = np.zeros((1, max_seq_len), bool)
+        like = jax.eval_shape(
+            lambda: model.init(
+                {"params": jax.random.key(0), "masking": jax.random.key(1)},
+                ids, pad,
+            )
+        )["params"]
+        params = restore_params(checkpoint_dir, like, step=step)
+        return cls(model, params, tokenizer, max_seq_len, max_batch=max_batch)
+
+    def logits(self, texts: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+        """(logits (B, L, vocab), token_ids (B, L)) for raw masked texts."""
+        token_ids, pad_mask = encode_masked_texts(
+            self.tokenizer, texts, self.max_seq_len
+        )
+        logits, _ = self._predictor(token_ids, pad_mask)
+        return np.asarray(logits, np.float32), token_ids
+
+    def fill_masks(self, texts: Sequence[str], k: int = 5) -> List[List[List[str]]]:
+        """Per text, per ``[MASK]`` occurrence (in order), the top-k predicted
+        tokens (reference ``train_mlm.py:24-35`` semantics, all positions)."""
+        logits, token_ids = self.logits(texts)
+        out: List[List[List[str]]] = []
+        for row in range(len(texts)):
+            positions = np.nonzero(token_ids[row] == self.mask_id)[0]
+            row_preds = []
+            for pos in positions:
+                top = np.argsort(-logits[row, pos])[:k]
+                row_preds.append([self.tokenizer.id_to_token(int(t)) for t in top])
+            out.append(row_preds)
+        return out

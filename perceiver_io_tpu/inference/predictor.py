@@ -1,0 +1,95 @@
+"""Batched inference wrapper with compile-stable batch bucketing.
+
+The reference has no inference API beyond an inline predict helper
+(``train/train_mlm.py:14-35``; SURVEY.md §3.4: "no serve()/export path").
+On TPU the naive approach — jit the forward and call it on whatever batch
+arrives — recompiles on every new batch size (XLA programs have static
+shapes). ``Predictor`` makes serving shapes compile-stable: requests are
+padded up to the next power-of-two bucket (one compilation per bucket,
+log₂(max_batch) programs total) and oversized requests are chunked at
+``max_batch``, so steady-state serving never recompiles.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+
+Array = jax.Array
+
+
+def bucket_size(n: int, max_batch: int) -> int:
+    """Smallest power of two ≥ n, capped at ``max_batch``."""
+    if n <= 0:
+        raise ValueError(f"batch size must be positive, got {n}")
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, max_batch)
+
+
+class Predictor:
+    """Wrap a pure ``(params, *batched_arrays) → pytree`` forward for serving.
+
+    - pads every input's leading axis to a power-of-two bucket (padding rows
+      repeat row 0, and are sliced off every output leaf), so each bucket
+      compiles exactly once;
+    - chunks requests larger than ``max_batch`` and concatenates the results;
+    - ``donate_params=False`` always: params live on device across calls.
+
+    ``apply_fn`` must treat examples independently along the leading axis
+    (true of every model in this framework — no cross-batch interaction).
+    """
+
+    def __init__(
+        self,
+        apply_fn: Callable[..., Any],
+        params,
+        max_batch: int = 64,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.params = params
+        self.max_batch = max_batch
+        self._jitted = jax.jit(apply_fn)
+
+    @classmethod
+    def for_model(cls, model, params, max_batch: int = 64, **apply_kwargs):
+        """Predictor over ``model.apply`` with dropout off (inference mode)."""
+
+        def apply_fn(p, *inputs):
+            return model.apply(
+                {"params": p}, *inputs, deterministic=True, **apply_kwargs
+            )
+
+        return cls(apply_fn, params, max_batch=max_batch)
+
+    def _call_padded(self, inputs: Sequence[np.ndarray], n: int):
+        bucket = bucket_size(n, self.max_batch)
+        padded = []
+        for x in inputs:
+            x = np.asarray(x)
+            if x.shape[0] != n:
+                raise ValueError(
+                    f"all inputs must share the leading batch axis: {x.shape[0]} != {n}"
+                )
+            if bucket > n:
+                fill = np.broadcast_to(x[:1], (bucket - n, *x.shape[1:]))
+                x = np.concatenate([x, fill], axis=0)
+            padded.append(x)
+        out = self._jitted(self.params, *padded)
+        return jax.tree.map(lambda leaf: np.asarray(jax.device_get(leaf))[:n], out)
+
+    def __call__(self, *inputs):
+        n = np.asarray(inputs[0]).shape[0]
+        if n <= self.max_batch:
+            return self._call_padded(inputs, n)
+        # oversized request: fixed-size chunks (+ one padded tail bucket)
+        host_inputs = [np.asarray(x) for x in inputs]
+        chunks = []
+        for start in range(0, n, self.max_batch):
+            sl = [x[start : start + self.max_batch] for x in host_inputs]
+            chunks.append(self._call_padded(sl, sl[0].shape[0]))
+        return jax.tree.map(lambda *leaves: np.concatenate(leaves, axis=0), *chunks)

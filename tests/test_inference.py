@@ -1,0 +1,220 @@
+"""Inference subsystem: batch-bucketed Predictor, fill-mask API, AOT export."""
+
+import numpy as np
+import optax
+import pytest
+import jax
+import jax.numpy as jnp
+
+import perceiver_io_tpu as pit
+from perceiver_io_tpu.data.tokenizer import (
+    MASK_TOKEN,
+    PAD_TOKEN,
+    UNK_TOKEN,
+    WordPieceTokenizer,
+)
+from perceiver_io_tpu.inference import (
+    MLMPredictor,
+    Predictor,
+    bucket_size,
+    encode_masked_texts,
+    export_forward,
+    load_exported,
+)
+from perceiver_io_tpu.ops.masking import TextMasking
+
+
+def test_bucket_size():
+    assert bucket_size(1, 64) == 1
+    assert bucket_size(3, 64) == 4
+    assert bucket_size(8, 64) == 8
+    assert bucket_size(100, 64) == 64
+    with pytest.raises(ValueError):
+        bucket_size(0, 64)
+
+
+def _tiny_classifier():
+    enc = pit.PerceiverEncoder(
+        input_adapter=pit.ImageInputAdapter(image_shape=(6, 6, 1), num_frequency_bands=3),
+        latent_shape=(4, 16),
+        num_layers=1,
+        num_self_attention_layers_per_block=1,
+        num_cross_attention_heads=2,
+        num_self_attention_heads=2,
+    )
+    dec = pit.PerceiverDecoder(
+        output_adapter=pit.ClassificationOutputAdapter(num_classes=3, num_output_channels=16),
+        latent_shape=(4, 16),
+        num_cross_attention_heads=2,
+    )
+    return pit.PerceiverIO(encoder=enc, decoder=dec)
+
+
+def test_predictor_bucketing_matches_direct(rng):
+    model = _tiny_classifier()
+    x = jnp.asarray(rng.normal(0, 1, (16, 6, 6, 1)), jnp.float32)
+    params = model.init({"params": jax.random.key(0)}, x)["params"]
+    direct = np.asarray(model.apply({"params": params}, x))
+
+    pred = Predictor.for_model(model, params, max_batch=8)
+    # padded bucket (5 → 8), exact bucket, chunked oversize (16 → 2×8)
+    for n in (5, 8, 16):
+        out = pred(np.asarray(x[:n]))
+        assert out.shape == (n, 3)
+        np.testing.assert_allclose(out, direct[:n], atol=1e-5)
+
+    with pytest.raises(ValueError):
+        pred(np.asarray(x[:3]), np.asarray(x[:2]))
+
+
+def test_predictor_pytree_outputs(rng):
+    """Dict-returning models (multimodal) slice/concat per leaf."""
+    from perceiver_io_tpu.models.multimodal import build_multimodal_autoencoder
+
+    model = build_multimodal_autoencoder(
+        video_shape=(2, 8, 8, 1), num_audio_samples=32, samples_per_patch=8,
+        num_classes=3, latent_shape=(4, 16), video_patch_shape=(1, 4, 4),
+        num_self_attention_layers_per_block=1, num_self_attention_heads=2,
+        num_modality_channels=4, video_frequency_bands=2, audio_frequency_bands=2,
+    )
+    batch = {
+        "video": jnp.asarray(rng.normal(0, 1, (5, 2, 8, 8, 1)), jnp.float32),
+        "audio": jnp.asarray(rng.normal(0, 1, (5, 32, 1)), jnp.float32),
+    }
+    params = model.init({"params": jax.random.key(0)}, batch)["params"]
+
+    def apply_fn(p, video, audio):
+        return model.apply({"params": p}, {"video": video, "audio": audio})
+
+    pred = Predictor(apply_fn, params, max_batch=4)  # 5 → chunk 4 + pad 1
+    out = pred(np.asarray(batch["video"]), np.asarray(batch["audio"]))
+    assert out["video"].shape == (5, 2, 8, 8, 1)
+    assert out["label"].shape == (5, 3)
+    direct = model.apply({"params": params}, batch)
+    np.testing.assert_allclose(out["label"], np.asarray(direct["label"]), atol=1e-5)
+
+
+def _word_tokenizer():
+    words = ["movie", "great", "terrible", "watch", "the", "was"]
+    vocab = {PAD_TOKEN: 0, UNK_TOKEN: 1, MASK_TOKEN: 2}
+    for w in words:
+        vocab[w] = len(vocab)
+    return WordPieceTokenizer(vocab=vocab)
+
+
+def test_encode_masked_texts():
+    tok = _word_tokenizer()
+    ids, pad = encode_masked_texts(tok, ["the movie was [MASK]"], 8)
+    assert ids.shape == (1, 8)
+    mask_id = tok.token_to_id(MASK_TOKEN)
+    assert list(ids[0, :4]) == [
+        tok.token_to_id("the"), tok.token_to_id("movie"),
+        tok.token_to_id("was"), mask_id,
+    ]
+    assert pad[0, 4:].all() and not pad[0, :4].any()
+
+
+def _tiny_mlm(vocab_size, max_seq_len=8):
+    c = 16
+    return pit.PerceiverMLM(
+        encoder=pit.PerceiverEncoder(
+            input_adapter=pit.TextInputAdapter(
+                vocab_size=vocab_size, max_seq_len=max_seq_len, num_channels=c
+            ),
+            latent_shape=(4, c),
+            num_layers=1,
+            num_self_attention_layers_per_block=1,
+            num_cross_attention_heads=2,
+            num_self_attention_heads=2,
+        ),
+        decoder=pit.PerceiverDecoder(
+            output_adapter=pit.TextOutputAdapter(
+                vocab_size=vocab_size, max_seq_len=max_seq_len, num_output_channels=c
+            ),
+            latent_shape=(4, c),
+            num_cross_attention_heads=2,
+        ),
+        masking=TextMasking(vocab_size, 1, 2, 3),
+    )
+
+
+def test_mlm_fill_masks_learns_pattern():
+    tok = _word_tokenizer()
+    vocab = tok.get_vocab_size()
+    model = _tiny_mlm(vocab)
+    # corpus where [MASK] after "was" is always "great"
+    ids, pad = encode_masked_texts(tok, ["the movie was great"] * 8, 8)
+    params = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        jnp.asarray(ids), jnp.asarray(pad),
+    )["params"]
+
+    # supervised overfit: predict the clean sequence from itself (no masking)
+    tx = optax.adam(5e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt):
+        def loss_fn(p):
+            logits, _ = model.apply(
+                {"params": p}, jnp.asarray(ids), jnp.asarray(pad), masking=False
+            )
+            labels = jnp.asarray(ids)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+            return jnp.mean(jnp.where(jnp.asarray(pad), 0.0, ce))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt = tx.update(grads, opt)
+        return optax.apply_updates(params, updates), opt, loss
+
+    for _ in range(60):
+        params, opt, loss = step(params, opt)
+
+    pred = MLMPredictor(model, params, tok, max_seq_len=8, max_batch=4)
+    preds = pred.fill_masks(["the movie was [MASK]"], k=2)
+    assert len(preds) == 1 and len(preds[0]) == 1
+    assert preds[0][0][0] == "great"
+
+
+def test_mlm_predictor_from_checkpoint(tmp_path):
+    """End-to-end: train a tiny MLM via the CLI, reload it by checkpoint dir."""
+    from perceiver_io_tpu.cli import train_mlm
+    from perceiver_io_tpu.data.tokenizer import load_tokenizer
+    import glob
+    import os
+
+    run_dir = train_mlm.main([
+        "--synthetic", "--logdir", str(tmp_path / "logs"),
+        "--root", str(tmp_path / "cache"),
+        "--num_latents", "4", "--num_latent_channels", "16",
+        "--num_encoder_layers", "1", "--num_self_attention_layers_per_block", "1",
+        "--num_cross_attention_heads", "2", "--num_self_attention_heads", "2",
+        "--dtype", "float32",
+        "--synthetic_size", "64", "--batch_size", "16",
+        "--max_seq_len", "32", "--vocab_size", "120",
+        "--max_steps", "2", "--log_every_n_steps", "1",
+        "--num_predictions", "2",
+    ])
+    tok_path = glob.glob(str(tmp_path / "cache" / "*tokenizer*.json"))[0]
+    tok = load_tokenizer(tok_path)
+    pred = MLMPredictor.from_checkpoint(
+        os.path.join(run_dir, "checkpoints"), tok, max_batch=4
+    )
+    preds = pred.fill_masks(["a [MASK] b", "no mask here"], k=3)
+    assert len(preds) == 2
+    assert len(preds[0]) == 1 and len(preds[0][0]) == 3
+    assert preds[1] == []
+    assert all(isinstance(t, str) for t in preds[0][0])
+
+
+def test_export_roundtrip(rng, tmp_path):
+    model = _tiny_classifier()
+    x = jnp.asarray(rng.normal(0, 1, (2, 6, 6, 1)), jnp.float32)
+    params = model.init({"params": jax.random.key(0)}, x)["params"]
+    direct = np.asarray(model.apply({"params": params}, x))
+
+    path = str(tmp_path / "clf.stablehlo")
+    export_forward(model, params, (x,), path=path)
+    restored = load_exported(path)
+    out = np.asarray(restored(x))
+    np.testing.assert_allclose(out, direct, atol=1e-5)
