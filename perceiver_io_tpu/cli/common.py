@@ -45,6 +45,11 @@ def add_optimizer_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--weight_decay", type=float, default=0.0)
     g.add_argument("--one_cycle_lr", action="store_true")
     g.add_argument("--one_cycle_pct_start", type=float, default=0.1)
+    g.add_argument("--grad_clip_norm", type=float, default=None,
+                   help="clip gradients to this global norm before the update")
+    g.add_argument("--accumulate_steps", type=int, default=1,
+                   help="average gradients over N micro-batches per optimizer "
+                        "update (effective batch = N * batch_size)")
 
 
 def add_trainer_args(parser: argparse.ArgumentParser) -> None:
@@ -137,6 +142,8 @@ def optimizer_from_args(args):
             one_cycle_lr=args.one_cycle_lr,
             one_cycle_pct_start=args.one_cycle_pct_start,
             max_steps=args.max_steps,
+            grad_clip_norm=getattr(args, "grad_clip_norm", None),
+            accumulate_steps=getattr(args, "accumulate_steps", 1),
         )
     )
 

@@ -66,30 +66,41 @@ class Predictor:
 
         return cls(apply_fn, params, max_batch=max_batch)
 
-    def _call_padded(self, inputs: Sequence[np.ndarray], n: int):
-        bucket = bucket_size(n, self.max_batch)
+    def _dispatch_padded(self, inputs: Sequence[np.ndarray], n: int):
+        """Pad to the bucket and dispatch; returns the on-device output
+        (not fetched — JAX dispatch is async, so callers can queue several
+        chunks before the first host transfer)."""
+        fill_rows = 1 if n == 0 else 0  # empty request: run one dummy row
+        bucket = bucket_size(n + fill_rows, self.max_batch)
         padded = []
         for x in inputs:
-            x = np.asarray(x)
             if x.shape[0] != n:
                 raise ValueError(
                     f"all inputs must share the leading batch axis: {x.shape[0]} != {n}"
                 )
             if bucket > n:
-                fill = np.broadcast_to(x[:1], (bucket - n, *x.shape[1:]))
-                x = np.concatenate([x, fill], axis=0)
+                row = x[:1] if n else np.zeros((1, *x.shape[1:]), x.dtype)
+                x = np.concatenate(
+                    [x, np.broadcast_to(row, (bucket - n, *x.shape[1:]))], axis=0
+                )
             padded.append(x)
-        out = self._jitted(self.params, *padded)
-        return jax.tree.map(lambda leaf: np.asarray(jax.device_get(leaf))[:n], out)
+        return self._jitted(self.params, *padded)
 
     def __call__(self, *inputs):
-        n = np.asarray(inputs[0]).shape[0]
-        if n <= self.max_batch:
-            return self._call_padded(inputs, n)
-        # oversized request: fixed-size chunks (+ one padded tail bucket)
         host_inputs = [np.asarray(x) for x in inputs]
-        chunks = []
+        n = host_inputs[0].shape[0]
+        if n <= self.max_batch:
+            out = self._dispatch_padded(host_inputs, n)
+            return jax.tree.map(lambda leaf: np.asarray(jax.device_get(leaf))[:n], out)
+        # oversized request: fixed-size chunks (+ one padded tail bucket);
+        # dispatch everything first, fetch after — overlaps host transfer of
+        # chunk i with device compute of chunk i+1
+        pending = []
         for start in range(0, n, self.max_batch):
             sl = [x[start : start + self.max_batch] for x in host_inputs]
-            chunks.append(self._call_padded(sl, sl[0].shape[0]))
+            pending.append((self._dispatch_padded(sl, sl[0].shape[0]), sl[0].shape[0]))
+        chunks = [
+            jax.tree.map(lambda leaf: np.asarray(jax.device_get(leaf))[:m], out)
+            for out, m in pending
+        ]
         return jax.tree.map(lambda *leaves: np.concatenate(leaves, axis=0), *chunks)

@@ -70,6 +70,9 @@ class OptimizerConfig:
     one_cycle_lr: bool = False
     one_cycle_pct_start: float = 0.1
     max_steps: Optional[int] = None
+    # TPU-framework extensions beyond the reference surface:
+    grad_clip_norm: Optional[float] = None  # global-norm clipping before moments
+    accumulate_steps: int = 1  # micro-batches averaged per optimizer update
 
 
 def make_optimizer(
@@ -80,11 +83,17 @@ def make_optimizer(
     Raises ValueError when OneCycle is requested without ``max_steps``
     (reference ``lightning.py:65-67``).
     """
+    k = config.accumulate_steps
+    if k < 1:
+        raise ValueError(f"accumulate_steps must be >= 1, got {k}")
+
     if config.one_cycle_lr:
         if config.max_steps is None:
             raise ValueError("OneCycleLR requires a max_steps value")
+        # max_steps counts trainer (micro) steps; the schedule advances once
+        # per optimizer update, i.e. every k micro steps
         schedule = torch_one_cycle_schedule(
-            total_steps=config.max_steps,
+            total_steps=max(config.max_steps // k, 1),
             max_lr=config.learning_rate,
             pct_start=config.one_cycle_pct_start,
         )
@@ -105,5 +114,18 @@ def make_optimizer(
         tx = optax.adamw(schedule, weight_decay=config.weight_decay)
     else:
         raise ValueError(f"unknown optimizer {name!r} (expected 'Adam' or 'AdamW')")
+
+    if config.grad_clip_norm is not None:
+        if config.grad_clip_norm <= 0:
+            raise ValueError(f"grad_clip_norm must be > 0, got {config.grad_clip_norm}")
+        tx = optax.chain(optax.clip_by_global_norm(config.grad_clip_norm), tx)
+
+    if k > 1:
+        ms = optax.MultiSteps(tx, every_k_schedule=k)
+        # plain GradientTransformation view, so downstream wrappers
+        # (freeze_subtrees' multi_transform) compose with it
+        tx = optax.GradientTransformation(ms.init, ms.update)
+        micro_schedule = schedule
+        schedule = lambda step: micro_schedule(jnp.asarray(step) // k)
 
     return tx, schedule

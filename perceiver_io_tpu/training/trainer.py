@@ -56,6 +56,12 @@ class TrainerConfig:
     compute_mfu: bool = True  # XLA cost-analysis FLOPs → MFU metric
     profile_steps: int = 0  # capture a trace of this many steps after warmup
     profile_start_step: int = 10
+    # failure detection (SURVEY.md §5): a non-finite train loss means the
+    # params are already poisoned (NaN grads → NaN moments) and the run can
+    # never recover — halt at the next log point instead of burning the rest
+    # of the schedule. Checked only at log boundaries, where the loss scalar
+    # is fetched anyway (no extra device sync on the hot path).
+    halt_on_nonfinite: bool = True
 
     def __post_init__(self):
         if self.max_epochs is None and self.max_steps is None:
@@ -280,79 +286,96 @@ class Trainer:
         last_validated_step = step_i
 
         metrics: Metrics = {}
-        while not done:
-            if cfg.max_epochs is not None and epoch >= cfg.max_epochs:
-                break
-            steps_this_epoch = 0
-            for batch in train_loader:
-                if (
-                    cfg.profile_steps > 0
-                    and not profiling_active
-                    and not profile_captured
-                    and step_i >= cfg.profile_start_step
-                ):
-                    jax.profiler.start_trace(self.run_dir)
-                    profiling_active = True
-                    profile_start = step_i
+        try:
+            while not done:
+                if cfg.max_epochs is not None and epoch >= cfg.max_epochs:
+                    break
+                steps_this_epoch = 0
+                for batch in train_loader:
+                    if (
+                        cfg.profile_steps > 0
+                        and not profiling_active
+                        and not profile_captured
+                        and step_i >= cfg.profile_start_step
+                    ):
+                        jax.profiler.start_trace(self.run_dir)
+                        profiling_active = True
+                        profile_start = step_i
 
-                with profiling.annotate_step(step_i):
-                    self.state, metrics = self._train_step(self.state, batch)
-                step_i += 1
-                window_steps += 1
-                steps_this_epoch += 1
+                    with profiling.annotate_step(step_i):
+                        self.state, metrics = self._train_step(self.state, batch)
+                    step_i += 1
+                    window_steps += 1
+                    steps_this_epoch += 1
 
-                if profiling_active and step_i >= profile_start + cfg.profile_steps:
-                    jax.block_until_ready(metrics["loss"])
-                    jax.profiler.stop_trace()
-                    profiling_active = False
-                    profile_captured = True
+                    if profiling_active and step_i >= profile_start + cfg.profile_steps:
+                        jax.block_until_ready(metrics["loss"])
+                        jax.profiler.stop_trace()
+                        profiling_active = False
+                        profile_captured = True
 
-                if step_i % cfg.log_every_n_steps == 0:
-                    self._maybe_compute_flops(batch)
-                    # the float() conversions are the only host syncs in the loop
-                    host_metrics = {
-                        f"train_{k}" if k in ("loss", "acc") else k: float(v)
-                        for k, v in metrics.items()
-                    }
-                    self._last_train_loss = host_metrics.get(
-                        "train_loss", self._last_train_loss
-                    )
-                    now = time.perf_counter()
-                    batch_size = len(batch[self._keys[0]])
-                    if self.mesh is not None:
-                        # loaders are per-host; the global batch spans processes
-                        batch_size *= jax.process_count()
-                    host_metrics.update(
-                        self._throughput_metrics(
-                            window_steps, now - window_start, batch_size
+                    if step_i % cfg.log_every_n_steps == 0:
+                        self._maybe_compute_flops(batch)
+                        # the float() conversions are the only host syncs in the loop
+                        host_metrics = {
+                            f"train_{k}" if k in ("loss", "acc") else k: float(v)
+                            for k, v in metrics.items()
+                        }
+                        self._last_train_loss = host_metrics.get(
+                            "train_loss", self._last_train_loss
                         )
-                    )
-                    self.logger.log_scalars(step_i, host_metrics)
-                    window_start, window_steps = now, 0
+                        if (
+                            cfg.halt_on_nonfinite
+                            and "train_loss" in host_metrics
+                            and not np.isfinite(host_metrics["train_loss"])
+                        ):
+                            self.logger.log_scalars(step_i, host_metrics)
+                            self.logger.flush()
+                            raise FloatingPointError(
+                                f"non-finite train loss "
+                                f"{host_metrics['train_loss']} at step {step_i} — "
+                                f"training diverged (disable with "
+                                f"halt_on_nonfinite=False)"
+                            )
+                        now = time.perf_counter()
+                        batch_size = len(batch[self._keys[0]])
+                        if self.mesh is not None:
+                            # loaders are per-host; the global batch spans processes
+                            batch_size *= jax.process_count()
+                        host_metrics.update(
+                            self._throughput_metrics(
+                                window_steps, now - window_start, batch_size
+                            )
+                        )
+                        self.logger.log_scalars(step_i, host_metrics)
+                        window_start, window_steps = now, 0
 
-                if cfg.eval_every_n_steps and step_i % cfg.eval_every_n_steps == 0:
+                    if cfg.eval_every_n_steps and step_i % cfg.eval_every_n_steps == 0:
+                        self._validate_and_checkpoint(step_i, val_loader)
+                        last_validated_step = step_i
+                        window_start, window_steps = time.perf_counter(), 0
+
+                    if cfg.max_steps is not None and step_i >= cfg.max_steps:
+                        done = True
+                        break
+                if steps_this_epoch == 0:
+                    raise ValueError(
+                        "train_loader produced no batches (dataset shard smaller "
+                        "than the batch size with drop_last?)"
+                    )
+                epoch += 1
+                if not cfg.eval_every_n_steps:
+                    if not np.isfinite(self._last_train_loss) and "loss" in metrics:
+                        self._last_train_loss = float(metrics["loss"])
                     self._validate_and_checkpoint(step_i, val_loader)
                     last_validated_step = step_i
                     window_start, window_steps = time.perf_counter(), 0
 
-                if cfg.max_steps is not None and step_i >= cfg.max_steps:
-                    done = True
-                    break
-            if steps_this_epoch == 0:
-                raise ValueError(
-                    "train_loader produced no batches (dataset shard smaller "
-                    "than the batch size with drop_last?)"
-                )
-            epoch += 1
-            if not cfg.eval_every_n_steps:
-                if not np.isfinite(self._last_train_loss) and "loss" in metrics:
-                    self._last_train_loss = float(metrics["loss"])
-                self._validate_and_checkpoint(step_i, val_loader)
-                last_validated_step = step_i
-                window_start, window_steps = time.perf_counter(), 0
-
-        if profiling_active:
-            jax.profiler.stop_trace()
+        finally:
+            # a halt_on_nonfinite raise (or any other error) must not leak
+            # an active profiler trace into the process
+            if profiling_active:
+                jax.profiler.stop_trace()
         if step_i > last_validated_step:
             # final partial interval (eval_every_n_steps runs): don't lose the
             # tail — validate and give the checkpointer a shot at it

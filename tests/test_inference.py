@@ -66,6 +66,10 @@ def test_predictor_bucketing_matches_direct(rng):
     with pytest.raises(ValueError):
         pred(np.asarray(x[:3]), np.asarray(x[:2]))
 
+    # empty request: empty result, not a crash
+    out = pred(np.zeros((0, 6, 6, 1), np.float32))
+    assert out.shape == (0, 3)
+
 
 def test_predictor_pytree_outputs(rng):
     """Dict-returning models (multimodal) slice/concat per leaf."""

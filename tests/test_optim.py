@@ -94,3 +94,69 @@ def test_constant_schedule_without_one_cycle():
     _, schedule = make_optimizer(OptimizerConfig(learning_rate=5e-4))
     assert float(schedule(0)) == pytest.approx(5e-4)
     assert float(schedule(10_000)) == pytest.approx(5e-4)
+
+
+def test_grad_clip_norm():
+    tx, _ = make_optimizer(
+        OptimizerConfig(optimizer="AdamW", learning_rate=1.0, grad_clip_norm=1.0)
+    )
+    params = {"w": jnp.zeros(4)}
+    state = tx.init(params)
+    huge = {"w": jnp.full(4, 1e6)}
+    updates, _ = tx.update(huge, state, params)
+    # clipped to unit norm before Adam: finite, sane update
+    assert np.isfinite(np.asarray(updates["w"])).all()
+
+    with pytest.raises(ValueError, match="grad_clip_norm"):
+        make_optimizer(OptimizerConfig(grad_clip_norm=-1.0))
+
+
+def test_accumulate_steps_averages_micro_batches(rng):
+    """k micro-steps with accumulation ≡ one step on the mean gradient."""
+    k = 4
+    params = {"w": jnp.asarray(rng.normal(0, 1, (8,)), jnp.float32)}
+    grads = [
+        {"w": jnp.asarray(rng.normal(0, 1, (8,)), jnp.float32)} for _ in range(k)
+    ]
+    mean_grad = {"w": sum(g["w"] for g in grads) / k}
+
+    ref_tx, _ = make_optimizer(OptimizerConfig(optimizer="AdamW", learning_rate=1e-2))
+    ref_state = ref_tx.init(params)
+    ref_updates, _ = ref_tx.update(mean_grad, ref_state, params)
+    ref_params = optax.apply_updates(params, ref_updates)
+
+    acc_tx, _ = make_optimizer(
+        OptimizerConfig(optimizer="AdamW", learning_rate=1e-2, accumulate_steps=k)
+    )
+    acc_state = acc_tx.init(params)
+    acc_params = params
+    for i, g in enumerate(grads):
+        updates, acc_state = acc_tx.update(g, acc_state, acc_params)
+        acc_params = optax.apply_updates(acc_params, updates)
+        if i < k - 1:
+            # no-op micro steps: params unchanged until the k-th
+            np.testing.assert_allclose(
+                np.asarray(acc_params["w"]), np.asarray(params["w"]), atol=1e-7
+            )
+    np.testing.assert_allclose(
+        np.asarray(acc_params["w"]), np.asarray(ref_params["w"]), atol=1e-6
+    )
+
+    with pytest.raises(ValueError, match="accumulate_steps"):
+        make_optimizer(OptimizerConfig(accumulate_steps=0))
+
+
+def test_accumulate_steps_schedule_counts_optimizer_updates():
+    k, total = 4, 40
+    _, schedule = make_optimizer(
+        OptimizerConfig(learning_rate=1e-2, one_cycle_lr=True, max_steps=total,
+                        accumulate_steps=k)
+    )
+    _, ref_schedule = make_optimizer(
+        OptimizerConfig(learning_rate=1e-2, one_cycle_lr=True, max_steps=total // k)
+    )
+    # micro-step s maps onto optimizer update s // k
+    for s in (0, 3, 4, 17, 39):
+        np.testing.assert_allclose(
+            float(schedule(s)), float(ref_schedule(s // k)), rtol=1e-6
+        )

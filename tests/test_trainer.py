@@ -265,3 +265,30 @@ def test_test_pass_logs_test_metrics(tmp_path):
     assert "test_loss" in metrics
     logged = read_metrics(trainer.run_dir)
     assert any("test_loss" in row for row in logged)
+
+
+def test_halt_on_nonfinite_loss(tmp_path):
+    # trainer whose step reports a NaN loss; log every step so the guard
+    # fires immediately
+    trainer2, loaders = _make_parts(tmp_path)
+    trainer2.config = dataclasses.replace(trainer2.config, log_every_n_steps=1)
+    original = trainer2._train_step
+    trainer2._train_step = lambda s, b: (
+        (lambda st, m: (st, {**m, "loss": m["loss"] * jnp.nan}))(*original(s, b))
+    )
+    with trainer2:
+        with pytest.raises(FloatingPointError, match="non-finite"):
+            trainer2.fit(loaders[0], loaders[1])
+
+    # and the escape hatch
+    trainer3, loaders3 = _make_parts(tmp_path)
+    trainer3.config = dataclasses.replace(
+        trainer3.config, log_every_n_steps=1, halt_on_nonfinite=False,
+        max_epochs=1,
+    )
+    original3 = trainer3._train_step
+    trainer3._train_step = lambda s, b: (
+        (lambda st, m: (st, {**m, "loss": m["loss"] * jnp.nan}))(*original3(s, b))
+    )
+    with trainer3:
+        trainer3.fit(loaders3[0], loaders3[1])  # completes without raising
