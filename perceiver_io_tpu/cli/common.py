@@ -98,6 +98,9 @@ def add_compute_args(parser: argparse.ArgumentParser) -> None:
 
 def add_imdb_args(parser: argparse.ArgumentParser) -> None:
     g = parser.add_argument_group("data (IMDB)")
+    # accepted for drop-in compatibility with the reference recipes
+    # (README.md:33-38); imdb is the only text dataset either repo ships
+    g.add_argument("--dataset", choices=("imdb",), default="imdb")
     g.add_argument("--root", default=".cache")
     g.add_argument("--max_seq_len", type=int, default=512)
     g.add_argument("--vocab_size", type=int, default=10003)
@@ -109,6 +112,9 @@ def add_imdb_args(parser: argparse.ArgumentParser) -> None:
 
 def add_mnist_args(parser: argparse.ArgumentParser) -> None:
     g = parser.add_argument_group("data (MNIST)")
+    # accepted for drop-in compatibility with the reference recipes
+    # (README.md:77-79)
+    g.add_argument("--dataset", choices=("mnist",), default="mnist")
     g.add_argument("--root", default=".cache")
     g.add_argument("--batch_size", type=int, default=128)
     g.add_argument("--random_crop", type=int, default=None)

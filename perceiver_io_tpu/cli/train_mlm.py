@@ -41,8 +41,9 @@ def build_parser() -> argparse.ArgumentParser:
     common.add_optimizer_args(parser)
     common.add_imdb_args(parser)
     g = parser.add_argument_group("task (MLM)")
-    g.add_argument("--num_predictions", type=int, default=5,
-                   help="top-k predictions logged per [MASK] position")
+    g.add_argument("--num_predictions", "--predict_k", type=int, default=5,
+                   help="top-k predictions logged per [MASK] position "
+                        "(--predict_k is the reference's spelling)")
     g.add_argument("--predict_samples", nargs="*", default=list(DEFAULT_PREDICT_SAMPLES))
     g.add_argument("--loss_gather_capacity", type=int, default=-1,
                    help="decode only the masked positions, up to this many per "

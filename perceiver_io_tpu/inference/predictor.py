@@ -68,10 +68,9 @@ class Predictor:
 
     def _dispatch_padded(self, inputs: Sequence[np.ndarray], n: int):
         """Pad to the bucket and dispatch; returns the on-device output
-        (not fetched — JAX dispatch is async, so callers can queue several
-        chunks before the first host transfer)."""
-        fill_rows = 1 if n == 0 else 0  # empty request: run one dummy row
-        bucket = bucket_size(n + fill_rows, self.max_batch)
+        (not fetched — JAX dispatch is async, so a second chunk can be queued
+        before the first host transfer)."""
+        bucket = bucket_size(n, self.max_batch)
         padded = []
         for x in inputs:
             if x.shape[0] != n:
@@ -79,28 +78,49 @@ class Predictor:
                     f"all inputs must share the leading batch axis: {x.shape[0]} != {n}"
                 )
             if bucket > n:
-                row = x[:1] if n else np.zeros((1, *x.shape[1:]), x.dtype)
                 x = np.concatenate(
-                    [x, np.broadcast_to(row, (bucket - n, *x.shape[1:]))], axis=0
+                    [x, np.broadcast_to(x[:1], (bucket - n, *x.shape[1:]))], axis=0
                 )
             padded.append(x)
         return self._jitted(self.params, *padded)
 
+    def _empty_result(self, inputs: Sequence[np.ndarray]):
+        """Outputs for an n=0 request without touching the device: eval_shape
+        over a one-row input gives the pytree structure/dtypes for free."""
+        ones = [np.zeros((1, *x.shape[1:]), x.dtype) for x in inputs]
+        shapes = jax.eval_shape(self._jitted, self.params, *ones)
+        return jax.tree.map(
+            lambda s: np.zeros((0, *s.shape[1:]), s.dtype), shapes
+        )
+
     def __call__(self, *inputs):
         host_inputs = [np.asarray(x) for x in inputs]
         n = host_inputs[0].shape[0]
+        if any(x.shape[0] != n for x in host_inputs):
+            raise ValueError("all inputs must share the leading batch axis")
+        if n == 0:
+            return self._empty_result(host_inputs)
         if n <= self.max_batch:
             out = self._dispatch_padded(host_inputs, n)
             return jax.tree.map(lambda leaf: np.asarray(jax.device_get(leaf))[:n], out)
-        # oversized request: fixed-size chunks (+ one padded tail bucket);
-        # dispatch everything first, fetch after — overlaps host transfer of
-        # chunk i with device compute of chunk i+1
-        pending = []
+        # oversized request: fixed-size chunks (+ one padded tail bucket).
+        # Keep exactly two dispatches in flight — chunk i's host transfer
+        # overlaps chunk i+1's device compute, while device-resident outputs
+        # stay O(max_batch), not O(n) (output-heavy models would otherwise
+        # queue gigabytes).
+        chunks = []
+        pending = None  # (device_out, rows)
         for start in range(0, n, self.max_batch):
             sl = [x[start : start + self.max_batch] for x in host_inputs]
-            pending.append((self._dispatch_padded(sl, sl[0].shape[0]), sl[0].shape[0]))
-        chunks = [
+            current = (self._dispatch_padded(sl, sl[0].shape[0]), sl[0].shape[0])
+            if pending is not None:
+                out, m = pending
+                chunks.append(
+                    jax.tree.map(lambda leaf: np.asarray(jax.device_get(leaf))[:m], out)
+                )
+            pending = current
+        out, m = pending
+        chunks.append(
             jax.tree.map(lambda leaf: np.asarray(jax.device_get(leaf))[:m], out)
-            for out, m in pending
-        ]
+        )
         return jax.tree.map(lambda *leaves: np.concatenate(leaves, axis=0), *chunks)
