@@ -32,8 +32,7 @@ kernel for long streams (image/flow inputs), XLA for short ones (text).
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -130,6 +129,26 @@ def _dot_product_attention(
     return jnp.einsum("bhts,bshd->bthd", probs, v, precision=precision)
 
 
+class _LinearParams(nn.Module):
+    """Declare a Linear's kernel/bias without applying it — the param tree is
+    identical to ``nn.Dense`` (``{name: {kernel, bias}}``), so checkpoints,
+    sharding path rules, and the torch-parity mapping are unchanged, while the
+    caller is free to fuse several projections into one matmul."""
+
+    in_features: int
+    features: int
+    kernel_init: Any = nn.initializers.xavier_uniform()
+    bias_init: Any = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self) -> Tuple[Array, Array]:
+        kernel = self.param(
+            "kernel", self.kernel_init, (self.in_features, self.features)
+        )
+        bias = self.param("bias", self.bias_init, (self.features,))
+        return kernel, bias
+
+
 class MultiHeadAttention(nn.Module):
     """Multi-head attention with distinct query / key-value channel counts.
 
@@ -160,16 +179,25 @@ class MultiHeadAttention(nn.Module):
             raise ValueError(f"num_q_channels {e} not divisible by num_heads {h}")
         d = e // h
 
-        dense = functools.partial(
-            nn.Dense,
-            features=e,
-            dtype=self.dtype,
-            kernel_init=nn.initializers.xavier_uniform(),
-            bias_init=nn.initializers.zeros_init(),
-        )
-        q = dense(name="q_proj")(x_q)
-        k = dense(name="k_proj")(x_kv)
-        v = dense(name="v_proj")(x_kv)
+        wq, bq = _LinearParams(x_q.shape[-1], e, name="q_proj")()
+        wk, bk = _LinearParams(x_kv.shape[-1], e, name="k_proj")()
+        wv, bv = _LinearParams(x_kv.shape[-1], e, name="v_proj")()
+        if x_q is x_kv:
+            # self-attention: one fused (C, 3E) matmul instead of three — the
+            # input is read once and the three skinny gemms become one
+            # (measured ~6% step win on the flagship MLM config, PERF.md).
+            # Identical math: each output column is an independent dot product.
+            w = jnp.concatenate([wq, wk, wv], axis=1)
+            bias = jnp.concatenate([bq, bk, bv])
+            x, w, bias = nn.dtypes.promote_dtype(x_q, w, bias, dtype=self.dtype)
+            q, k, v = jnp.split(x @ w + bias, 3, axis=-1)
+        else:
+            xq, wq, bq = nn.dtypes.promote_dtype(x_q, wq, bq, dtype=self.dtype)
+            xkv, wk, bk = nn.dtypes.promote_dtype(x_kv, wk, bk, dtype=self.dtype)
+            _, wv, bv = nn.dtypes.promote_dtype(x_kv, wv, bv, dtype=self.dtype)
+            q = xq @ wq + bq
+            k = xkv @ wk + bk
+            v = xkv @ wv + bv
 
         b, t = q.shape[:2]
         s = k.shape[1]

@@ -4,12 +4,12 @@ Times config ablations of the train step with the honest sync discipline
 (PERF.md): chain donated state, fetch the loss scalar, subtract a 1-iter run.
 Each row removes one component, so deltas attribute time to components:
 
-  full            the bench step (3 layers x 6 self-attn, gather decode)
-  no-decode       loss on latent mean instead of decoder+CE
-  no-self         blocks of 0 self-attention layers (cross-attn only)
-  one-layer       num_layers=1 (no shared-layer recurrence)
-  fwd-only        no backward/optimizer (value instead of value_and_grad)
-  f32-softmax-off softmax in bf16 (accuracy-risky; measurement only)
+  full         the bench step (3 layers x 6 self-attn, gather decode)
+  full-decode  all 512 positions decoded (reference-shaped CE)
+  no-decode    loss on latent mean instead of decoder+CE
+  no-self      blocks of 1 self-attention layer (delta = the 15 removed layers)
+  one-layer    num_layers=1 (no shared-layer recurrence)
+  fwd-only     no backward/optimizer (forward + loss only)
 """
 
 from __future__ import annotations
