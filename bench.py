@@ -11,7 +11,8 @@ The reference publishes no throughput numbers (BASELINE.md), so
 
 Env knobs: PIT_BENCH_CPU=1 forces CPU; PIT_BENCH_STEPS / PIT_BENCH_BATCH
 override defaults; PIT_BENCH_ATTN selects the attention impl
-('xla' | 'pallas', default 'xla' — measured faster at these skinny head dims);
+('xla' | 'pallas' | 'packed', default 'xla' — measured fastest at these
+skinny head dims, see PERF.md);
 PIT_BENCH_GATHER sets the masked-decode capacity (-1 auto — measured ~35%
 faster than full decode: the (B, 512, 10003) logits and their CE dominate HBM
 traffic; 0 = reference-shaped full decode).
@@ -56,8 +57,9 @@ def main() -> None:
     steps = int(os.environ.get("PIT_BENCH_STEPS", "20"))
     compute_dtype = jnp.bfloat16
     attn_impl = os.environ.get("PIT_BENCH_ATTN", "xla")
-    if attn_impl not in ("xla", "pallas"):
-        raise SystemExit(f"PIT_BENCH_ATTN must be 'xla' or 'pallas', got {attn_impl!r}")
+    if attn_impl not in ("xla", "pallas", "packed"):
+        raise SystemExit(
+            f"PIT_BENCH_ATTN must be 'xla', 'pallas' or 'packed', got {attn_impl!r}")
     gather = int(os.environ.get("PIT_BENCH_GATHER", "-1"))
     if gather < 0:
         gather = mlm_gather_capacity(seq_len)

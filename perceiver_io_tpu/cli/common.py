@@ -83,9 +83,11 @@ def add_mesh_args(parser: argparse.ArgumentParser) -> None:
 def add_compute_args(parser: argparse.ArgumentParser) -> None:
     g = parser.add_argument_group("compute")
     g.add_argument("--dtype", choices=sorted(DTYPES), default="bfloat16")
-    g.add_argument("--attn_impl", choices=("auto", "xla", "pallas"), default="auto",
+    g.add_argument("--attn_impl", choices=("auto", "xla", "pallas", "packed"),
+                   default="auto",
                    help="attention inner-product impl; auto picks the fused "
-                        "Pallas kernel for long KV streams, XLA otherwise")
+                        "Pallas kernel for long KV streams, XLA otherwise; "
+                        "packed = experimental small-latent kernel (PERF.md)")
     g.add_argument("--remat", action="store_true",
                    help="rematerialize encoder layers (HBM for FLOPs)")
     g.add_argument("--pad_vocab_multiple", type=int, default=None,

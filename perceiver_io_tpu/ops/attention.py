@@ -25,9 +25,11 @@ normalized output by ~0.1%.
 
 The attention inner product is pluggable: ``attn_impl='xla'`` uses pure
 jnp/einsum (XLA fuses this well on the MXU); ``attn_impl='pallas'`` dispatches
-to the fused Pallas kernel in ``perceiver_io_tpu.ops.pallas_attention``;
-``'auto'`` (default) picks per call site by KV-stream length — the fused
-kernel for long streams (image/flow inputs), XLA for short ones (text).
+to the streaming fused Pallas kernel in ``perceiver_io_tpu.ops.pallas_attention``;
+``attn_impl='packed'`` is the experimental small-latent packed-heads kernel
+(opt-in — see PERF.md's negative-results note); ``'auto'`` (default) picks per
+call site by KV-stream length — the fused kernel for long streams (image/flow
+inputs), XLA for short ones (text).
 """
 
 from __future__ import annotations
@@ -162,7 +164,7 @@ class MultiHeadAttention(nn.Module):
     num_heads: int
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
-    attn_impl: str = "auto"  # 'auto' | 'xla' | 'pallas'
+    attn_impl: str = "auto"  # 'auto' | 'xla' | 'pallas' | 'packed'
 
     @nn.compact
     def __call__(
@@ -201,20 +203,19 @@ class MultiHeadAttention(nn.Module):
 
         b, t = q.shape[:2]
         s = k.shape[1]
-        q = q.reshape(b, t, h, d)
-        k = k.reshape(b, s, h, d)
-        v = v.reshape(b, s, h, d)
 
         dropout_active = self.dropout > 0.0 and not deterministic
         dropout_rng = self.make_rng("dropout") if dropout_active else None
 
-        # The fused kernel covers the Perceiver hot path: pad-masked or
+        # The fused kernels cover the Perceiver hot path: pad-masked or
         # unmasked attention without prob-dropout. attn_mask / prob-dropout
         # fall back to the XLA path (never silently dropped).
         #
         # 'auto' (the default) picks per call site — long KV stream with
-        # shallow heads → fused kernel; everything else → XLA einsum. See the
-        # constants' comment for the measurements behind the thresholds.
+        # shallow heads → streaming fused kernel; everything else → XLA
+        # einsum. 'packed' is the small-latent kernel reading the un-split
+        # (B, T, E) layout (head separation in-VMEM by channel masking) —
+        # opt-in while its end-to-end wins are shape-dependent.
         impl = self.attn_impl
         if impl == "auto":
             # TPU-only: off-TPU the kernel would run in interpreter mode
@@ -224,16 +225,33 @@ class MultiHeadAttention(nn.Module):
                        and d <= AUTO_PALLAS_MAX_HEAD_DIM
                        and jax.default_backend() == "tpu")
             impl = "pallas" if long_kv else "xla"
-        if impl == "pallas" and attn_mask is None and not dropout_active:
-            from perceiver_io_tpu.ops.pallas_attention import fused_attention
-
-            out = fused_attention(q, k, v, pad_mask=pad_mask)
-        else:
-            out = _dot_product_attention(
-                q, k, v, pad_mask, attn_mask, self.dropout, dropout_rng, deterministic
+        fusable = attn_mask is None and not dropout_active
+        if impl == "packed" and fusable:
+            from perceiver_io_tpu.ops.pallas_attention import (
+                packed_fits_vmem,
+                packed_latent_attention,
             )
 
-        out = out.reshape(b, t, e)
+            if not packed_fits_vmem(t, s, e, jnp.dtype(q.dtype).itemsize):
+                raise ValueError(
+                    f"attn_impl='packed' shapes T={t} S={s} E={e} exceed the "
+                    "kernel's per-example VMEM budget (see "
+                    "pallas_attention.packed_vmem_bytes)"
+                )
+            out = packed_latent_attention(q, k, v, h, pad_mask=pad_mask)
+        elif impl == "pallas" and fusable:
+            from perceiver_io_tpu.ops.pallas_attention import fused_attention
+
+            out = fused_attention(
+                q.reshape(b, t, h, d), k.reshape(b, s, h, d),
+                v.reshape(b, s, h, d), pad_mask=pad_mask,
+            ).reshape(b, t, e)
+        else:
+            out = _dot_product_attention(
+                q.reshape(b, t, h, d), k.reshape(b, s, h, d),
+                v.reshape(b, s, h, d), pad_mask, attn_mask,
+                self.dropout, dropout_rng, deterministic,
+            ).reshape(b, t, e)
         out = nn.Dense(
             features=e,
             dtype=self.dtype,
