@@ -11,6 +11,7 @@ DDP-flags replacement) and compute (dtype / attention impl / remat).
 from __future__ import annotations
 
 import argparse
+import os
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
@@ -65,6 +66,11 @@ def add_trainer_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--no_tensorboard", action="store_true")
     g.add_argument("--profile_steps", type=int, default=0,
                    help="capture a profiler trace of N steps after warmup")
+    g.add_argument("--resume", default=None, metavar="RUN_DIR",
+                   help="continue a previous run in place: restore the newest "
+                        "checkpoint (the preemption last/ slot if present), "
+                        "override model args from its hparams, and keep "
+                        "logging into the same run directory")
 
 
 def add_mesh_args(parser: argparse.ArgumentParser) -> None:
@@ -292,3 +298,43 @@ def override_model_args(args, hparams: dict) -> None:
     for key in MODEL_HPARAM_KEYS:
         if key in hparams:
             setattr(args, key, hparams[key])
+
+
+def parse_with_resume(parser: argparse.ArgumentParser, argv):
+    """Parse, and when ``--resume RUN_DIR`` is set, re-parse with the resumed
+    run's embedded hparams installed as the parser's defaults.
+
+    Every arg of the original run — model shapes, data shapes, optimizer
+    structure (``accumulate_steps`` changes the opt_state pytree!) — comes
+    back automatically, while flags given explicitly on THIS command line
+    still win (so ``--resume RUN --max_steps 100000`` extends the schedule).
+    ``--resume`` itself is never taken from hparams."""
+    args = parser.parse_args(argv)
+    if not getattr(args, "resume", None):
+        return args
+    from perceiver_io_tpu.training.checkpoint import load_hparams
+
+    hparams = load_hparams(os.path.join(args.resume, "checkpoints"))
+    known = vars(args)
+    defaults = {
+        k: v for k, v in hparams.items() if k in known and k != "resume"
+    }
+    parser.set_defaults(**defaults)
+    args = parser.parse_args(argv)
+    args.resume = os.path.abspath(known["resume"])
+    return args
+
+
+def resume_state(args, state):
+    """After building the fresh TrainState: restore the newest checkpoint of
+    the ``--resume`` run (preferring the preemption ``last/`` slot). Returns
+    ``(state, run_dir)`` — ``run_dir`` is the resumed directory (so logging
+    and checkpoints continue in place) or None for a fresh run."""
+    if not getattr(args, "resume", None):
+        return state, None
+    from perceiver_io_tpu.training.checkpoint import restore_train_state
+
+    state = restore_train_state(
+        os.path.join(args.resume, "checkpoints"), state, prefer_latest=True
+    )
+    return state, args.resume

@@ -50,7 +50,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[Sequence[str]] = None):
-    args = build_parser().parse_args(argv)
+    args = common.parse_with_resume(build_parser(), argv)
     image_shape = (args.image_height, args.image_width, args.image_channels)
 
     data = FlowDataModule(
@@ -86,6 +86,7 @@ def main(argv: Optional[Sequence[str]] = None):
     )
     tx, schedule = common.optimizer_from_args(args)
     state = TrainState.create(variables["params"], tx, jax.random.key(args.seed + 2))
+    state, resume_dir = common.resume_state(args, state)
 
     train_step, eval_step = make_flow_steps(model, schedule)
     mesh = common.mesh_from_args(args)
@@ -98,6 +99,7 @@ def main(argv: Optional[Sequence[str]] = None):
         example_batch={k: example[k] for k in ("frames", "flow")},
         mesh=mesh,
         hparams=vars(args),
+        run_dir=resume_dir,
     )
     with trainer:
         trainer.fit(data.train_dataloader(), data.val_dataloader())

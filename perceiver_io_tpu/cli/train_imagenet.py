@@ -60,7 +60,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[Sequence[str]] = None):
-    args = build_parser().parse_args(argv)
+    args = common.parse_with_resume(build_parser(), argv)
     # remat is the sane default at M = image_size² (opt out via --no_remat)
     if args.image_size >= 64 and not args.no_remat:
         args.remat = True
@@ -91,6 +91,7 @@ def main(argv: Optional[Sequence[str]] = None):
     )
     tx, schedule = common.optimizer_from_args(args)
     state = TrainState.create(variables["params"], tx, jax.random.key(args.seed + 2))
+    state, resume_dir = common.resume_state(args, state)
 
     train_step, eval_step = make_classifier_steps(model, schedule, input_kind="image")
     mesh = common.mesh_from_args(args)
@@ -104,6 +105,7 @@ def main(argv: Optional[Sequence[str]] = None):
         mesh=mesh,
         shard_seq=args.shard_seq,
         hparams=vars(args),
+        run_dir=resume_dir,
     )
     with trainer:
         trainer.fit(data.train_dataloader(), data.val_dataloader())

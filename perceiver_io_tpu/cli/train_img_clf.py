@@ -37,7 +37,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[Sequence[str]] = None):
-    args = build_parser().parse_args(argv)
+    args = common.parse_with_resume(build_parser(), argv)
 
     data = MNISTDataModule(
         root=args.root,
@@ -62,6 +62,7 @@ def main(argv: Optional[Sequence[str]] = None):
     )
     tx, schedule = common.optimizer_from_args(args)
     state = TrainState.create(variables["params"], tx, jax.random.key(args.seed + 2))
+    state, resume_dir = common.resume_state(args, state)
 
     train_step, eval_step = make_classifier_steps(model, schedule, input_kind="image")
     mesh = common.mesh_from_args(args)
@@ -74,6 +75,7 @@ def main(argv: Optional[Sequence[str]] = None):
         example_batch={k: example[k] for k in ("image", "label")},
         mesh=mesh,
         hparams=vars(args),
+        run_dir=resume_dir,
     )
     with trainer:
         trainer.fit(data.train_dataloader(), data.val_dataloader())

@@ -94,7 +94,7 @@ def make_predict_hook(predict_fn, collator, samples: Sequence[str], k: int):
 
 
 def main(argv: Optional[Sequence[str]] = None):
-    args = build_parser().parse_args(argv)
+    args = common.parse_with_resume(build_parser(), argv)
 
     data = IMDBDataModule(
         root=args.root,
@@ -119,6 +119,7 @@ def main(argv: Optional[Sequence[str]] = None):
     )
     tx, schedule = common.optimizer_from_args(args)
     state = TrainState.create(variables["params"], tx, jax.random.key(args.seed + 2))
+    state, resume_dir = common.resume_state(args, state)
 
     capacity = args.loss_gather_capacity
     if capacity < 0:
@@ -137,6 +138,7 @@ def main(argv: Optional[Sequence[str]] = None):
         mesh=mesh,
         shard_seq=args.shard_seq,
         hparams=vars(args),
+        run_dir=resume_dir,
         predict_hook=make_predict_hook(
             predict_fn, data.collator, args.predict_samples, args.num_predictions
         ),

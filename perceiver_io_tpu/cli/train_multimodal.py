@@ -64,7 +64,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[Sequence[str]] = None):
-    args = build_parser().parse_args(argv)
+    args = common.parse_with_resume(build_parser(), argv)
     video_shape = (
         args.video_frames, args.video_size, args.video_size, args.video_channels
     )
@@ -112,6 +112,7 @@ def main(argv: Optional[Sequence[str]] = None):
     )
     tx, schedule = common.optimizer_from_args(args)
     state = TrainState.create(variables["params"], tx, jax.random.key(args.seed + 2))
+    state, resume_dir = common.resume_state(args, state)
 
     train_step, eval_step = make_multimodal_steps(
         model, schedule,
@@ -129,6 +130,7 @@ def main(argv: Optional[Sequence[str]] = None):
         example_batch={k: example[k] for k in ("video", "audio", "label")},
         mesh=mesh,
         hparams=vars(args),
+        run_dir=resume_dir,
     )
     with trainer:
         trainer.fit(data.train_dataloader(), data.val_dataloader())

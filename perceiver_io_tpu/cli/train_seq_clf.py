@@ -55,9 +55,15 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: Optional[Sequence[str]] = None):
-    args = build_parser().parse_args(argv)
+    args = common.parse_with_resume(build_parser(), argv)
     if args.mlm_checkpoint and args.clf_checkpoint:
         raise SystemExit("--mlm_checkpoint and --clf_checkpoint are exclusive")
+    if args.resume and (args.mlm_checkpoint or args.clf_checkpoint):
+        # conflicting init modes: --resume continues one run in place, the
+        # checkpoint flags start a NEW run from another run's weights
+        raise SystemExit(
+            "--resume is exclusive with --mlm_checkpoint/--clf_checkpoint"
+        )
 
     # a restored encoder must be rebuilt with the shapes it was trained with
     source_ckpt = args.mlm_checkpoint or args.clf_checkpoint
@@ -105,6 +111,7 @@ def main(argv: Optional[Sequence[str]] = None):
     if args.freeze_encoder:
         tx = freeze_subtrees(tx, params, ["encoder"])
     state = TrainState.create(params, tx, jax.random.key(args.seed + 2))
+    state, resume_dir = common.resume_state(args, state)
 
     if args.clf_checkpoint:
         state = restore_train_state(args.clf_checkpoint, state)
@@ -123,6 +130,7 @@ def main(argv: Optional[Sequence[str]] = None):
         mesh=mesh,
         shard_seq=args.shard_seq,
         hparams=vars(args),
+        run_dir=resume_dir,
         tokens_per_example=args.max_seq_len,
     )
     with trainer:

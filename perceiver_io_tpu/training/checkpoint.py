@@ -27,6 +27,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 HPARAMS_FILE = "hparams.json"
+LAST_SUBDIR = "last"  # unconditional newest-state slot (preemption/crash)
 METRICS_FILE = "metrics.json"
 
 
@@ -92,12 +93,36 @@ class CheckpointManager:
                 enable_async_checkpointing=async_save,
             ),
         )
+        self._last_mngr: Optional[ocp.CheckpointManager] = None
+        self._async_save = async_save
         if self._hparams is not None and jax.process_index() == 0:
             os.makedirs(self.directory, exist_ok=True)
             with open(os.path.join(self.directory, HPARAMS_FILE), "w") as f:
                 json.dump(self._hparams, f, indent=2, sort_keys=True)
 
     # -- save ---------------------------------------------------------------
+
+    def save_last(self, step: int, state) -> None:
+        """Unconditionally save the CURRENT state to the ``last/`` slot
+        (one kept), regardless of metric rank — the preemption/crash
+        checkpoint. The best-by-metric policy above would GC a state whose
+        monitored metric is worse than the champion's, which is exactly the
+        state a preempted run needs to resume from."""
+        if self._last_mngr is None:
+            self._last_mngr = ocp.CheckpointManager(
+                os.path.join(self.directory, LAST_SUBDIR),
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=1,
+                    enable_async_checkpointing=self._async_save,
+                ),
+            )
+        self._last_mngr.save(
+            int(step),
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(_to_save_tree(state))
+            ),
+        )
+        self._last_mngr.wait_until_finished()
 
     def save(self, step: int, state, metrics: Dict[str, float]) -> bool:
         """Save if ``metrics[monitor]`` ranks in the top-k. Returns whether a
@@ -173,6 +198,8 @@ class CheckpointManager:
     def close(self) -> None:
         self.wait()
         self._mngr.close()
+        if self._last_mngr is not None:
+            self._last_mngr.close()
 
     def __enter__(self) -> "CheckpointManager":
         return self
@@ -208,16 +235,37 @@ def _read_manager(directory: str, monitor: str, mode: str) -> ocp.CheckpointMana
 def restore_train_state(
     directory: str, like_state, step: Optional[int] = None,
     monitor: str = "val_loss", mode: str = "min",
+    prefer_latest: bool = False,
 ):
-    """Restore a TrainState from ``directory`` (best step by default)."""
+    """Restore a TrainState from ``directory`` (best step by default).
+
+    ``prefer_latest=True`` is the crash/preemption-resume mode: it considers
+    both the ranked checkpoints and the unconditional ``last/`` slot
+    (``CheckpointManager.save_last``) and restores whichever holds the highest
+    step — continuing training from the newest state rather than the champion.
+    """
+    restore_args = ocp.args.Composite(
+        state=ocp.args.StandardRestore(_to_save_tree(like_state))
+    )
+    last_dir = os.path.join(os.path.abspath(directory), LAST_SUBDIR)
+    if prefer_latest and step is None and os.path.isdir(last_dir):
+        # open each manager once: construction re-scans the directory (and
+        # synchronizes cross-host), so probing and restoring reuse the handle
+        with ocp.CheckpointManager(last_dir) as last_mngr:
+            last_step = last_mngr.latest_step()
+            with _read_manager(directory, monitor, mode) as mngr:
+                main_step = mngr.latest_step()
+                if last_step is None or (main_step is not None
+                                         and main_step > last_step):
+                    if main_step is None:
+                        raise FileNotFoundError(f"no checkpoints in {directory}")
+                    restored = mngr.restore(main_step, args=restore_args)["state"]
+                    return _from_save_tree(restored, like_state)
+            restored = last_mngr.restore(last_step, args=restore_args)["state"]
+        return _from_save_tree(restored, like_state)
     with _read_manager(directory, monitor, mode) as mngr:
         step = _resolve_step(mngr, step, directory)
-        restored = mngr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(_to_save_tree(like_state))
-            ),
-        )["state"]
+        restored = mngr.restore(step, args=restore_args)["state"]
     return _from_save_tree(restored, like_state)
 
 

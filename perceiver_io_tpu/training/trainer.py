@@ -23,6 +23,8 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import signal
+import threading
 import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
@@ -56,6 +58,10 @@ class TrainerConfig:
     compute_mfu: bool = True  # XLA cost-analysis FLOPs → MFU metric
     profile_steps: int = 0  # capture a trace of this many steps after warmup
     profile_start_step: int = 10
+    # preemption safety (SURVEY.md §5, restart-on-failure): on SIGTERM, save
+    # the CURRENT state to the checkpoint dir's unconditional last/ slot and
+    # stop cleanly; restore_train_state(prefer_latest=True) resumes from it.
+    checkpoint_on_sigterm: bool = True
     # failure detection (SURVEY.md §5): a non-finite train loss means the
     # params are already poisoned (NaN grads → NaN moments) and the run can
     # never recover — halt at the next log point instead of burning the rest
@@ -285,6 +291,26 @@ class Trainer:
         profile_captured = False
         last_validated_step = step_i
 
+        # SIGTERM = preemption notice: finish the in-flight step, save the
+        # newest state unconditionally, stop cleanly. The handler only sets a
+        # flag — all real work happens on the main thread between steps.
+        # Single-process only: Orbax saves of mesh-sharded arrays are
+        # multi-host collectives, and hosts observe SIGTERM at different
+        # step boundaries — an unsynchronized save would deadlock. Multi-host
+        # preemption recovery is restart-from-checkpoint (--resume), which
+        # every host performs identically.
+        self._sigterm = False
+        handler_installed = False
+        prev_handler = None
+        if (cfg.checkpoint_on_sigterm
+                and jax.process_count() == 1
+                and threading.current_thread() is threading.main_thread()):
+            def _on_sigterm(signum, frame):
+                self._sigterm = True
+
+            prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+            handler_installed = True
+
         metrics: Metrics = {}
         try:
             while not done:
@@ -292,6 +318,15 @@ class Trainer:
                     break
                 steps_this_epoch = 0
                 for batch in train_loader:
+                    if self._sigterm:
+                        self.checkpoints.save_last(step_i, self.state)
+                        self.logger.log_text(
+                            "events", step_i,
+                            f"SIGTERM: saved last/ checkpoint at step {step_i}",
+                        )
+                        self.logger.flush()
+                        done = True
+                        break
                     if (
                         cfg.profile_steps > 0
                         and not profiling_active
@@ -358,6 +393,8 @@ class Trainer:
                     if cfg.max_steps is not None and step_i >= cfg.max_steps:
                         done = True
                         break
+                if self._sigterm:
+                    break
                 if steps_this_epoch == 0:
                     raise ValueError(
                         "train_loader produced no batches (dataset shard smaller "
@@ -376,7 +413,15 @@ class Trainer:
             # an active profiler trace into the process
             if profiling_active:
                 jax.profiler.stop_trace()
-        if step_i > last_validated_step:
+            if handler_installed:
+                # signal.signal returned None when the prior disposition was
+                # installed outside Python — restore the default, never leave
+                # the flag-setter swallowing SIGTERM after fit() returns
+                signal.signal(
+                    signal.SIGTERM,
+                    prev_handler if prev_handler is not None else signal.SIG_DFL,
+                )
+        if step_i > last_validated_step and not self._sigterm:
             # final partial interval (eval_every_n_steps runs): don't lose the
             # tail — validate and give the checkpointer a shot at it
             if not np.isfinite(self._last_train_loss) and "loss" in metrics:

@@ -208,3 +208,46 @@ def test_encoder_transfer(tmp_path, state_and_batch, rng):
     logits = clf.apply({"params": grafted}, token_ids, pad_mask)
     assert logits.shape == (2, 2)
     assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_save_last_and_prefer_latest(tmp_path, state_and_batch):
+    """Preemption flow: best slot holds an old champion, last/ holds the newer
+    state; prefer_latest resumes from last/, default restore from best."""
+    model, state, batch, schedule = state_and_batch
+    train_step, _, _ = make_mlm_steps(model, schedule)
+    step_fn = jax.jit(train_step)
+
+    directory = str(tmp_path / "ckpt")
+    with CheckpointManager(directory, async_save=False) as mngr:
+        state, _ = step_fn(state, batch)
+        mngr.save(int(state.step), state, {"val_loss": 1.0})  # champion @ 1
+        champion = state
+        for _ in range(3):
+            state, _ = step_fn(state, batch)
+        # a worse metric would be GC'd by the ranked slot; last/ keeps it
+        mngr.save_last(int(state.step), state)
+
+    like = TrainState.create(
+        jax.tree.map(jnp.zeros_like, state.params), state.tx, jax.random.key(0)
+    )
+    latest = restore_train_state(directory, like, prefer_latest=True)
+    assert int(latest.step) == int(state.step)
+    assert _trees_equal(latest.params, state.params)
+
+    best = restore_train_state(directory, like)
+    assert int(best.step) == int(champion.step)
+
+
+def test_prefer_latest_without_last_slot(tmp_path, state_and_batch):
+    """prefer_latest with no last/ dir falls back to the ranked slot."""
+    model, state, batch, schedule = state_and_batch
+    train_step, _, _ = make_mlm_steps(model, schedule)
+    state, _ = jax.jit(train_step)(state, batch)
+    directory = str(tmp_path / "ckpt")
+    with CheckpointManager(directory, async_save=False) as mngr:
+        mngr.save(int(state.step), state, {"val_loss": 1.0})
+    like = TrainState.create(
+        jax.tree.map(jnp.zeros_like, state.params), state.tx, jax.random.key(0)
+    )
+    restored = restore_train_state(directory, like, prefer_latest=True)
+    assert int(restored.step) == int(state.step)

@@ -292,3 +292,66 @@ def test_halt_on_nonfinite_loss(tmp_path):
     )
     with trainer3:
         trainer3.fit(loaders3[0], loaders3[1])  # completes without raising
+
+
+def test_sigterm_saves_last_and_resumes(tmp_path):
+    """SIGTERM mid-fit: the trainer saves the newest state to last/ and stops
+    cleanly; restore_train_state(prefer_latest=True) resumes from it."""
+    import os as _os
+    import signal as _signal
+
+    from perceiver_io_tpu.training import restore_train_state
+
+    trainer, loaders = _make_parts(tmp_path)
+    trainer.config = dataclasses.replace(trainer.config, max_epochs=50)
+
+    count = {"n": 0}
+    original = trainer._train_step
+
+    def step_then_sigterm(s, b):
+        out = original(s, b)
+        count["n"] += 1
+        if count["n"] == 3:
+            _os.kill(_os.getpid(), _signal.SIGTERM)
+        return out
+
+    trainer._train_step = step_then_sigterm
+    with trainer:
+        state = trainer.fit(loaders[0], loaders[1])
+    assert count["n"] == 3  # stopped right after the signal, not 50 epochs
+    assert _os.path.isdir(_os.path.join(trainer.run_dir, "checkpoints", "last"))
+
+    like = jax.tree.map(jnp.zeros_like, state)
+    restored = restore_train_state(
+        _os.path.join(trainer.run_dir, "checkpoints"), like, prefer_latest=True
+    )
+    assert int(restored.step) == int(state.step) == 3
+    # the normal SIGTERM disposition is restored after fit
+    assert _signal.getsignal(_signal.SIGTERM) == _signal.SIG_DFL
+
+
+def test_cli_resume_continues_run(tmp_path):
+    """--resume picks up the newest checkpoint and logs into the same dir."""
+    from perceiver_io_tpu.cli import train_img_clf
+    from perceiver_io_tpu.training import read_metrics
+
+    argv = [
+        "--synthetic", "--logdir", str(tmp_path / "logs"),
+        "--root", str(tmp_path / "cache"),
+        "--num_latents", "4", "--num_latent_channels", "16",
+        "--num_encoder_layers", "1", "--num_self_attention_layers_per_block", "1",
+        "--num_cross_attention_heads", "2", "--num_self_attention_heads", "2",
+        "--dtype", "float32", "--synthetic_size", "64", "--batch_size", "16",
+        "--max_steps", "3", "--log_every_n_steps", "1",
+    ]
+    run_dir = train_img_clf.main(argv)
+    steps1 = {r["step"] for r in read_metrics(run_dir) if "train_loss" in r}
+
+    # resume passes NO model/data args: every one must come back from the
+    # run's embedded hparams; only the explicitly-given flags change
+    resumed_dir = train_img_clf.main(
+        ["--resume", run_dir, "--max_steps", "6", "--log_every_n_steps", "1"]
+    )
+    assert resumed_dir == run_dir
+    steps2 = {r["step"] for r in read_metrics(run_dir) if "train_loss" in r}
+    assert max(steps2) == 6 and steps1 < steps2
