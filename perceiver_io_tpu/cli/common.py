@@ -84,6 +84,10 @@ def add_mesh_args(parser: argparse.ArgumentParser) -> None:
                    help="shard batches over the seq mesh axis: token axis for "
                         "text, first spatial axis for image/frames (must be "
                         "divisible by sp)")
+    g.add_argument("--multihost", action="store_true",
+                   help="call jax.distributed.initialize() before touching "
+                        "devices (TPU pods auto-detect the coordinator); "
+                        "without it every host trains independently")
 
 
 def add_compute_args(parser: argparse.ArgumentParser) -> None:
@@ -300,6 +304,23 @@ def override_model_args(args, hparams: dict) -> None:
             setattr(args, key, hparams[key])
 
 
+def maybe_initialize_distributed(args) -> None:
+    """Multi-host bring-up, gated on ``--multihost``. MUST run before any
+    device access (first use initializes the local-only backend)."""
+    if getattr(args, "multihost", False):
+        from perceiver_io_tpu.parallel import initialize_distributed
+
+        try:
+            initialize_distributed()
+        except ValueError as e:
+            raise SystemExit(
+                f"--multihost: jax.distributed.initialize failed ({e}). On a "
+                "TPU pod the coordinator is auto-detected; elsewhere set "
+                "JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID "
+                "or drop the flag for single-host runs."
+            ) from e
+
+
 def parse_with_resume(parser: argparse.ArgumentParser, argv):
     """Parse, and when ``--resume RUN_DIR`` is set, re-parse with the resumed
     run's embedded hparams installed as the parser's defaults.
@@ -316,8 +337,12 @@ def parse_with_resume(parser: argparse.ArgumentParser, argv):
 
     hparams = load_hparams(os.path.join(args.resume, "checkpoints"))
     known = vars(args)
+    # environment/bring-up flags describe where THIS invocation runs, not the
+    # training recipe — never inherit them from the original run (store_true
+    # flags have no --no_* spelling to override with)
+    env_flags = {"resume", "multihost", "dp", "tp", "sp", "shard_seq"}
     defaults = {
-        k: v for k, v in hparams.items() if k in known and k != "resume"
+        k: v for k, v in hparams.items() if k in known and k not in env_flags
     }
     parser.set_defaults(**defaults)
     args = parser.parse_args(argv)

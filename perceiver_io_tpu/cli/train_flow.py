@@ -51,6 +51,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[Sequence[str]] = None):
     args = common.parse_with_resume(build_parser(), argv)
+    common.maybe_initialize_distributed(args)
     image_shape = (args.image_height, args.image_width, args.image_channels)
 
     data = FlowDataModule(

@@ -61,6 +61,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[Sequence[str]] = None):
     args = common.parse_with_resume(build_parser(), argv)
+    common.maybe_initialize_distributed(args)
     # remat is the sane default at M = image_size² (opt out via --no_remat)
     if args.image_size >= 64 and not args.no_remat:
         args.remat = True

@@ -38,6 +38,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[Sequence[str]] = None):
     args = common.parse_with_resume(build_parser(), argv)
+    common.maybe_initialize_distributed(args)
 
     data = MNISTDataModule(
         root=args.root,

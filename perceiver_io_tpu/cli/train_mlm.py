@@ -95,6 +95,7 @@ def make_predict_hook(predict_fn, collator, samples: Sequence[str], k: int):
 
 def main(argv: Optional[Sequence[str]] = None):
     args = common.parse_with_resume(build_parser(), argv)
+    common.maybe_initialize_distributed(args)
 
     data = IMDBDataModule(
         root=args.root,

@@ -56,6 +56,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[Sequence[str]] = None):
     args = common.parse_with_resume(build_parser(), argv)
+    common.maybe_initialize_distributed(args)
     if args.mlm_checkpoint and args.clf_checkpoint:
         raise SystemExit("--mlm_checkpoint and --clf_checkpoint are exclusive")
     if args.resume and (args.mlm_checkpoint or args.clf_checkpoint):
