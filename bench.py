@@ -28,7 +28,6 @@ from __future__ import annotations
 
 import json
 import os
-import time
 
 
 def main() -> None:
@@ -104,28 +103,17 @@ def main() -> None:
     tx, schedule = make_optimizer(OptimizerConfig(learning_rate=1e-3))
     state = TrainState.create(variables["params"], tx, jax.random.key(2))
     train_step, _, _ = make_mlm_steps(model, schedule, loss_gather_capacity=gather or None)
-    step = jax.jit(train_step, donate_argnums=(0,))
 
-    # warmup / compile; float() fetch is the only reliable device sync here
-    for _ in range(3):
-        state, metrics = step(state, batch)
-    float(metrics["loss"])
+    from perceiver_io_tpu.utils.benchmarking import time_train_step
 
-    def timed(n: int):
-        nonlocal state
-        t0 = time.perf_counter()
-        for _ in range(n):
-            state, metrics = step(state, batch)
-        float(metrics["loss"])
-        return time.perf_counter() - t0
-
-    t_one = timed(1)  # sync round-trip + one step
-    elapsed = timed(steps + 1) - t_one
+    seconds_per_step, _ = time_train_step(
+        train_step, state, batch, steps, windows=3
+    )
 
     # the jitted step runs on exactly one device (no sharding here), so
     # per-chip throughput is the total regardless of how many chips the
     # host exposes
-    tokens_per_sec_per_chip = batch_size * seq_len * steps / elapsed
+    tokens_per_sec_per_chip = batch_size * seq_len / seconds_per_step
 
     baseline = None
     try:

@@ -88,6 +88,11 @@ def add_mesh_args(parser: argparse.ArgumentParser) -> None:
                    help="call jax.distributed.initialize() before touching "
                         "devices (TPU pods auto-detect the coordinator); "
                         "without it every host trains independently")
+    g.add_argument("--coordinator_address", default=None,
+                   help="host:port of process 0, for clusters JAX cannot "
+                        "auto-detect (implies --multihost)")
+    g.add_argument("--num_processes", type=int, default=None)
+    g.add_argument("--process_id", type=int, default=None)
 
 
 def add_compute_args(parser: argparse.ArgumentParser) -> None:
@@ -307,17 +312,28 @@ def override_model_args(args, hparams: dict) -> None:
 def maybe_initialize_distributed(args) -> None:
     """Multi-host bring-up, gated on ``--multihost``. MUST run before any
     device access (first use initializes the local-only backend)."""
-    if getattr(args, "multihost", False):
+    wants_distributed = (
+        getattr(args, "multihost", False)
+        or getattr(args, "coordinator_address", None) is not None
+        or getattr(args, "num_processes", None) is not None
+        or getattr(args, "process_id", None) is not None
+    )
+    if wants_distributed:
         from perceiver_io_tpu.parallel import initialize_distributed
 
         try:
-            initialize_distributed()
-        except ValueError as e:
+            initialize_distributed(
+                coordinator_address=getattr(args, "coordinator_address", None),
+                num_processes=getattr(args, "num_processes", None),
+                process_id=getattr(args, "process_id", None),
+            )
+        except (ValueError, RuntimeError) as e:
             raise SystemExit(
                 f"--multihost: jax.distributed.initialize failed ({e}). On a "
-                "TPU pod the coordinator is auto-detected; elsewhere set "
-                "JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID "
-                "or drop the flag for single-host runs."
+                "TPU pod the coordinator is auto-detected; elsewhere pass "
+                "--coordinator_address host:port --num_processes N "
+                "--process_id I on every process, or drop the flag for "
+                "single-host runs."
             ) from e
 
 
@@ -340,7 +356,8 @@ def parse_with_resume(parser: argparse.ArgumentParser, argv):
     # environment/bring-up flags describe where THIS invocation runs, not the
     # training recipe — never inherit them from the original run (store_true
     # flags have no --no_* spelling to override with)
-    env_flags = {"resume", "multihost", "dp", "tp", "sp", "shard_seq"}
+    env_flags = {"resume", "multihost", "coordinator_address", "num_processes",
+                 "process_id", "dp", "tp", "sp", "shard_seq"}
     defaults = {
         k: v for k, v in hparams.items() if k in known and k not in env_flags
     }

@@ -1,0 +1,60 @@
+"""Tunnel-safe train-step timing (the load-bearing measurement discipline).
+
+On tunneled/remote PJRT backends naive timing lies (PERF.md):
+``block_until_ready`` can return before device work completes, per-call
+scalar fetches cost a ~100 ms round trip, and dispatches whose outputs are
+never consumed get DCE'd. The one honest recipe, shared by ``bench.py`` and
+``tools/e2e_configs_bench.py``:
+
+- jit with a donated state and CHAIN iterations through it (nothing is dead),
+- sync by fetching the loss scalar (never ``block_until_ready``),
+- subtract a 1-iteration run so the fetch round trip doesn't count.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Tuple
+
+import jax
+
+
+def time_train_step(
+    train_step, state, batch, steps: int, windows: int = 1
+) -> Tuple[float, object]:
+    """Seconds per step of ``(state, batch) → (state, metrics)``; returns
+    ``(seconds_per_step, final_state)``. Compiles/warms once before timing.
+
+    ``steps`` is a lower bound: when the measured delta doesn't dwarf the
+    fetch round trip (sub-millisecond steps on a ~100 ms tunnel), the
+    iteration count grows until it does — otherwise round-trip jitter swamps
+    the signal (and can even make the subtraction negative).
+
+    ``windows``: number of measurement windows; the MEDIAN is returned. A
+    shared/tunneled chip shows occasional 1.5x-slow windows (contention);
+    with one window a single outlier becomes the recorded number."""
+    step = jax.jit(train_step, donate_argnums=(0,))
+
+    for _ in range(3):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])  # the only reliable device sync here
+
+    def timed(n: int) -> float:
+        nonlocal state
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, metrics = step(state, batch)
+        float(metrics["loss"])
+        return time.perf_counter() - t0
+
+    def one_window() -> float:
+        t_one = timed(1)  # fetch round trip + one step
+        n = steps
+        while True:
+            delta = timed(n + 1) - t_one
+            if delta > max(4.0 * t_one, 0.25) or n >= 65536:
+                return max(delta, 0.0) / n
+            n *= 4
+
+    samples = sorted(one_window() for _ in range(max(windows, 1)))
+    return samples[len(samples) // 2], state

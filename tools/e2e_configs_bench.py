@@ -1,0 +1,203 @@
+"""Developer tool: reproduce PERF.md's end-to-end config table.
+
+Times one full train step (fwd+bwd+optimizer, donated state, honest sync —
+see PERF.md's measurement discipline) for each BASELINE.md-tracked config on
+the current backend. Usage:
+
+    python tools/e2e_configs_bench.py [config ...]   # default: all
+
+Configs: mlm, mnist, imagenet, imagenet8h, flow, multimodal.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import perceiver_io_tpu as pit
+from perceiver_io_tpu.ops.masking import TextMasking
+from perceiver_io_tpu.training import (
+    OptimizerConfig,
+    TrainState,
+    make_classifier_steps,
+    make_flow_steps,
+    make_mlm_steps,
+    make_multimodal_steps,
+    make_optimizer,
+    mlm_gather_capacity,
+)
+
+STEPS = int(os.environ.get("PIT_BENCH_STEPS", "10"))
+DTYPE = jnp.bfloat16
+rng = np.random.default_rng(0)
+
+
+def _image_classifier(image_shape, num_classes, latents, channels, blocks,
+                      cross_heads, self_heads, bands):
+    return pit.PerceiverIO(
+        encoder=pit.PerceiverEncoder(
+            input_adapter=pit.ImageInputAdapter(
+                image_shape=image_shape, num_frequency_bands=bands, dtype=DTYPE
+            ),
+            latent_shape=(latents, channels),
+            num_layers=1,
+            num_cross_attention_heads=cross_heads,
+            num_self_attention_heads=self_heads,
+            num_self_attention_layers_per_block=blocks,
+            dtype=DTYPE,
+        ),
+        decoder=pit.PerceiverDecoder(
+            output_adapter=pit.ClassificationOutputAdapter(
+                num_classes=num_classes, num_output_channels=channels, dtype=DTYPE
+            ),
+            latent_shape=(latents, channels),
+            num_cross_attention_heads=cross_heads,
+            dtype=DTYPE,
+        ),
+    )
+
+
+def config_mlm():
+    """Flagship IMDB MLM (512 seq, 256x64 latents, 3x6 layers, batch 64)."""
+    vocab, seq, b = 10003, 512, 64
+    model = pit.PerceiverMLM(
+        encoder=pit.PerceiverEncoder(
+            input_adapter=pit.TextInputAdapter(
+                vocab_size=vocab, max_seq_len=seq, num_channels=64, dtype=DTYPE
+            ),
+            latent_shape=(256, 64), num_layers=3,
+            num_self_attention_layers_per_block=6, dtype=DTYPE,
+        ),
+        decoder=pit.PerceiverDecoder(
+            output_adapter=pit.TextOutputAdapter(
+                vocab_size=vocab, max_seq_len=seq, num_output_channels=64,
+                dtype=DTYPE,
+            ),
+            latent_shape=(256, 64), dtype=DTYPE,
+        ),
+        masking=TextMasking(vocab, 1, 2, 3),
+    )
+    batch = {
+        "token_ids": jnp.asarray(rng.integers(3, vocab, (b, seq)).astype(np.int32)),
+        "pad_mask": jnp.zeros((b, seq), bool),
+    }
+    variables = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        batch["token_ids"], batch["pad_mask"],
+    )
+    train_step, _, _ = make_mlm_steps(
+        model, loss_gather_capacity=mlm_gather_capacity(seq)
+    )
+    return variables, train_step, batch, b
+
+
+def config_mnist():
+    """MNIST recipe (28x28, 32x128 latents, 3 self-attn, batch 128)."""
+    b = 128
+    model = _image_classifier((28, 28, 1), 10, 32, 128, 3, 4, 4, 32)
+    batch = {
+        "image": jnp.asarray(rng.normal(0, 1, (b, 28, 28, 1)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 10, b).astype(np.int32)),
+    }
+    variables = model.init({"params": jax.random.key(0)}, batch["image"][:1])
+    train_step, _ = make_classifier_steps(model, input_kind="image")
+    return variables, train_step, batch, b
+
+
+def _imagenet(cross_heads):
+    b = 8
+    model = _image_classifier((224, 224, 3), 1000, 512, 1024, 6, cross_heads, 8, 64)
+    batch = {
+        "image": jnp.asarray(rng.normal(0, 1, (b, 224, 224, 3)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 1000, b).astype(np.int32)),
+    }
+    variables = model.init({"params": jax.random.key(0)}, batch["image"][:1])
+    train_step, _ = make_classifier_steps(model, input_kind="image")
+    return variables, train_step, batch, b
+
+
+def config_imagenet():
+    """ImageNet-1k paper config (224^2, 512x1024 latents, 1-head cross)."""
+    return _imagenet(1)
+
+
+def config_imagenet8h():
+    """ImageNet-1k, 8-head cross variant (the fused-kernel showcase)."""
+    return _imagenet(8)
+
+
+def config_flow():
+    """Sintel optical flow (368x496, 2048x512 latents, dense 2D queries)."""
+    from perceiver_io_tpu.models.flow import build_optical_flow_model
+
+    b = 1
+    model = build_optical_flow_model(dtype=DTYPE)
+    batch = {
+        "frames": jnp.asarray(rng.normal(0, 1, (b, 2, 368, 496, 3)), jnp.float32),
+        "flow": jnp.asarray(rng.normal(0, 1, (b, 368, 496, 2)), jnp.float32),
+    }
+    variables = model.init({"params": jax.random.key(0)}, batch["frames"][:1])
+    train_step, _ = make_flow_steps(model)
+    return variables, train_step, batch, b
+
+
+def config_multimodal():
+    """Kinetics-style AV autoencoding (16x224^2 video + audio, 784x512)."""
+    from perceiver_io_tpu.models.multimodal import build_multimodal_autoencoder
+
+    b = 2
+    video_shape = (16, 224, 224, 3)
+    model = build_multimodal_autoencoder(
+        video_shape=video_shape, num_audio_samples=30720, dtype=DTYPE, remat=True
+    )
+    batch = {
+        "video": jnp.asarray(rng.normal(0, 1, (b, *video_shape)), jnp.float32),
+        "audio": jnp.asarray(rng.normal(0, 1, (b, 30720, 1)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 700, b).astype(np.int32)),
+    }
+    variables = model.init(
+        {"params": jax.random.key(0)},
+        {"video": batch["video"][:1], "audio": batch["audio"][:1]},
+    )
+    train_step, _ = make_multimodal_steps(model)
+    return variables, train_step, batch, b
+
+
+CONFIGS = {
+    "mlm": config_mlm,
+    "mnist": config_mnist,
+    "imagenet": config_imagenet,
+    "imagenet8h": config_imagenet8h,
+    "flow": config_flow,
+    "multimodal": config_multimodal,
+}
+
+
+def run(name: str) -> None:
+    from perceiver_io_tpu.utils.benchmarking import time_train_step
+
+    variables, train_step, batch, batch_size = CONFIGS[name]()
+    tx, _ = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    state = TrainState.create(variables["params"], tx, jax.random.key(2))
+    seconds, _ = time_train_step(train_step, state, batch, STEPS, windows=3)
+    print(f"{name:12s} {seconds * 1e3:9.2f} ms/step   {batch_size / seconds:8.1f} ex/s")
+
+
+def main():
+    names = sys.argv[1:] or list(CONFIGS)
+    unknown = [n for n in names if n not in CONFIGS]
+    if unknown:
+        raise SystemExit(f"unknown configs {unknown}; pick from {sorted(CONFIGS)}")
+    print(f"device: {jax.devices()[0].device_kind}, {STEPS} steps per config")
+    for name in names:
+        run(name)
+
+
+if __name__ == "__main__":
+    main()
