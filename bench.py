@@ -40,8 +40,6 @@ def main() -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    import perceiver_io_tpu as pit
-    from perceiver_io_tpu.ops.masking import TextMasking
     from perceiver_io_tpu.training import (
         OptimizerConfig,
         TrainState,
@@ -63,30 +61,11 @@ def main() -> None:
     if gather < 0:
         gather = mlm_gather_capacity(seq_len)
 
-    latent_shape = (num_latents, channels)
-    model = pit.PerceiverMLM(
-        encoder=pit.PerceiverEncoder(
-            input_adapter=pit.TextInputAdapter(
-                vocab_size=vocab, max_seq_len=seq_len, num_channels=channels,
-                dtype=compute_dtype,
-            ),
-            latent_shape=latent_shape,
-            num_layers=3,
-            num_self_attention_layers_per_block=6,
-            dtype=compute_dtype,
-            attn_impl=attn_impl,
-        ),
-        decoder=pit.PerceiverDecoder(
-            output_adapter=pit.TextOutputAdapter(
-                vocab_size=vocab, max_seq_len=seq_len, num_output_channels=channels,
-                dtype=compute_dtype,
-            ),
-            latent_shape=latent_shape,
-            dtype=compute_dtype,
-            attn_impl=attn_impl,
-        ),
-        masking=TextMasking(vocab_size=vocab, unk_token_id=1, mask_token_id=2,
-                            num_special_tokens=3),
+    from perceiver_io_tpu.models.presets import flagship_mlm
+
+    model = flagship_mlm(
+        vocab_size=vocab, max_seq_len=seq_len, num_latents=num_latents,
+        num_channels=channels, dtype=compute_dtype, attn_impl=attn_impl,
     )
 
     rng = np.random.default_rng(0)

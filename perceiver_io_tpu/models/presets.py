@@ -1,0 +1,60 @@
+"""Named model presets shared by the benchmarks and the driver entry points.
+
+One definition of the flagship config so ``bench.py``,
+``tools/e2e_configs_bench.py`` and ``__graft_entry__.py`` cannot drift apart
+(the PERF.md table is sourced from these).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from perceiver_io_tpu.models.adapters import TextInputAdapter, TextOutputAdapter
+from perceiver_io_tpu.models.perceiver import (
+    PerceiverDecoder,
+    PerceiverEncoder,
+    PerceiverMLM,
+)
+from perceiver_io_tpu.ops.masking import TextMasking
+
+
+def flagship_mlm(
+    vocab_size: int = 10003,
+    max_seq_len: int = 512,
+    num_latents: int = 256,
+    num_channels: int = 64,
+    num_layers: int = 3,
+    num_self_attention_layers_per_block: int = 6,
+    dtype: jnp.dtype = jnp.float32,
+    attn_impl: str = "auto",
+) -> PerceiverMLM:
+    """The BASELINE.md north-star config: reference train_mlm shapes
+    (SURVEY.md §3.1 — 512-token sequences, 256 latents, 3 encoder layers ×
+    (cross-attention + 6-layer self-attention block), text in/out adapters)."""
+    latent_shape = (num_latents, num_channels)
+    return PerceiverMLM(
+        encoder=PerceiverEncoder(
+            input_adapter=TextInputAdapter(
+                vocab_size=vocab_size, max_seq_len=max_seq_len,
+                num_channels=num_channels, dtype=dtype,
+            ),
+            latent_shape=latent_shape,
+            num_layers=num_layers,
+            num_self_attention_layers_per_block=num_self_attention_layers_per_block,
+            dtype=dtype,
+            attn_impl=attn_impl,
+        ),
+        decoder=PerceiverDecoder(
+            output_adapter=TextOutputAdapter(
+                vocab_size=vocab_size, max_seq_len=max_seq_len,
+                num_output_channels=num_channels, dtype=dtype,
+            ),
+            latent_shape=latent_shape,
+            dtype=dtype,
+            attn_impl=attn_impl,
+        ),
+        masking=TextMasking(
+            vocab_size=vocab_size, unk_token_id=1, mask_token_id=2,
+            num_special_tokens=3,
+        ),
+    )

@@ -21,7 +21,6 @@ import jax.numpy as jnp
 import numpy as np
 
 import perceiver_io_tpu as pit
-from perceiver_io_tpu.ops.masking import TextMasking
 from perceiver_io_tpu.training import (
     OptimizerConfig,
     TrainState,
@@ -64,25 +63,12 @@ def _image_classifier(image_shape, num_classes, latents, channels, blocks,
 
 
 def config_mlm():
-    """Flagship IMDB MLM (512 seq, 256x64 latents, 3x6 layers, batch 64)."""
+    """Flagship IMDB MLM (512 seq, 256x64 latents, 3x6 layers, batch 64).
+    Matches bench.py's default env knobs (attn_impl='xla', gather decode)."""
+    from perceiver_io_tpu.models.presets import flagship_mlm
+
     vocab, seq, b = 10003, 512, 64
-    model = pit.PerceiverMLM(
-        encoder=pit.PerceiverEncoder(
-            input_adapter=pit.TextInputAdapter(
-                vocab_size=vocab, max_seq_len=seq, num_channels=64, dtype=DTYPE
-            ),
-            latent_shape=(256, 64), num_layers=3,
-            num_self_attention_layers_per_block=6, dtype=DTYPE,
-        ),
-        decoder=pit.PerceiverDecoder(
-            output_adapter=pit.TextOutputAdapter(
-                vocab_size=vocab, max_seq_len=seq, num_output_channels=64,
-                dtype=DTYPE,
-            ),
-            latent_shape=(256, 64), dtype=DTYPE,
-        ),
-        masking=TextMasking(vocab, 1, 2, 3),
-    )
+    model = flagship_mlm(dtype=DTYPE, attn_impl="xla")
     batch = {
         "token_ids": jnp.asarray(rng.integers(3, vocab, (b, seq)).astype(np.int32)),
         "pad_mask": jnp.zeros((b, seq), bool),
