@@ -20,7 +20,7 @@ import jax
 
 
 def time_train_step(
-    train_step, state, batch, steps: int, windows: int = 1
+    train_step, state, batch, steps: int, windows: int = 1, jitted=None
 ) -> Tuple[float, object]:
     """Seconds per step of ``(state, batch) → (state, metrics)``; returns
     ``(seconds_per_step, final_state)``. Compiles/warms once before timing.
@@ -32,8 +32,12 @@ def time_train_step(
 
     ``windows``: number of measurement windows; the MEDIAN is returned. A
     shared/tunneled chip shows occasional 1.5x-slow windows (contention);
-    with one window a single outlier becomes the recorded number."""
-    step = jax.jit(train_step, donate_argnums=(0,))
+    with one window a single outlier becomes the recorded number.
+
+    ``jitted``: pass a pre-built ``jax.jit(train_step, donate_argnums=(0,))``
+    wrapper to reuse its compiled executable (e.g. when the caller already
+    lowered it for cost analysis) — a fresh wrapper would compile again."""
+    step = jitted if jitted is not None else jax.jit(train_step, donate_argnums=(0,))
 
     for _ in range(3):
         state, metrics = step(state, batch)
