@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -17,8 +16,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-import perceiver_io_tpu as pit
-from perceiver_io_tpu.ops.masking import TextMasking
 from perceiver_io_tpu.training import (
     OptimizerConfig,
     TrainState,
@@ -27,49 +24,10 @@ from perceiver_io_tpu.training import (
     mlm_gather_capacity,
 )
 
-# bf16 peak FLOP/s per chip
-PEAK = {
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v4": 275e12,
-    "TPU v6 lite": 918e12,
-}
+def build(attn_impl: str):
+    from perceiver_io_tpu.models.presets import flagship_mlm
 
-
-def peak_flops() -> float:
-    kind = jax.devices()[0].device_kind
-    for name, val in PEAK.items():
-        if kind.startswith(name):
-            return val
-    return 197e12
-
-
-def build(attn_impl: str, vocab=10003, seq_len=512, num_latents=256, channels=64):
-    latent_shape = (num_latents, channels)
-    return pit.PerceiverMLM(
-        encoder=pit.PerceiverEncoder(
-            input_adapter=pit.TextInputAdapter(
-                vocab_size=vocab, max_seq_len=seq_len, num_channels=channels,
-                dtype=jnp.bfloat16,
-            ),
-            latent_shape=latent_shape,
-            num_layers=3,
-            num_self_attention_layers_per_block=6,
-            dtype=jnp.bfloat16,
-            attn_impl=attn_impl,
-        ),
-        decoder=pit.PerceiverDecoder(
-            output_adapter=pit.TextOutputAdapter(
-                vocab_size=vocab, max_seq_len=seq_len, num_output_channels=channels,
-                dtype=jnp.bfloat16,
-            ),
-            latent_shape=latent_shape,
-            dtype=jnp.bfloat16,
-            attn_impl=attn_impl,
-        ),
-        masking=TextMasking(vocab_size=vocab, unk_token_id=1, mask_token_id=2,
-                            num_special_tokens=3),
-    )
+    return flagship_mlm(dtype=jnp.bfloat16, attn_impl=attn_impl)
 
 
 def run(attn_impl: str, batch_size=64, steps=20, gather=None):
@@ -88,37 +46,28 @@ def run(attn_impl: str, batch_size=64, steps=20, gather=None):
     train_step, _, _ = make_mlm_steps(model, schedule, loss_gather_capacity=gather)
     step = jax.jit(train_step, donate_argnums=(0,))
 
-    lowered = step.lower(state, batch)
-    compiled = lowered.compile()
-    cost = compiled.cost_analysis()
-    flops = cost.get("flops", 0.0) if cost else 0.0
+    from perceiver_io_tpu.utils import profiling
 
-    # float() fetch is the only reliable sync on tunneled backends (PERF.md);
-    # the 1-step run subtracts the fetch round-trip.
-    for _ in range(3):
-        state, metrics = step(state, batch)
-    float(metrics["loss"])
+    flops = profiling.compiled_flops(step, state, batch) or 0.0
 
-    def timed(n):
-        nonlocal state, metrics
-        t0 = time.perf_counter()
-        for _ in range(n):
-            state, metrics = step(state, batch)
-        float(metrics["loss"])
-        return time.perf_counter() - t0
+    from perceiver_io_tpu.utils.benchmarking import time_train_step
 
-    t_one = timed(1)
-    dt = (timed(steps + 1) - t_one) / steps
+    dt, _ = time_train_step(train_step, state, batch, steps, windows=3, jitted=step)
 
     toks = batch_size * 512 / dt
-    mfu = flops / dt / peak_flops()
+    u = profiling.mfu(flops, dt)
+    mfu_str = f"  MFU {100 * u:.1f}%" if u is not None else ""
     tag = f"{attn_impl}+g{gather}" if gather else attn_impl
     print(f"{tag:12s} step {dt*1e3:7.2f} ms  {toks/1e6:6.2f} Mtok/s  "
-          f"flops/step {flops/1e9:.1f} G  MFU {mfu*100:.1f}%")
+          f"flops/step {flops/1e9:.1f} G{mfu_str}")
 
 
 if __name__ == "__main__":
-    print(f"device: {jax.devices()[0].device_kind}, peak {peak_flops()/1e12:.0f} TF/s")
+    from perceiver_io_tpu.utils import profiling
+
+    peak = profiling.device_peak_flops()
+    peak_str = f", peak {peak/1e12:.0f} TF/s" if peak else " (no known peak: MFU off)"
+    print(f"device: {jax.devices()[0].device_kind}{peak_str}")
     cap = mlm_gather_capacity(512)
     for impl in ("xla", "pallas"):
         run(impl)
