@@ -84,6 +84,9 @@ def add_mesh_args(parser: argparse.ArgumentParser) -> None:
                    help="shard batches over the seq mesh axis: token axis for "
                         "text, first spatial axis for image/frames (must be "
                         "divisible by sp)")
+    g.add_argument("--zero", dest="zero_opt", action="store_true",
+                   help="ZeRO-style optimizer-state sharding over the data "
+                        "axis (per-chip Adam mu/nu footprint / dp)")
     g.add_argument("--multihost", action="store_true",
                    help="call jax.distributed.initialize() before touching "
                         "devices (TPU pods auto-detect the coordinator); "
@@ -357,7 +360,7 @@ def parse_with_resume(parser: argparse.ArgumentParser, argv):
     # training recipe — never inherit them from the original run (store_true
     # flags have no --no_* spelling to override with)
     env_flags = {"resume", "multihost", "coordinator_address", "num_processes",
-                 "process_id", "dp", "tp", "sp", "shard_seq"}
+                 "process_id", "dp", "tp", "sp", "shard_seq", "zero_opt"}
     defaults = {
         k: v for k, v in hparams.items() if k in known and k not in env_flags
     }

@@ -99,6 +99,8 @@ def main(argv: Optional[Sequence[str]] = None):
         common.trainer_config(args),
         example_batch={k: example[k] for k in ("frames", "flow")},
         mesh=mesh,
+        shard_seq=args.shard_seq,
+        zero_opt=args.zero_opt,
         hparams=vars(args),
         run_dir=resume_dir,
     )

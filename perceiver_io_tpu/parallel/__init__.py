@@ -12,6 +12,7 @@ from perceiver_io_tpu.parallel.sharding import (
     sharding_for_tree,
     shard_train_state,
     make_sharded_train_step,
+    zero_state_shardings,
 )
 
 __all__ = [
@@ -26,4 +27,5 @@ __all__ = [
     "sharding_for_tree",
     "shard_train_state",
     "make_sharded_train_step",
+    "zero_state_shardings",
 ]

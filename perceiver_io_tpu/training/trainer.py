@@ -87,6 +87,8 @@ class Trainer:
       mesh: optional ``jax.sharding.Mesh`` — SPMD mode: params/opt-state are
         placed by the sharding rules, the batch shards over ``data`` (and
         optionally ``seq``), gradient sync becomes a compiler-inserted psum.
+      zero_opt: shard the optimizer state over ``data`` (ZeRO-style; SURVEY
+        §2.3) — per-chip Adam mu/nu footprint drops by the dp size.
       hparams: JSON-serializable config embedded in checkpoints
         (``save_hyperparameters`` parity).
       predict_hook: ``(state, logger, step) → None`` called after each
@@ -103,6 +105,7 @@ class Trainer:
         example_batch: Batch,
         mesh=None,
         shard_seq: bool = False,
+        zero_opt: bool = False,
         rules: Sequence = PARAM_RULES,
         hparams: Optional[Dict[str, Any]] = None,
         predict_hook: Optional[Callable] = None,
@@ -132,7 +135,7 @@ class Trainer:
             self._train_step, self.state, self._batch_shardings = (
                 make_sharded_train_step(
                     train_step, mesh, state, self._example_batch,
-                    rules=rules, shard_seq=shard_seq,
+                    rules=rules, shard_seq=shard_seq, zero_opt=zero_opt,
                 )
             )
         else:
