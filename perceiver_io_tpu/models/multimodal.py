@@ -465,10 +465,14 @@ def multimodal_autoencoding_loss(
         + audio_weight * audio_loss
         + label_weight * label_loss
     )
+    # PSNR over the [0, 1]-scaled video — the paper's reconstruction metric;
+    # derived from the already-computed MSE, so it costs nothing extra
+    video_psnr = -10.0 * jnp.log10(jnp.maximum(video_loss, 1e-10))
     metrics = {
         "video_loss": video_loss,
         "audio_loss": audio_loss,
         "label_loss": label_loss,
+        "video_psnr": video_psnr,
         "acc": label_acc,
     }
     return loss, metrics

@@ -195,7 +195,13 @@ def test_autoencoder_learns(rng):
         state, metrics = step(state, batch)
         losses.append(float(metrics["loss"]))
     assert losses[-1] < losses[0]
-    assert {"video_loss", "audio_loss", "label_loss", "acc"} <= metrics.keys()
+    assert {"video_loss", "audio_loss", "label_loss", "video_psnr",
+            "acc"} <= metrics.keys()
+    # PSNR must be consistent with the video MSE it derives from
+    np.testing.assert_allclose(
+        float(metrics["video_psnr"]),
+        -10 * np.log10(float(metrics["video_loss"])), rtol=1e-4,
+    )
 
     ev = eval_step(state, batch)
     assert np.isfinite(float(ev["loss"]))
