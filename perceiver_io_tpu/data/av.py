@@ -8,7 +8,7 @@ structure — each class fixes an audio tone frequency and a video drift
 direction, so classification, audio reconstruction, and video reconstruction
 all have learnable signal. A directory layout reader
 (``<root>/av/<split>/<class>/<clip>.npz`` with arrays ``video`` (T, H, W, C)
-float in [0, 1] — uint8-range clips are auto-rescaled — and ``audio``
+float in [0, 1] — integer-dtype clips are auto-rescaled by 1/255 — and ``audio``
 (S, C_a)) covers real pre-extracted data. The [0, 1] video contract is what
 makes the logged ``video_psnr`` comparable to published numbers.
 """
@@ -115,13 +115,16 @@ def load_av_tree(
                     or len(audio) < num_audio_samples
                     or audio.shape[1] < num_audio_channels):
                 continue
-            # the model contract (and the video_psnr metric) expects video in
-            # [0, 1]; uint8-range clips are rescaled here
-            video = video.astype(np.float32)
-            if video.max() > 1.5:
-                video = video / 255.0
+            # crop first (a float copy of an uncropped 1080p clip would be
+            # GBs), then enforce the [0, 1] contract the model and the
+            # video_psnr metric expect — integer-dtype clips are pixel-valued
             top, left = (vh - h) // 2, (vw - w) // 2
-            videos.append(video[:t, top : top + h, left : left + w, :c])
+            crop = video[:t, top : top + h, left : left + w, :c]
+            if np.issubdtype(crop.dtype, np.integer):
+                crop = crop.astype(np.float32) / 255.0
+            else:
+                crop = crop.astype(np.float32)
+            videos.append(crop)
             audios.append(audio[:num_audio_samples, :num_audio_channels])
             labels.append(label)
     if not videos:

@@ -71,6 +71,18 @@ def test_load_av_tree(tmp_path):
     assert videos[labels == 0].max() == 0.25
     assert videos[labels == 1].max() == 0.75
 
+    # integer-dtype clips are rescaled to [0, 1] (dtype-dispatched, so even
+    # an all-dark uint8 clip scales consistently)
+    d8 = root / "train" / "uint8clips"
+    os.makedirs(d8)
+    np.savez(d8 / "c.npz",
+             video=np.full((4, 8, 8, 3), 128, np.uint8),
+             audio=np.zeros((128, 1), np.float32))
+    v8, _, l8, classes8 = load_av_tree(str(root), "train", (2, 8, 8, 3), 64, 1)
+    uint8_label = classes8.index("uint8clips")
+    uint8_videos = v8[l8 == uint8_label]
+    np.testing.assert_allclose(uint8_videos, 128 / 255, atol=1e-6)
+
     with pytest.raises(FileNotFoundError):
         load_av_tree(str(root), "missing_split", (2, 8, 8, 3), 64, 1)
     # clips smaller than the request are skipped; all-skipped raises

@@ -1,0 +1,74 @@
+"""Shared benchmark harness + model presets (the PERF.md-table sources)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from perceiver_io_tpu.models.presets import flagship_mlm
+from perceiver_io_tpu.training import (
+    OptimizerConfig,
+    TrainState,
+    make_mlm_steps,
+    make_optimizer,
+)
+from perceiver_io_tpu.utils.benchmarking import time_train_step
+
+
+def _tiny_setup():
+    model = flagship_mlm(
+        vocab_size=50, max_seq_len=16, num_latents=4, num_channels=16,
+        num_layers=1, num_self_attention_layers_per_block=1,
+    )
+    rng = np.random.default_rng(0)
+    batch = {
+        "token_ids": jnp.asarray(rng.integers(3, 50, (2, 16)).astype(np.int32)),
+        "pad_mask": jnp.zeros((2, 16), bool),
+    }
+    variables = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        batch["token_ids"], batch["pad_mask"],
+    )
+    tx, _ = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    state = TrainState.create(variables["params"], tx, jax.random.key(2))
+    train_step, _, _ = make_mlm_steps(model)
+    return train_step, state, batch
+
+
+def test_time_train_step_returns_positive_and_advances_state():
+    train_step, state, batch = _tiny_setup()
+    seconds, final_state = time_train_step(train_step, state, batch, steps=2)
+    assert seconds > 0
+    # warmup (3) + t_one (1) + at least `steps`+1 timed iterations ran
+    assert int(jax.device_get(final_state.step)) >= 7
+
+
+def test_time_train_step_accepts_prebuilt_jit():
+    train_step, state, batch = _tiny_setup()
+    jitted = jax.jit(train_step, donate_argnums=(0,))
+    seconds, _ = time_train_step(
+        train_step, state, batch, steps=2, windows=2, jitted=jitted
+    )
+    assert seconds > 0
+
+
+def test_flagship_preset_matches_graft_entry():
+    """__graft_entry__ must build exactly the preset (the driver's compile
+    check and the benches must agree on the flagship model)."""
+    import __graft_entry__ as g
+
+    entry_model = g._build_flagship(
+        vocab_size=50, max_seq_len=16, num_latents=4, num_channels=16,
+        num_layers=1, blocks=1,
+    )
+    preset = flagship_mlm(
+        vocab_size=50, max_seq_len=16, num_latents=4, num_channels=16,
+        num_layers=1, num_self_attention_layers_per_block=1,
+    )
+    ids = jnp.zeros((1, 16), jnp.int32)
+    pad = jnp.zeros((1, 16), bool)
+    rngs = {"params": jax.random.key(0), "masking": jax.random.key(1)}
+    p1 = entry_model.init(rngs, ids, pad)["params"]
+    p2 = preset.init(rngs, ids, pad)["params"]
+    assert jax.tree_util.tree_structure(p1) == jax.tree_util.tree_structure(p2)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
