@@ -156,3 +156,25 @@ def test_train_flow(tmp_path):
     assert any("train_loss" in r for r in rows)
     assert any("val_loss" in r for r in rows)
     assert os.path.isdir(os.path.join(run_dir, "checkpoints"))
+
+
+def test_all_parsers_build_and_render_help():
+    """Every entry point's composed parser builds without argparse conflicts
+    and renders help (cheap guard for flag collisions across the shared
+    argument groups)."""
+    from perceiver_io_tpu.cli import (
+        train_flow,
+        train_imagenet,
+        train_img_clf,
+        train_mlm,
+        train_multimodal,
+        train_seq_clf,
+    )
+
+    for mod in (train_mlm, train_seq_clf, train_img_clf,
+                train_imagenet, train_flow, train_multimodal):
+        parser = mod.build_parser()
+        help_text = parser.format_help()
+        for flag in ("--dp", "--tp", "--sp", "--zero", "--multihost",
+                     "--resume", "--attn_impl", "--dtype"):
+            assert flag in help_text, f"{mod.__name__} missing {flag}"
