@@ -128,6 +128,8 @@ def add_imdb_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--synthetic", action="store_true",
                    help="deterministic generated corpus (no downloads)")
     g.add_argument("--synthetic_size", type=int, default=2048)
+    g.add_argument("--no_download", action="store_true",
+                   help="fail fast if data is absent instead of fetching it")
 
 
 def add_mnist_args(parser: argparse.ArgumentParser) -> None:
@@ -140,6 +142,8 @@ def add_mnist_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--random_crop", type=int, default=None)
     g.add_argument("--synthetic", action="store_true")
     g.add_argument("--synthetic_size", type=int, default=4096)
+    g.add_argument("--no_download", action="store_true",
+                   help="fail fast if data is absent instead of fetching it")
 
 
 # -- builders ----------------------------------------------------------------

@@ -107,6 +107,7 @@ def main(argv: Optional[Sequence[str]] = None):
         seed=args.seed,
         shard_id=jax.process_index(),
         num_shards=jax.process_count(),
+        download=not args.no_download,
     )
     data.prepare_data()
     data.setup()

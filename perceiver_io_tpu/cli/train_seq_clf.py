@@ -10,6 +10,14 @@ Three init modes, mirroring ``train_seq_clf.py:18-28``:
 - ``--clf_checkpoint <run_dir/checkpoints>``: resume a classifier run;
 - neither: train from scratch.
 
+Both checkpoint flags also accept a reference PyTorch-Lightning ``.ckpt``
+FILE (the artifacts the reference publishes, ``README.md:46-48``) — the torch
+state_dict is converted on the fly (``perceiver_io_tpu/interop.py``), so the
+reference's pretrained-weights workflow transfers unchanged. A ``.ckpt``
+carries no compatible optimizer state, so ``--clf_checkpoint model.ckpt``
+restores weights and starts a fresh optimizer (the reference's
+``load_from_checkpoint`` does the same, ``train_seq_clf.py:26``).
+
 Reference per-task defaults (``train_seq_clf.py:56-68``): batch 128,
 weight_decay 1e-3, dropout 0.1.
 """
@@ -31,6 +39,64 @@ from perceiver_io_tpu.training.checkpoint import (
 )
 from perceiver_io_tpu.training.steps import freeze_subtrees
 from perceiver_io_tpu.training.trainer import Trainer
+
+
+def _is_torch_ckpt(path: str) -> bool:
+    import os
+
+    return os.path.isfile(path) and path.endswith(".ckpt")
+
+
+def _check_tree(imported, like, source: str):
+    """Imported params must exactly match the fresh model's tree — a mismatch
+    means the .ckpt was trained with different shapes/hparams."""
+    import jax
+
+    imported_paths = {
+        jax.tree_util.keystr(p): leaf.shape
+        for p, leaf in jax.tree_util.tree_leaves_with_path(imported)
+    }
+    like_paths = {
+        jax.tree_util.keystr(p): leaf.shape
+        for p, leaf in jax.tree_util.tree_leaves_with_path(like)
+    }
+    if imported_paths != like_paths:
+        missing = sorted(set(like_paths) - set(imported_paths))
+        extra = sorted(set(imported_paths) - set(like_paths))
+        mismatched = sorted(
+            k for k in set(like_paths) & set(imported_paths)
+            if like_paths[k] != imported_paths[k]
+        )
+        raise SystemExit(
+            f"imported checkpoint {source} does not fit the model: "
+            f"missing={missing[:4]} extra={extra[:4]} shape-mismatch={mismatched[:4]}"
+        )
+    return imported
+
+
+def _warn_if_vocab_mismatch(tokenizer_path: str, ckpt: str) -> None:
+    """A reference .ckpt's embedding rows are indexed by the reference's
+    exact vocab. A locally-trained WordPiece of the same size passes every
+    shape check while assigning different ids — warn loudly so the silent
+    quality degradation is visible. (The reference's cached HF tokenizer
+    JSON drops in at ``<root>/imdb-tokenizer-10003.json``.)"""
+    import json
+    import warnings
+
+    try:
+        with open(tokenizer_path, encoding="utf-8") as f:
+            native = json.load(f).get("format", "").startswith("perceiver_io_tpu")
+    except (OSError, ValueError):
+        native = False
+    if native:
+        warnings.warn(
+            f"importing {ckpt} while using a locally-trained tokenizer "
+            f"({tokenizer_path}): token ids almost certainly differ from the "
+            f"vocab the checkpoint was trained with, so pretrained embeddings "
+            f"will be misaligned. Drop the reference's tokenizer JSON at that "
+            f"path (tools/import_reference.py tokenizer) for exact ids.",
+            stacklevel=2,
+        )
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -68,9 +134,16 @@ def main(argv: Optional[Sequence[str]] = None):
 
     # a restored encoder must be rebuilt with the shapes it was trained with
     source_ckpt = args.mlm_checkpoint or args.clf_checkpoint
-    if source_ckpt:
-        common.override_model_args(args, load_hparams(source_ckpt))
-    if args.clf_checkpoint:
+    imported_params = None  # set when the source is a reference .ckpt file
+    if source_ckpt and _is_torch_ckpt(source_ckpt):
+        from perceiver_io_tpu.interop import import_lightning_checkpoint
+
+        imported_params, source_hparams = import_lightning_checkpoint(source_ckpt)
+        common.override_model_args(args, source_hparams)
+    elif source_ckpt:
+        source_hparams = load_hparams(source_ckpt)
+        common.override_model_args(args, source_hparams)
+    if args.clf_checkpoint and imported_params is None:
         # resume also restores the training setup: the optimizer-state pytree
         # structure depends on these (load_from_checkpoint parity,
         # reference lightning.py:46 + train_seq_clf.py:26)
@@ -89,10 +162,14 @@ def main(argv: Optional[Sequence[str]] = None):
         seed=args.seed,
         shard_id=jax.process_index(),
         num_shards=jax.process_count(),
+        download=not args.no_download,
     )
     data.prepare_data()
     data.setup()
     vocab_size = data.tokenizer.get_vocab_size()
+
+    if imported_params is not None:
+        _warn_if_vocab_mismatch(data.tokenizer_path, source_ckpt)
 
     model = common.build_text_classifier(args, vocab_size, args.max_seq_len)
     example = next(iter(data.val_dataloader()))
@@ -104,9 +181,16 @@ def main(argv: Optional[Sequence[str]] = None):
 
     if args.mlm_checkpoint:
         params = dict(params)
-        params["encoder"] = restore_encoder_params(
-            args.mlm_checkpoint, params["encoder"]
-        )
+        if imported_params is not None:
+            params["encoder"] = _check_tree(
+                imported_params["encoder"], params["encoder"], args.mlm_checkpoint
+            )
+        else:
+            params["encoder"] = restore_encoder_params(
+                args.mlm_checkpoint, params["encoder"]
+            )
+    if args.clf_checkpoint and imported_params is not None:
+        params = _check_tree(imported_params, params, args.clf_checkpoint)
 
     tx, schedule = common.optimizer_from_args(args)
     if args.freeze_encoder:
@@ -114,7 +198,7 @@ def main(argv: Optional[Sequence[str]] = None):
     state = TrainState.create(params, tx, jax.random.key(args.seed + 2))
     state, resume_dir = common.resume_state(args, state)
 
-    if args.clf_checkpoint:
+    if args.clf_checkpoint and imported_params is None:
         state = restore_train_state(args.clf_checkpoint, state)
 
     train_step, eval_step = make_classifier_steps(

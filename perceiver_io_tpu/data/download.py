@@ -1,0 +1,193 @@
+"""Guarded dataset downloaders (IMDB tarball, MNIST idx files).
+
+The reference downloads IMDB through torchtext (reference
+``data/imdb.py:115-117``) and MNIST through torchvision with patched
+md5-verified resources (reference ``data/mnist.py:9-14``). This module is the
+first-party equivalent: stdlib-urllib fetch with mirror fallback, md5
+verification, atomic writes (tmp + rename, so an interrupted download never
+poisons the cache), and tar/gzip extraction.
+
+Everything is *guarded*: the data modules call ``ensure_*`` only when local
+data is absent, and a network failure surfaces one clear error naming the
+offline alternatives (pre-placing the tree, or ``--synthetic``). On a
+zero-egress box the guarded path is exercised by tests against a localhost
+HTTP server.
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import os
+import shutil
+import tarfile
+import tempfile
+import urllib.error
+import urllib.request
+from typing import Optional, Sequence
+
+# Stanford AI original; the only canonical source (what torchtext fetches),
+# with torchtext's pinned md5 for the tarball.
+IMDB_URLS = [
+    "https://ai.stanford.edu/~amaas/data/sentiment/aclImdb_v1.tar.gz",
+]
+IMDB_MD5 = "7c2ac02c03563afcf9b574c7e56c153a"
+
+# (filename, md5) pairs exactly as the reference pins them
+# (reference data/mnist.py:9-14); mirrors tried in order.
+MNIST_FILES = [
+    ("train-images-idx3-ubyte.gz", "f68b3c2dcbeaaa9fbdd348bbdeb94873"),
+    ("train-labels-idx1-ubyte.gz", "d53e105ee54ea40749a09fcbcd1e9432"),
+    ("t10k-images-idx3-ubyte.gz", "9fb629c4189551a2d022fa330f9573f3"),
+    ("t10k-labels-idx1-ubyte.gz", "ec29112dd5afa0611ce80d1b7f02629c"),
+]
+MNIST_MIRRORS = [
+    "https://ossci-datasets.s3.amazonaws.com/mnist/",
+    "http://yann.lecun.com/exdb/mnist/",
+]
+
+
+class DownloadError(RuntimeError):
+    """A dataset could not be fetched (offline box, dead mirror, bad hash)."""
+
+
+def _md5(path: str) -> str:
+    digest = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
+def download_file(
+    url: str, dest: str, md5: Optional[str] = None, timeout: float = 60.0
+) -> str:
+    """Fetch ``url`` to ``dest`` atomically; verify ``md5`` when given."""
+    os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(dest) or ".", suffix=".part")
+    try:
+        with os.fdopen(fd, "wb") as out, urllib.request.urlopen(
+            url, timeout=timeout
+        ) as resp:
+            shutil.copyfileobj(resp, out)
+        if md5 is not None:
+            got = _md5(tmp)
+            if got != md5:
+                raise DownloadError(
+                    f"checksum mismatch for {url}: expected {md5}, got {got}"
+                )
+        os.replace(tmp, dest)
+        return dest
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def download_any(
+    urls: Sequence[str], dest: str, md5: Optional[str] = None,
+    timeout: float = 60.0,
+) -> str:
+    """Try each mirror in order; raise :class:`DownloadError` naming every
+    failure if none succeeds."""
+    failures = []
+    for url in urls:
+        try:
+            return download_file(url, dest, md5=md5, timeout=timeout)
+        except (urllib.error.URLError, OSError, DownloadError) as e:
+            failures.append(f"{url}: {e}")
+    raise DownloadError(
+        "all mirrors failed:\n  " + "\n  ".join(failures)
+    )
+
+
+def ensure_imdb(
+    root: str, urls: Optional[Sequence[str]] = None,
+    md5: Optional[str] = "default", timeout: float = 60.0,
+) -> str:
+    """Make ``<root>/IMDB/aclImdb`` exist, downloading + extracting the
+    tarball if absent (the torchtext step at reference ``imdb.py:115-117``).
+    Extraction is atomic (temp dir + rename), so an interrupted run never
+    leaves a partial tree that later runs mistake for complete. Returns the
+    aclImdb directory path."""
+    if md5 == "default":
+        md5 = IMDB_MD5 if urls is None else None
+    target = os.path.join(root, "IMDB", "aclImdb")
+    if os.path.isdir(os.path.join(target, "train")):
+        return target
+    dest_dir = os.path.join(root, "IMDB")
+    os.makedirs(dest_dir, exist_ok=True)
+    tarball = os.path.join(dest_dir, "aclImdb_v1.tar.gz")
+    if os.path.exists(tarball) and md5 is not None and _md5(tarball) != md5:
+        os.unlink(tarball)  # corrupt/truncated leftover: re-fetch
+    if not os.path.exists(tarball):
+        try:
+            download_any(urls or IMDB_URLS, tarball, md5=md5, timeout=timeout)
+        except DownloadError as e:
+            raise DownloadError(
+                f"IMDB is not present under {target} and could not be "
+                f"downloaded. Offline alternatives: extract aclImdb_v1.tar.gz "
+                f"to {dest_dir}, or pass synthetic=True / --synthetic.\n{e}"
+            ) from e
+    staging = tempfile.mkdtemp(dir=dest_dir, prefix=".aclImdb-extract-")
+    try:
+        with tarfile.open(tarball, "r:gz") as tar:
+            # reject traversal and link members in an untrusted archive
+            for member in tar.getmembers():
+                path = os.path.normpath(member.name)
+                if path.startswith(("/", "..")) or member.issym() or member.islnk():
+                    raise DownloadError(f"unsafe tar member {member.name!r}")
+            try:
+                tar.extractall(staging, filter="data")
+            except TypeError:  # Python < 3.12: no filter=; the check above holds
+                tar.extractall(staging)
+        extracted = os.path.join(staging, "aclImdb")
+        if not os.path.isdir(extracted):
+            raise DownloadError(f"{tarball} does not contain an aclImdb/ tree")
+        if os.path.isdir(target):
+            # a partial tree from an interrupted earlier extraction (we only
+            # early-return when train/ exists) — replace it wholesale
+            shutil.rmtree(target)
+        os.replace(extracted, target)
+    finally:
+        shutil.rmtree(staging, ignore_errors=True)
+    return target
+
+
+def ensure_mnist(
+    root: str, mirrors: Optional[Sequence[str]] = None, timeout: float = 60.0
+) -> str:
+    """Make ``<root>/MNIST/raw`` hold the four idx files, downloading any that
+    are missing from the md5-pinned mirror list (reference
+    ``mnist.py:9-14``). Files are stored unpacked (``.gz`` kept too, matching
+    torchvision's layout). Returns the raw directory path."""
+    raw = os.path.join(root, "MNIST", "raw")
+    os.makedirs(raw, exist_ok=True)
+    for gz_name, md5 in MNIST_FILES:
+        plain = os.path.join(raw, gz_name[:-3])
+        gz = os.path.join(raw, gz_name)
+        if os.path.exists(plain):
+            continue
+        if os.path.exists(gz):
+            if md5 is None or _md5(gz) == md5:
+                continue
+            os.unlink(gz)  # corrupt/truncated leftover: re-fetch
+        try:
+            download_any(
+                [m + gz_name for m in (mirrors or MNIST_MIRRORS)], gz,
+                md5=md5, timeout=timeout,
+            )
+        except DownloadError as e:
+            raise DownloadError(
+                f"MNIST file {gz_name} is not present under {raw} and could "
+                f"not be downloaded. Offline alternatives: place the idx "
+                f"files at {raw}, or pass synthetic=True / --synthetic.\n{e}"
+            ) from e
+        fd, tmp = tempfile.mkstemp(dir=raw, suffix=".part")
+        try:
+            with gzip.open(gz, "rb") as src, os.fdopen(fd, "wb") as dst:
+                shutil.copyfileobj(src, dst)
+            os.replace(tmp, plain)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    return raw

@@ -10,10 +10,10 @@ first use, and collates batches by padding/truncating to ``max_seq_len`` with
 Differences, by design:
 
 - tokenization is first-party (``data/tokenizer.py``) — no Rust dependency;
-- this box has zero egress, so there is no downloader; ``synthetic=True``
-  substitutes a deterministic generated corpus with the same interface
-  (word-soup reviews with a sentiment-correlated vocabulary) for tests,
-  benchmarks, and smoke training;
+- the download step (reference ``imdb.py:115-117`` via torchtext) is a
+  first-party guarded fetch (``data/download.py``): attempted only when the
+  local tree is absent, with ``download=False`` and ``synthetic=True`` as
+  offline modes (a zero-egress box gets one clear error naming both);
 - batches are dicts of numpy arrays feeding the SPMD input pipeline
   (``data/pipeline.py``) instead of torch tensors.
 """
@@ -149,8 +149,10 @@ class IMDBDataModule:
         seed: int = 0,
         shard_id: int = 0,
         num_shards: int = 1,
+        download: bool = True,
     ):
         self.root = root
+        self.download = download
         self.max_seq_len = max_seq_len
         self.vocab_size = vocab_size
         self.batch_size = batch_size
@@ -188,8 +190,14 @@ class IMDBDataModule:
         return load_split(self.root, "test")  # val = test split, as the reference
 
     def prepare_data(self):
-        """Train + cache the WordPiece tokenizer on first run (rank-0 work;
-        reference ``imdb.py:114-126``)."""
+        """Download-if-absent, then train + cache the WordPiece tokenizer on
+        first run (rank-0 work; reference ``imdb.py:114-126``)."""
+        if not self.synthetic and self.download and not os.path.isdir(
+            os.path.join(self.root, "IMDB", "aclImdb", "train")
+        ):
+            from perceiver_io_tpu.data.download import ensure_imdb
+
+            ensure_imdb(self.root)
         if os.path.exists(self.tokenizer_path):
             return
         os.makedirs(self.root, exist_ok=True)

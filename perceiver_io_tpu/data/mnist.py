@@ -148,8 +148,10 @@ class MNISTDataModule:
         seed: int = 0,
         shard_id: int = 0,
         num_shards: int = 1,
+        download: bool = True,
     ):
         self.root = root
+        self.download = download
         self.batch_size = batch_size
         self.random_crop = random_crop
         self.val_split = val_split
@@ -176,10 +178,18 @@ class MNISTDataModule:
         return (s, s, 1) if s else (28, 28, 1)
 
     def prepare_data(self):
-        """No downloader on a zero-egress box: validate local data exists
-        (or synthetic mode)."""
-        if not self.synthetic:
-            _find(self.root, _FILES["train_images"])
+        """Download-if-absent (md5-pinned mirrors, reference ``mnist.py:9-14``),
+        then validate local data exists (or synthetic mode)."""
+        if self.synthetic:
+            return
+        if self.download:
+            # per-file idempotent: fetches only what's missing, so a
+            # partially-populated raw/ dir is completed rather than trusted
+            from perceiver_io_tpu.data.download import ensure_mnist
+
+            ensure_mnist(self.root)
+        for base in _FILES.values():
+            _find(self.root, base)
 
     def setup(self):
         if self.synthetic:

@@ -297,22 +297,179 @@ class WordPieceTokenizer:
 
     # -- persistence -------------------------------------------------------
 
-    def save(self, path: str):
-        payload = {
-            "format": "perceiver_io_tpu.wordpiece.v1",
-            "vocab": self.vocab,
-            "replacements": self.replacements,
-        }
+    def save(self, path: str, format: str = "native"):
+        """Write the tokenizer as JSON.
+
+        ``format='native'`` is this framework's compact schema;
+        ``format='hf'`` emits the HuggingFace ``tokenizers`` schema the
+        reference caches (reference ``tokenizer.py:26-36``), loadable by the
+        HF Rust library and by :meth:`from_file` alike.
+        """
+        if format == "native":
+            payload = {
+                "format": "perceiver_io_tpu.wordpiece.v1",
+                "vocab": self.vocab,
+                "replacements": self.replacements,
+            }
+        elif format == "hf":
+            payload = self.to_hf_dict()
+        else:
+            raise ValueError(f"format must be 'native' or 'hf', got {format!r}")
         with open(path, "w", encoding="utf-8") as f:
             json.dump(payload, f, ensure_ascii=False)
+
+    def to_hf_dict(self) -> dict:
+        """This tokenizer in the HF ``tokenizers`` JSON schema (the pipeline
+        the reference builds at ``tokenizer.py:26-36``: Replace* → NFD →
+        Lowercase → StripAccents, Whitespace pre-tokenizer, WordPiece model +
+        decoder, specials registered as added tokens)."""
+        normalizers = [
+            {"type": "Replace", "pattern": {"String": old}, "content": new}
+            for old, new in self.replacements
+        ] + [{"type": "NFD"}, {"type": "Lowercase"}, {"type": "StripAccents"}]
+        return {
+            "version": "1.0",
+            "truncation": None,
+            "padding": None,
+            "added_tokens": [
+                {
+                    "id": self.vocab[t], "special": True, "content": t,
+                    "single_word": False, "lstrip": False, "rstrip": False,
+                    "normalized": False,
+                }
+                for t in SPECIAL_TOKENS if t in self.vocab
+            ],
+            "normalizer": {"type": "Sequence", "normalizers": normalizers},
+            "pre_tokenizer": {"type": "Whitespace"},
+            "post_processor": None,
+            "decoder": {
+                "type": "WordPiece",
+                "prefix": CONTINUATION_PREFIX,
+                "cleanup": True,
+            },
+            "model": {
+                "type": "WordPiece",
+                "unk_token": UNK_TOKEN,
+                "continuing_subword_prefix": CONTINUATION_PREFIX,
+                "max_input_chars_per_word": MAX_CHARS_PER_WORD,
+                "vocab": self.vocab,
+            },
+        }
+
+    @classmethod
+    def from_hf_dict(cls, payload: dict) -> "WordPieceTokenizer":
+        """Build from the HF ``tokenizers`` JSON schema — the format of the
+        reference's cached artifact (``.cache/imdb-tokenizer-10003.json``).
+        Token ids index embedding rows, so loading the exact reference vocab
+        is what makes an imported reference checkpoint usable.
+
+        Raises on pipeline components this implementation does not reproduce
+        (anything beyond Replace/NFD/Lowercase/StripAccents normalizers, the
+        Whitespace pre-tokenizer, and a WordPiece model) — silently dropping
+        one would change token ids.
+        """
+        model = payload.get("model") or {}
+        if model.get("type") != "WordPiece":
+            raise ValueError(
+                f"unsupported tokenizer model {model.get('type')!r} (need WordPiece)"
+            )
+        prefix = model.get("continuing_subword_prefix", CONTINUATION_PREFIX)
+        if prefix != CONTINUATION_PREFIX:
+            raise ValueError(f"unsupported continuation prefix {prefix!r}")
+        unk = model.get("unk_token", UNK_TOKEN)
+        if unk != UNK_TOKEN:
+            raise ValueError(f"unsupported unk_token {unk!r} (need {UNK_TOKEN!r})")
+        max_chars = model.get("max_input_chars_per_word", MAX_CHARS_PER_WORD)
+        if max_chars != MAX_CHARS_PER_WORD:
+            raise ValueError(
+                f"unsupported max_input_chars_per_word {max_chars} "
+                f"(need {MAX_CHARS_PER_WORD})"
+            )
+        if payload.get("post_processor") is not None:
+            raise ValueError(
+                "post_processor pipelines are not supported (they add tokens "
+                "this implementation would not reproduce)"
+            )
+
+        # encode() unconditionally applies Replace* → NFD → Lowercase →
+        # StripAccents then Whitespace splitting, so the file must declare
+        # EXACTLY that pipeline (leading Replaces + those three, in order) —
+        # anything else (normalizer: null, cased vocab, different order)
+        # would produce different ids than the HF library
+        normalizer = payload.get("normalizer")
+        entries = []
+        if normalizer is not None:
+            entries = (
+                normalizer.get("normalizers", [])
+                if normalizer.get("type") == "Sequence" else [normalizer]
+            )
+        replacements = []
+        tail = []
+        for entry in entries:
+            kind = entry.get("type")
+            if kind == "Replace":
+                if tail:
+                    # normalize() applies replacements FIRST; a Replace after
+                    # case-folding would see different text than here
+                    raise ValueError(
+                        "Replace normalizers after NFD/Lowercase/StripAccents "
+                        "are not supported"
+                    )
+                pattern = entry.get("pattern", {})
+                if "String" not in pattern:
+                    raise ValueError("only literal-string Replace is supported")
+                replacements.append((pattern["String"], entry.get("content", "")))
+            elif kind in ("NFD", "Lowercase", "StripAccents"):
+                tail.append(kind)
+            else:
+                raise ValueError(f"unsupported normalizer {kind!r}")
+        if tail != ["NFD", "Lowercase", "StripAccents"]:
+            raise ValueError(
+                f"normalizer pipeline must be Replace* -> NFD -> Lowercase -> "
+                f"StripAccents (this implementation always applies all "
+                f"three), got {tail or None}"
+            )
+
+        pre = payload.get("pre_tokenizer")
+        if pre is None or pre.get("type") != "Whitespace":
+            raise ValueError(
+                f"pre-tokenizer must be Whitespace, got "
+                f"{pre.get('type') if pre else None!r}"
+            )
+
+        extra_added = [
+            t.get("content") for t in payload.get("added_tokens") or []
+            if t.get("content") not in SPECIAL_TOKENS
+        ]
+        if extra_added:
+            raise ValueError(
+                f"added tokens beyond {SPECIAL_TOKENS} are not supported: "
+                f"{extra_added}"
+            )
+
+        vocab = model["vocab"]
+        for i, tok in enumerate(SPECIAL_TOKENS):
+            if vocab.get(tok) != i:
+                # the masking op assumes specials occupy the first ids
+                # (reference model.py:284-289) — a vocab violating that would
+                # silently corrupt MLM training
+                raise ValueError(
+                    f"special token {tok!r} must have id {i}, got {vocab.get(tok)}"
+                )
+        return cls(vocab=vocab, replacements=replacements)
 
     @classmethod
     def from_file(cls, path: str) -> "WordPieceTokenizer":
         with open(path, encoding="utf-8") as f:
             payload = json.load(f)
-        if payload.get("format") != "perceiver_io_tpu.wordpiece.v1":
-            raise ValueError(f"unrecognized tokenizer file format in {path}")
-        return cls(vocab=payload["vocab"], replacements=payload.get("replacements", ()))
+        if payload.get("format") == "perceiver_io_tpu.wordpiece.v1":
+            return cls(
+                vocab=payload["vocab"],
+                replacements=payload.get("replacements", ()),
+            )
+        if isinstance(payload.get("model"), dict):  # HF tokenizers schema
+            return cls.from_hf_dict(payload)
+        raise ValueError(f"unrecognized tokenizer file format in {path}")
 
 
 # -- module-level API mirroring the reference surface (tokenizer.py:18-36) --

@@ -1,0 +1,354 @@
+"""Interop with the reference implementation's published artifacts.
+
+The reference ships pretrained PyTorch-Lightning checkpoints and its transfer
+workflow starts from them (reference ``README.md:46-48``,
+``train/train_seq_clf.py:18-28``): users download ``epoch=…-val_loss=….ckpt``
+files and hand them to ``--mlm_checkpoint`` / ``--clf_checkpoint``. For "same
+capabilities" that entry point must work here too, so this module converts a
+Lightning checkpoint's torch ``state_dict`` into this framework's flax params
+pytree — numerically exact (golden-tested at 2e-5 end to end) — and can write
+the result as an Orbax checkpoint directory that the existing
+``--mlm_checkpoint`` / ``--clf_checkpoint`` / ``restore_params`` paths consume
+unchanged.
+
+Key-space being translated (reference ``perceiver/model.py``):
+
+- ``PerceiverMLM`` holds named submodules → keys ``encoder.…`` / ``decoder.…``
+  (``model.py:296-303``); ``PerceiverIO`` is a ``Sequential`` → positional keys
+  ``0.…`` / ``1.…`` (``model.py:321-325``). Lightning prefixes everything with
+  ``model.`` (``lightning.py:87,183``).
+- an encoder layer is ``Sequential(cross_attention_layer,
+  self_attention_block)`` where each attention layer is
+  ``Sequential(Residual(attn), Residual(mlp))`` (``model.py:29-44``), so torch
+  paths look like ``layer_1.0.0.module.q_norm.weight`` — positional Sequential
+  indices plus the ``Residual.module`` wrapper — while the flax tree uses the
+  named modules ``layer_1.cross_attention_layer.cross_attention.q_norm.scale``.
+- ``torch.nn.MultiheadAttention`` stores merged ``in_proj_weight`` when
+  q/k/v dims agree, separate ``{q,k,v}_proj_weight`` otherwise; both map onto
+  this framework's always-split ``q_proj``/``k_proj``/``v_proj`` params.
+
+Tokenizer-artifact interop (the HF ``tokenizers`` JSON schema the reference
+caches, e.g. ``.cache/imdb-tokenizer-10003.json``) lives in
+``data/tokenizer.py``; together the two make a reference checkpoint + its
+exact vocab fully usable from this framework.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "convert_state_dict",
+    "load_lightning_checkpoint",
+    "import_lightning_checkpoint",
+    "convert_hparams",
+    "export_orbax_checkpoint",
+]
+
+
+# -- small pytree helpers ----------------------------------------------------
+
+
+def _assign(tree: Dict[str, Any], path: List[str], value) -> None:
+    node = tree
+    for key in path[:-1]:
+        node = node.setdefault(key, {})
+    if path[-1] in node:
+        raise ValueError(f"duplicate parameter at {'/'.join(path)}")
+    node[path[-1]] = value
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor / array-like → float32 numpy copy (params are f32)."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.array(t, dtype=np.float32)
+
+
+# -- per-module translators ---------------------------------------------------
+#
+# Each takes the remaining torch path (already split on '.') and returns the
+# flax path, or buffers MHA leaves for post-processing.
+
+
+def _translate_linear(rest: List[str], name: str) -> Tuple[List[str], bool]:
+    """torch Linear → flax Dense: weight is (out, in) → kernel (in, out)."""
+    if rest == ["weight"]:
+        return [name, "kernel"], True
+    if rest == ["bias"]:
+        return [name, "bias"], False
+    raise KeyError(f"unexpected Linear leaf {rest!r}")
+
+
+def _translate_ln(rest: List[str], name: str) -> List[str]:
+    if rest == ["weight"]:
+        return [name, "scale"]
+    if rest == ["bias"]:
+        return [name, "bias"]
+    raise KeyError(f"unexpected LayerNorm leaf {rest!r}")
+
+
+def _translate_mlp(rest: List[str]) -> Tuple[List[str], bool]:
+    """Reference mlp = Sequential(LN, Linear, GELU, Linear) (model.py:20-26):
+    positional children 0/1/3 → named norm/dense_1/dense_2."""
+    idx, leaf = rest[0], rest[1:]
+    if idx == "0":
+        return ["mlp"] + _translate_ln(leaf, "norm"), False
+    if idx == "1":
+        path, transpose = _translate_linear(leaf, "dense_1")
+        return ["mlp"] + path, transpose
+    if idx == "3":
+        path, transpose = _translate_linear(leaf, "dense_2")
+        return ["mlp"] + path, transpose
+    raise KeyError(f"unexpected mlp child {rest!r}")
+
+
+def _translate_attn_module(rest: List[str], kind: str) -> Tuple[List[str], bool, bool]:
+    """CrossAttention / SelfAttention body (model.py:77-116).
+
+    Returns (flax_path, transpose, is_mha_leaf). MHA leaves keep their torch
+    name as the final path element; a later pass splits/merges them into
+    q_proj/k_proj/v_proj/out_proj.
+    """
+    name = "cross_attention" if kind == "cross" else "self_attention"
+    if rest[0] in ("q_norm", "kv_norm", "norm"):
+        return [name] + _translate_ln(rest[1:], rest[0]), False, False
+    if rest[:2] == ["attention", "attention"]:
+        # MultiHeadAttention wrapper (.attention) around nn.MultiheadAttention
+        # (.attention) — model.py:59-74
+        return [name, "attention", ".".join(rest[2:])], False, True
+    raise KeyError(f"unexpected attention leaf {rest!r}")
+
+
+def _translate_attn_layer(rest: List[str], kind: str) -> Tuple[List[str], bool, bool]:
+    """cross/self_attention_layer = Sequential(Residual(attn), Residual(mlp))
+    (model.py:29-40): child 0.module = attention, 1.module = mlp."""
+    if rest[:2] == ["0", "module"]:
+        return _translate_attn_module(rest[2:], kind)
+    if rest[:2] == ["1", "module"]:
+        path, transpose = _translate_mlp(rest[2:])
+        return path, transpose, False
+    raise KeyError(f"unexpected attention-layer child {rest!r}")
+
+
+def _translate_encoder(rest: List[str]) -> Optional[Tuple[List[str], bool, bool]]:
+    head = rest[0]
+    if head == "input_adapter":
+        sub = rest[1:]
+        if sub == ["text_embedding", "weight"]:
+            # embedding matrices are (vocab, C) in both frameworks
+            return ["input_adapter", "text_embedding", "embedding"], False, False
+        if sub == ["pos_encoding"]:
+            return ["input_adapter", "pos_encoding"], False, False
+        if sub == ["position_encoding"]:
+            # ImageInputAdapter's Fourier-encoding BUFFER (adapter.py:51) —
+            # deterministic, recomputed at trace time here; not a parameter
+            return None
+        raise KeyError(f"unexpected input_adapter leaf {sub!r}")
+    if head == "latent":
+        return ["latent"], False, False
+    if head in ("layer_1", "layer_n"):
+        # perceiver layer = Sequential(cross_attention_layer,
+        # self_attention_block) (model.py:150-160)
+        idx, sub = rest[1], rest[2:]
+        if idx == "0":
+            path, transpose, is_mha = _translate_attn_layer(sub, "cross")
+            return [head, "cross_attention_layer"] + path, transpose, is_mha
+        if idx == "1":
+            layer_i, layer_rest = sub[0], sub[1:]
+            path, transpose, is_mha = _translate_attn_layer(layer_rest, "self")
+            return (
+                [head, "self_attention_block", f"layer_{int(layer_i)}"] + path,
+                transpose,
+                is_mha,
+            )
+        raise KeyError(f"unexpected perceiver-layer child {rest!r}")
+    raise KeyError(f"unexpected encoder key {'.'.join(rest)!r}")
+
+
+def _translate_decoder(rest: List[str]) -> Optional[Tuple[List[str], bool, bool]]:
+    head = rest[0]
+    if head == "output":
+        return ["output"], False, False
+    if head == "cross_attention":
+        path, transpose, is_mha = _translate_attn_layer(rest[1:], "cross")
+        return ["cross_attention_layer"] + path, transpose, is_mha
+    if head == "output_adapter":
+        if rest[1] != "linear":
+            raise KeyError(f"unexpected output_adapter leaf {rest[1:]!r}")
+        path, transpose = _translate_linear(rest[2:], "linear")
+        return ["output_adapter"] + path, transpose, False
+    raise KeyError(f"unexpected decoder key {'.'.join(rest)!r}")
+
+
+# -- MHA merge/split ----------------------------------------------------------
+
+
+def _finalize_mha(group: Dict[str, np.ndarray], where: str) -> Dict[str, Any]:
+    """torch nn.MultiheadAttention tensors → split q/k/v/out params.
+
+    Merged layout (kdim == vdim == embed_dim): ``in_proj_weight`` rows stack
+    q, k, v; separate layout otherwise (``{q,k,v}_proj_weight``). Bias is
+    always the stacked ``in_proj_bias``.
+    """
+    out_w = group.get("out_proj.weight")
+    if out_w is None:
+        raise ValueError(f"attention at {where} missing out_proj.weight")
+    e = out_w.shape[0]
+    if "in_proj_weight" in group:
+        w = group["in_proj_weight"]
+        qw, kw, vw = w[:e], w[e:2 * e], w[2 * e:]
+    else:
+        qw, kw, vw = (
+            group["q_proj_weight"], group["k_proj_weight"], group["v_proj_weight"]
+        )
+    bias = group["in_proj_bias"]
+    return {
+        "q_proj": {"kernel": qw.T.copy(), "bias": bias[:e].copy()},
+        "k_proj": {"kernel": kw.T.copy(), "bias": bias[e:2 * e].copy()},
+        "v_proj": {"kernel": vw.T.copy(), "bias": bias[2 * e:].copy()},
+        "out_proj": {"kernel": out_w.T.copy(), "bias": group["out_proj.bias"].copy()},
+    }
+
+
+# -- public API ---------------------------------------------------------------
+
+_SKIPPED_KEY_RE = re.compile(
+    # torchmetrics Accuracy state, CrossEntropyLoss buffers, masking counters —
+    # training bookkeeping with no equivalent in a params pytree
+    r"^(loss\.|acc\.|masking\.)"
+)
+
+
+def convert_state_dict(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
+    """Reference torch ``state_dict`` → flax params pytree.
+
+    Accepts the state_dict of a Lightning module (``model.…`` prefix), a bare
+    ``PerceiverMLM`` (``encoder.…``/``decoder.…``), a bare ``PerceiverIO``
+    (Sequential: ``0.…``/``1.…``), or a bare ``PerceiverEncoder`` (keys start
+    at ``input_adapter``/``latent``/``layer_…`` — returned under an
+    ``encoder`` root).
+    """
+    params: Dict[str, Any] = {}
+    mha_groups: Dict[Tuple[str, ...], Dict[str, np.ndarray]] = {}
+
+    for key, value in state_dict.items():
+        parts = key.split(".")
+        if parts[0] == "model":
+            parts = parts[1:]
+        if _SKIPPED_KEY_RE.match(".".join(parts)):
+            continue
+        if parts[0] in ("encoder", "0"):
+            root, rest = "encoder", parts[1:]
+            translated = _translate_encoder(rest)
+        elif parts[0] in ("decoder", "1"):
+            root, rest = "decoder", parts[1:]
+            translated = _translate_decoder(rest)
+        elif parts[0] in ("input_adapter", "latent", "layer_1", "layer_n"):
+            root = "encoder"
+            translated = _translate_encoder(parts)
+        else:
+            raise KeyError(f"unrecognized checkpoint key {key!r}")
+        if translated is None:  # deterministic buffer — recomputed, not stored
+            continue
+        path, transpose, is_mha = translated
+        arr = _np(value)
+        if is_mha:
+            *prefix, torch_name = path
+            mha_groups.setdefault(tuple([root] + prefix), {})[torch_name] = arr
+        else:
+            _assign(params, [root] + path, arr.T.copy() if transpose else arr)
+
+    for prefix, group in mha_groups.items():
+        _assign(params, list(prefix), _finalize_mha(group, "/".join(prefix)))
+    return params
+
+
+def load_lightning_checkpoint(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Read a Lightning ``.ckpt`` (a torch pickle) → (state_dict, hparams).
+
+    torch is only needed here, at the import boundary — never on the device
+    path.
+    """
+    import torch
+
+    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    if "state_dict" not in ckpt:  # a bare state_dict file also works
+        return ckpt, {}
+    hparams = ckpt.get("hyper_parameters", {}) or {}
+    if not isinstance(hparams, dict):  # Lightning may store an argparse Namespace
+        hparams = dict(vars(hparams))
+    return ckpt["state_dict"], hparams
+
+
+_HPARAM_RENAMES = {
+    # reference argparse names (lightning.py:26-40) → this framework's
+    # (cli/common.py MODEL_HPARAM_KEYS)
+    "num_encoder_cross_attention_heads": "num_cross_attention_heads",
+    "num_encoder_self_attention_heads": "num_self_attention_heads",
+    "num_encoder_self_attention_layers_per_block":
+        "num_self_attention_layers_per_block",
+}
+
+
+def convert_hparams(hparams: Mapping[str, Any]) -> Dict[str, Any]:
+    """Reference Lightning hparams → this framework's arg names (shape knobs
+    pass through; encoder-prefixed head counts are renamed)."""
+    out: Dict[str, Any] = {}
+    for key, value in hparams.items():
+        out[_HPARAM_RENAMES.get(key, key)] = value
+    return out
+
+
+def import_lightning_checkpoint(
+    path: str, encoder_only: bool = False
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Lightning ``.ckpt`` → (flax params pytree, converted hparams).
+
+    ``encoder_only=True`` returns just the ``encoder`` subtree — the transfer
+    entry (reference ``train_seq_clf.py:18-24`` moves the pretrained MLM
+    encoder into a fresh classifier).
+    """
+    state_dict, hparams = load_lightning_checkpoint(path)
+    params = convert_state_dict(state_dict)
+    if encoder_only:
+        params = {"encoder": params["encoder"]}
+    return params, convert_hparams(hparams)
+
+
+def export_orbax_checkpoint(
+    params: Dict[str, Any],
+    directory: str,
+    hparams: Optional[Dict[str, Any]] = None,
+) -> None:
+    """Write ``params`` as an Orbax checkpoint directory in this framework's
+    run layout, so ``--mlm_checkpoint DIR`` / ``--clf_checkpoint DIR`` /
+    ``restore_params(DIR, …)`` consume an imported reference checkpoint
+    exactly like a native one.
+
+    Only the params subtree is stored (an imported torch checkpoint has no
+    compatible optimizer state); every restore path in
+    ``training/checkpoint.py`` does a partial pytree restore, so that is
+    sufficient for transfer and inference.
+    """
+    import orbax.checkpoint as ocp
+
+    from perceiver_io_tpu.training.checkpoint import HPARAMS_FILE
+
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    with ocp.CheckpointManager(
+        directory, options=ocp.CheckpointManagerOptions(max_to_keep=1)
+    ) as mngr:
+        mngr.save(
+            0, args=ocp.args.Composite(state=ocp.args.StandardSave({"params": params}))
+        )
+        mngr.wait_until_finished()
+    if hparams is not None:
+        with open(os.path.join(directory, HPARAMS_FILE), "w") as f:
+            json.dump(hparams, f, indent=2, sort_keys=True, default=str)

@@ -125,7 +125,7 @@ def test_imdb_synthetic_module(tmp_path):
 
 
 def test_imdb_missing_data_raises(tmp_path):
-    dm = IMDBDataModule(root=str(tmp_path), synthetic=False)
+    dm = IMDBDataModule(root=str(tmp_path), synthetic=False, download=False)
     with pytest.raises(FileNotFoundError, match="aclImdb"):
         dm.prepare_data()
 
@@ -191,7 +191,7 @@ def test_mnist_synthetic_module():
 
 
 def test_mnist_missing_data_raises(tmp_path):
-    dm = MNISTDataModule(root=str(tmp_path), synthetic=False)
+    dm = MNISTDataModule(root=str(tmp_path), synthetic=False, download=False)
     with pytest.raises(FileNotFoundError, match="MNIST"):
         dm.prepare_data()
 

@@ -1,0 +1,170 @@
+"""Guarded dataset downloaders, exercised against a localhost HTTP server
+(zero-egress-safe end-to-end: fetch → verify → extract → data module loads
+real, non-synthetic data)."""
+
+import gzip
+import hashlib
+import io
+import os
+import struct
+import tarfile
+import threading
+from functools import partial
+from http.server import HTTPServer, SimpleHTTPRequestHandler
+
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.data.download import (
+    DownloadError,
+    download_any,
+    download_file,
+    ensure_imdb,
+    ensure_mnist,
+)
+
+
+@pytest.fixture
+def http_root(tmp_path):
+    """Serve tmp_path/srv over localhost; yields (base_url, srv_dir)."""
+    srv = tmp_path / "srv"
+    srv.mkdir()
+    handler = partial(SimpleHTTPRequestHandler, directory=str(srv))
+    handler.log_message = lambda *a, **k: None
+    server = HTTPServer(("127.0.0.1", 0), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_address[1]}/", srv
+    server.shutdown()
+    thread.join()
+
+
+def _write_imdb_tarball(path):
+    """A miniature aclImdb tree, tarred like the real aclImdb_v1.tar.gz."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        for split in ("train", "test"):
+            for label in ("neg", "pos"):
+                for i in range(3):
+                    text = f"{label} review {i} for {split}: the movie was a movie".encode()
+                    info = tarfile.TarInfo(f"aclImdb/{split}/{label}/{i}_7.txt")
+                    info.size = len(text)
+                    tar.addfile(info, io.BytesIO(text))
+    path.write_bytes(buf.getvalue())
+
+
+def _write_mnist_files(srv):
+    """Four tiny-but-valid idx gz files; returns [(name, md5)] to pin."""
+    rng = np.random.default_rng(0)
+    entries = []
+    for prefix, n in (("train", 64), ("t10k", 16)):
+        images = rng.integers(0, 256, size=(n, 28, 28)).astype(np.uint8)
+        labels = rng.integers(0, 10, size=n).astype(np.uint8)
+        payloads = {
+            f"{prefix}-images-idx3-ubyte.gz":
+                struct.pack(">IIII", 0x00000803, n, 28, 28) + images.tobytes(),
+            f"{prefix}-labels-idx1-ubyte.gz":
+                struct.pack(">II", 0x00000801, n) + labels.tobytes(),
+        }
+        for name, raw in payloads.items():
+            data = gzip.compress(raw)
+            (srv / name).write_bytes(data)
+            entries.append((name, hashlib.md5(data).hexdigest()))
+    return entries
+
+
+def test_download_file_and_checksum(http_root, tmp_path):
+    base, srv = http_root
+    (srv / "blob.bin").write_bytes(b"hello dataset")
+    md5 = hashlib.md5(b"hello dataset").hexdigest()
+    dest = tmp_path / "out" / "blob.bin"
+    download_file(base + "blob.bin", str(dest), md5=md5)
+    assert dest.read_bytes() == b"hello dataset"
+    with pytest.raises(DownloadError, match="checksum"):
+        download_file(base + "blob.bin", str(tmp_path / "bad.bin"), md5="0" * 32)
+    assert not (tmp_path / "bad.bin").exists()  # atomic: no partial file
+
+
+def test_download_any_mirror_fallback(http_root, tmp_path):
+    base, srv = http_root
+    (srv / "file.txt").write_bytes(b"mirror two wins")
+    dest = tmp_path / "file.txt"
+    download_any([base + "missing.txt", base + "file.txt"], str(dest))
+    assert dest.read_bytes() == b"mirror two wins"
+    with pytest.raises(DownloadError, match="all mirrors failed"):
+        download_any([base + "nope1", base + "nope2"], str(tmp_path / "x"))
+
+
+def test_ensure_imdb_end_to_end(http_root, tmp_path, monkeypatch):
+    from perceiver_io_tpu.data import download as dl
+    from perceiver_io_tpu.data.imdb import IMDBDataModule
+
+    base, srv = http_root
+    _write_imdb_tarball(srv / "aclImdb_v1.tar.gz")
+    monkeypatch.setattr(dl, "IMDB_URLS", [base + "aclImdb_v1.tar.gz"])
+    monkeypatch.setattr(
+        dl, "IMDB_MD5", hashlib.md5((srv / "aclImdb_v1.tar.gz").read_bytes()).hexdigest()
+    )
+
+    root = tmp_path / "cache"
+    target = ensure_imdb(str(root))
+    assert os.path.isdir(os.path.join(target, "train", "pos"))
+    # idempotent: second call is a no-op (no server needed)
+    assert ensure_imdb(str(root)) == target
+
+    # the data module consumes the downloaded tree end to end
+    dm = IMDBDataModule(root=str(root), max_seq_len=16, vocab_size=60,
+                        batch_size=4)
+    dm.prepare_data()
+    dm.setup()
+    batch = next(iter(dm.train_dataloader()))
+    assert batch["token_ids"].shape == (4, 16)
+    assert len(dm.ds_train) == 6  # 3 neg + 3 pos
+
+
+def test_ensure_mnist_end_to_end(http_root, tmp_path, monkeypatch):
+    from perceiver_io_tpu.data import download as dl
+    from perceiver_io_tpu.data.mnist import MNISTDataModule
+
+    base, srv = http_root
+    entries = _write_mnist_files(srv)
+    monkeypatch.setattr(dl, "MNIST_FILES", entries)
+    monkeypatch.setattr(dl, "MNIST_MIRRORS", [base])
+
+    root = tmp_path / "cache"
+    raw = ensure_mnist(str(root))
+    for name, _ in entries:
+        assert os.path.exists(os.path.join(raw, name[:-3]))  # unpacked
+
+    dm = MNISTDataModule(root=str(root), batch_size=8, val_split=16)
+    dm.prepare_data()
+    dm.setup()
+    batch = next(iter(dm.train_dataloader()))
+    assert batch["image"].shape == (8, 28, 28, 1)
+    assert len(dm.ds_train) == 48 and len(dm.ds_valid) == 16
+
+
+def test_ensure_imdb_offline_error_names_alternatives(tmp_path, monkeypatch):
+    from perceiver_io_tpu.data import download as dl
+
+    # a closed port: connection refused immediately, no egress attempted
+    monkeypatch.setattr(dl, "IMDB_URLS", ["http://127.0.0.1:1/x.tar.gz"])
+    with pytest.raises(DownloadError, match="synthetic"):
+        ensure_imdb(str(tmp_path), timeout=2.0)
+
+
+def test_tarball_path_traversal_rejected(http_root, tmp_path, monkeypatch):
+    from perceiver_io_tpu.data import download as dl
+
+    base, srv = http_root
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        info = tarfile.TarInfo("../evil.txt")
+        info.size = 4
+        tar.addfile(info, io.BytesIO(b"evil"))
+    (srv / "aclImdb_v1.tar.gz").write_bytes(buf.getvalue())
+    monkeypatch.setattr(dl, "IMDB_URLS", [base + "aclImdb_v1.tar.gz"])
+    monkeypatch.setattr(dl, "IMDB_MD5", hashlib.md5(buf.getvalue()).hexdigest())
+    with pytest.raises(DownloadError, match="unsafe tar member"):
+        ensure_imdb(str(tmp_path / "cache"))
+    assert not (tmp_path / "evil.txt").exists()

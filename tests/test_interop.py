@@ -1,0 +1,523 @@
+"""Reference-artifact interop: Lightning .ckpt import + HF tokenizer JSON.
+
+The torch models here rebuild the reference's exact MODULE STRUCTURE —
+positional ``Sequential`` children, ``Residual.module`` wrappers, the
+``MultiHeadAttention`` wrapper holding ``nn.MultiheadAttention`` (reference
+``perceiver/model.py:29-116``) — so their ``state_dict`` keys are
+byte-identical to a published checkpoint's. The importer
+(``perceiver_io_tpu/interop.py``) must map those keys onto the flax tree and
+golden-match logits at 2e-5.
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+import perceiver_io_tpu as pit
+from perceiver_io_tpu.interop import (
+    convert_hparams,
+    convert_state_dict,
+    export_orbax_checkpoint,
+    import_lightning_checkpoint,
+)
+
+B, L, VOCAB, C, N_LATENT, HEADS = 2, 10, 40, 16, 6, 4
+NUM_LAYERS, SELF_PER_BLOCK = 3, 2
+
+REF_TOKENIZER_JSON = "/root/reference/.cache/imdb-tokenizer-10003.json"
+
+
+# -- reference-shaped torch modules (state_dict keys match published ckpts) --
+
+
+class TupleSequential(torch.nn.Sequential):
+    """Threads a tuple of inputs through children (reference utils.py:4-11)."""
+
+    def forward(self, *args):
+        out = args if len(args) > 1 else args[0]
+        for module in self:
+            out = module(*out) if isinstance(out, tuple) else module(out)
+        return out
+
+
+class Residual(torch.nn.Module):
+    def __init__(self, module):
+        super().__init__()
+        self.module = module
+        self.dropout = torch.nn.Dropout(p=0.0)
+
+    def forward(self, *args):
+        return self.dropout(self.module(*args)) + args[0]
+
+
+class MHAWrapper(torch.nn.Module):
+    def __init__(self, q_ch, kv_ch, heads):
+        super().__init__()
+        self.attention = torch.nn.MultiheadAttention(
+            embed_dim=q_ch, num_heads=heads, kdim=kv_ch, vdim=kv_ch,
+            batch_first=True,
+        )
+
+    def forward(self, x_q, x_kv, pad_mask=None):
+        return self.attention(x_q, x_kv, x_kv, key_padding_mask=pad_mask)[0]
+
+
+class CrossAttention(torch.nn.Module):
+    def __init__(self, q_ch, kv_ch, heads):
+        super().__init__()
+        self.q_norm = torch.nn.LayerNorm(q_ch)
+        self.kv_norm = torch.nn.LayerNorm(kv_ch)
+        self.attention = MHAWrapper(q_ch, kv_ch, heads)
+
+    def forward(self, x_q, x_kv, pad_mask=None):
+        return self.attention(self.q_norm(x_q), self.kv_norm(x_kv), pad_mask)
+
+
+class SelfAttention(torch.nn.Module):
+    def __init__(self, ch, heads):
+        super().__init__()
+        self.norm = torch.nn.LayerNorm(ch)
+        self.attention = MHAWrapper(ch, ch, heads)
+
+    def forward(self, x):
+        h = self.norm(x)
+        return self.attention(h, h)
+
+
+def _mlp(ch):
+    return torch.nn.Sequential(
+        torch.nn.LayerNorm(ch),
+        torch.nn.Linear(ch, ch),
+        torch.nn.GELU(),
+        torch.nn.Linear(ch, ch),
+    )
+
+
+def _cross_layer(q_ch, kv_ch, heads):
+    return TupleSequential(
+        Residual(CrossAttention(q_ch, kv_ch, heads)), Residual(_mlp(q_ch))
+    )
+
+
+def _self_block(n_layers, ch, heads):
+    return TupleSequential(*[
+        TupleSequential(Residual(SelfAttention(ch, heads)), Residual(_mlp(ch)))
+        for _ in range(n_layers)
+    ])
+
+
+def _perceiver_layer(q_ch, kv_ch, heads, self_layers):
+    return TupleSequential(
+        _cross_layer(q_ch, kv_ch, heads), _self_block(self_layers, q_ch, heads)
+    )
+
+
+class RefTextAdapter(torch.nn.Module):
+    def __init__(self, vocab, max_len, ch):
+        super().__init__()
+        self.text_embedding = torch.nn.Embedding(vocab, ch)
+        self.pos_encoding = torch.nn.Parameter(torch.rand(max_len, ch) - 0.5)
+        self.scale = math.sqrt(ch)
+
+    def forward(self, x):
+        return self.text_embedding(x) * self.scale + self.pos_encoding[: x.shape[1]]
+
+
+class RefEncoder(torch.nn.Module):
+    def __init__(self, adapter, num_layers):
+        super().__init__()
+        self.input_adapter = adapter
+        self.num_layers = num_layers
+        self.layer_1 = _perceiver_layer(C, C, HEADS, SELF_PER_BLOCK)
+        self.layer_n = _perceiver_layer(C, C, HEADS, SELF_PER_BLOCK)
+        self.latent = torch.nn.Parameter(torch.randn(N_LATENT, C) * 0.02)
+
+    def forward(self, x, pad_mask=None):
+        x = self.input_adapter(x)
+        latent = self.latent.expand(x.shape[0], -1, -1)
+        latent = self.layer_1(latent, x, pad_mask)
+        for _ in range(self.num_layers - 1):
+            latent = self.layer_n(latent, x, pad_mask)
+        return latent
+
+
+class RefOutputAdapter(torch.nn.Module):
+    def __init__(self, num_classes, ch):
+        super().__init__()
+        self.linear = torch.nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        return self.linear(x).squeeze(dim=1)
+
+
+class RefDecoder(torch.nn.Module):
+    def __init__(self, output_adapter, output_shape):
+        super().__init__()
+        self.output_adapter = output_adapter
+        self.cross_attention = _cross_layer(C, C, HEADS)
+        self.output = torch.nn.Parameter(torch.randn(*output_shape) * 0.02)
+
+    def forward(self, x):
+        out = self.output.expand(x.shape[0], -1, -1)
+        out = self.cross_attention(out, x)
+        return self.output_adapter(out)
+
+
+class RefMLM(torch.nn.Module):
+    """PerceiverMLM layout: named encoder/decoder/masking children
+    (reference model.py:296-303)."""
+
+    def __init__(self):
+        super().__init__()
+        self.encoder = RefEncoder(RefTextAdapter(VOCAB, L, C), NUM_LAYERS)
+        self.decoder = RefDecoder(RefOutputAdapter(VOCAB, C), (L, C))
+        self.masking = torch.nn.Identity()  # no params, like TextMasking
+
+    def forward(self, ids, pad_mask=None):
+        logits = self.decoder(self.encoder(ids, pad_mask))
+        return logits[:, : ids.shape[1], :]
+
+
+class RefIO(TupleSequential):
+    """PerceiverIO layout: positional encoder/decoder (model.py:321-325)."""
+
+    def __init__(self, num_classes=3):
+        super().__init__(
+            RefEncoder(RefTextAdapter(VOCAB, L, C), NUM_LAYERS),
+            RefDecoder(RefOutputAdapter(num_classes, C), (1, C)),
+        )
+
+
+def _lightning_ckpt(module, hparams):
+    return {
+        "state_dict": {f"model.{k}": v for k, v in module.state_dict().items()},
+        "hyper_parameters": dict(hparams),
+    }
+
+
+REF_HPARAMS = {
+    "num_latents": N_LATENT,
+    "num_latent_channels": C,
+    "num_encoder_layers": NUM_LAYERS,
+    "num_encoder_cross_attention_heads": HEADS,
+    "num_encoder_self_attention_heads": HEADS,
+    "num_encoder_self_attention_layers_per_block": SELF_PER_BLOCK,
+    "num_decoder_cross_attention_heads": HEADS,
+    "dropout": 0.0,
+    "max_seq_len": L,
+    "vocab_size": VOCAB,
+}
+
+
+def _build_flax_mlm():
+    from perceiver_io_tpu.models.presets import flagship_mlm
+
+    return flagship_mlm(
+        vocab_size=VOCAB, max_seq_len=L, num_latents=N_LATENT,
+        num_channels=C, num_layers=NUM_LAYERS,
+        num_self_attention_layers_per_block=SELF_PER_BLOCK,
+    )
+
+
+def _build_flax_classifier(num_classes=3):
+    return pit.PerceiverIO(
+        encoder=pit.PerceiverEncoder(
+            input_adapter=pit.TextInputAdapter(
+                vocab_size=VOCAB, max_seq_len=L, num_channels=C
+            ),
+            latent_shape=(N_LATENT, C),
+            num_layers=NUM_LAYERS,
+            num_cross_attention_heads=HEADS,
+            num_self_attention_heads=HEADS,
+            num_self_attention_layers_per_block=SELF_PER_BLOCK,
+        ),
+        decoder=pit.PerceiverDecoder(
+            output_adapter=pit.ClassificationOutputAdapter(
+                num_classes=num_classes, num_output_channels=C
+            ),
+            latent_shape=(N_LATENT, C),
+            num_cross_attention_heads=HEADS,
+        ),
+    )
+
+
+# -- checkpoint import -------------------------------------------------------
+
+
+def test_mlm_ckpt_import_golden(tmp_path, rng):
+    torch.manual_seed(0)
+    ref = RefMLM().eval()
+    path = tmp_path / "mlm.ckpt"
+    torch.save(_lightning_ckpt(ref, REF_HPARAMS), path)
+
+    params, hparams = import_lightning_checkpoint(str(path))
+    assert hparams["num_cross_attention_heads"] == HEADS
+    assert hparams["num_self_attention_layers_per_block"] == SELF_PER_BLOCK
+
+    model = _build_flax_mlm()
+    init = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        jnp.zeros((1, L), jnp.int32), jnp.zeros((1, L), bool),
+    )["params"]
+    # exhaustive: every init leaf imported, no extras, shapes agree
+    got = {jax.tree_util.keystr(p): v.shape
+           for p, v in jax.tree_util.tree_leaves_with_path(params)}
+    want = {jax.tree_util.keystr(p): v.shape
+            for p, v in jax.tree_util.tree_leaves_with_path(init)}
+    assert got == want
+
+    ids = rng.integers(0, VOCAB, size=(B, L)).astype(np.int64)
+    pad = np.zeros((B, L), dtype=bool)
+    pad[0, -3:] = True
+    with torch.no_grad():
+        t_logits = ref(torch.tensor(ids), torch.tensor(pad)).numpy()
+    j_logits, _ = model.apply(
+        {"params": jax.tree.map(jnp.asarray, params)},
+        jnp.asarray(ids.astype(np.int32)), jnp.asarray(pad), masking=False,
+    )
+    np.testing.assert_allclose(np.asarray(j_logits), t_logits, atol=2e-5)
+
+
+def test_perceiver_io_positional_root(rng):
+    """Classifier ckpts store the PerceiverIO Sequential as model.0/model.1."""
+    torch.manual_seed(1)
+    ref = RefIO().eval()
+    sd = {f"model.{k}": v for k, v in ref.state_dict().items()}
+    params = convert_state_dict(sd)
+    assert set(params) == {"encoder", "decoder"}
+
+    ids = rng.integers(0, VOCAB, size=(B, L)).astype(np.int64)
+    with torch.no_grad():
+        t_logits = ref(torch.tensor(ids), None).numpy()
+    model = _build_flax_classifier()
+    j_logits = model.apply(
+        {"params": jax.tree.map(jnp.asarray, params)},
+        jnp.asarray(ids.astype(np.int32)), pad_mask=None,
+    )
+    np.testing.assert_allclose(np.asarray(j_logits), t_logits, atol=2e-5)
+
+
+def test_encoder_only_import(tmp_path):
+    torch.manual_seed(2)
+    ref = RefMLM()
+    path = tmp_path / "mlm.ckpt"
+    torch.save(_lightning_ckpt(ref, REF_HPARAMS), path)
+    full, _ = import_lightning_checkpoint(str(path))
+    enc_only, _ = import_lightning_checkpoint(str(path), encoder_only=True)
+    assert set(enc_only) == {"encoder"}
+    jax.tree.map(np.testing.assert_array_equal, enc_only["encoder"], full["encoder"])
+
+
+def test_export_orbax_roundtrip(tmp_path):
+    from perceiver_io_tpu.training.checkpoint import (
+        load_hparams,
+        restore_encoder_params,
+        restore_params,
+    )
+
+    torch.manual_seed(3)
+    ref = RefMLM()
+    ckpt = tmp_path / "mlm.ckpt"
+    torch.save(_lightning_ckpt(ref, REF_HPARAMS), ckpt)
+    out = tmp_path / "imported"
+    params, hparams = import_lightning_checkpoint(str(ckpt))
+    export_orbax_checkpoint(params, str(out), hparams=hparams)
+
+    assert load_hparams(str(out))["num_latents"] == N_LATENT
+    restored = restore_params(str(out), params)
+    jax.tree.map(np.testing.assert_array_equal, restored, params)
+    enc = restore_encoder_params(str(out), params["encoder"])
+    jax.tree.map(np.testing.assert_array_equal, enc, params["encoder"])
+
+
+def test_seq_clf_cli_accepts_torch_ckpt(tmp_path):
+    """The reference's pretrained-weights entry (README.md:46-48): hand a
+    Lightning .ckpt straight to --mlm_checkpoint."""
+    from perceiver_io_tpu.cli import train_seq_clf
+    from perceiver_io_tpu.training import read_metrics
+
+    torch.manual_seed(4)
+    ref = RefMLM()
+    ckpt = tmp_path / "ref-mlm.ckpt"
+    torch.save(_lightning_ckpt(ref, REF_HPARAMS), ckpt)
+
+    run = train_seq_clf.main([
+        "--synthetic", "--logdir", str(tmp_path / "logs"),
+        "--root", str(tmp_path / "cache"),
+        "--dtype", "float32",
+        "--synthetic_size", "64", "--batch_size", "16",
+        "--max_seq_len", str(L), "--vocab_size", str(VOCAB),
+        "--max_steps", "2", "--log_every_n_steps", "1",
+        "--num_latents", "32",  # must be overridden by the ckpt's hparams
+        "--mlm_checkpoint", str(ckpt), "--freeze_encoder",
+    ])
+    rows = read_metrics(run)
+    assert any("train_loss" in r for r in rows)
+
+
+def test_import_rejects_unknown_keys():
+    with pytest.raises(KeyError):
+        convert_state_dict({"model.bogus.weight": torch.zeros(2)})
+
+
+def test_convert_hparams_renames():
+    out = convert_hparams({
+        "num_encoder_cross_attention_heads": 8,
+        "num_latents": 64,
+        "learning_rate": 1e-3,
+    })
+    assert out["num_cross_attention_heads"] == 8
+    assert out["num_latents"] == 64
+    assert out["learning_rate"] == 1e-3
+
+
+# -- HF tokenizer JSON -------------------------------------------------------
+
+
+@pytest.mark.skipif(
+    not os.path.exists(REF_TOKENIZER_JSON),
+    reason="reference cached tokenizer not present",
+)
+def test_load_reference_hf_tokenizer():
+    from perceiver_io_tpu.data.tokenizer import WordPieceTokenizer
+
+    tok = WordPieceTokenizer.from_file(REF_TOKENIZER_JSON)
+    assert tok.get_vocab_size() == 10003
+    assert tok.token_to_id("[PAD]") == 0
+    assert tok.token_to_id("[UNK]") == 1
+    assert tok.token_to_id("[MASK]") == 2
+    assert tok.replacements == [("<br />", " ")]
+    ids = tok.encode_ids("This movie was great!<br />Loved it.")
+    assert ids and all(0 <= i < 10003 for i in ids)
+    assert "movie" in tok.decode(ids)
+
+
+@pytest.mark.skipif(
+    not os.path.exists(REF_TOKENIZER_JSON),
+    reason="reference cached tokenizer not present",
+)
+def test_reference_tokenizer_matches_hf_library():
+    """Token-id parity with the HF Rust library on the reference's own
+    artifact — ids index embedding rows, so exactness is the contract."""
+    tokenizers = pytest.importorskip("tokenizers")
+
+    from perceiver_io_tpu.data.tokenizer import WordPieceTokenizer
+
+    ours = WordPieceTokenizer.from_file(REF_TOKENIZER_JSON)
+    theirs = tokenizers.Tokenizer.from_file(REF_TOKENIZER_JSON)
+    samples = [
+        "This movie was great!<br /><br />I loved it.",
+        "Café au lait, naïve résumé — ÅNGSTRÖM.",
+        "unbelievably overacted... 10/10 would NOT recommend :-)",
+        "short",
+        "word-with-hyphens and CAPS and numbers 12345 67890",
+        "supercalifragilisticexpialidocious antidisestablishmentarianism",
+    ]
+    for text in samples:
+        assert ours.encode_ids(text) == theirs.encode(text).ids, text
+
+
+def test_hf_roundtrip_via_our_writer(tmp_path, rng):
+    """Train a tiny tokenizer, save in the HF schema, reload with both our
+    loader and (if present) the HF library — ids must agree."""
+    from perceiver_io_tpu.data.tokenizer import (
+        WordPieceTokenizer,
+        create_tokenizer,
+        train_tokenizer,
+    )
+
+    corpus = [
+        "the quick brown fox jumps over the lazy dog",
+        "pack my box with five dozen liquor jugs",
+        "sphinx of black quartz judge my vow",
+    ] * 5
+    tok = create_tokenizer(("<br />", " "))
+    train_tokenizer(tok, corpus, vocab_size=80)
+    path = tmp_path / "tok.json"
+    tok.save(str(path), format="hf")
+
+    reloaded = WordPieceTokenizer.from_file(str(path))
+    assert reloaded.vocab == tok.vocab
+    assert reloaded.replacements == [("<br />", " ")]
+    text = "the quick liquor sphinx<br />judge"
+    assert reloaded.encode_ids(text) == tok.encode_ids(text)
+
+    try:
+        import tokenizers
+    except ImportError:
+        return
+    theirs = tokenizers.Tokenizer.from_file(str(path))
+    assert theirs.encode(text).ids == tok.encode_ids(text)
+
+
+def test_from_hf_dict_rejects_unsupported():
+    from perceiver_io_tpu.data.tokenizer import WordPieceTokenizer
+
+    ok_vocab = {"[PAD]": 0, "[UNK]": 1, "[MASK]": 2, "a": 3}
+    ok_normalizer = {
+        "type": "Sequence",
+        "normalizers": [
+            {"type": "NFD"}, {"type": "Lowercase"}, {"type": "StripAccents"},
+        ],
+    }
+
+    def hf(**overrides):
+        payload = {
+            "model": {"type": "WordPiece", "vocab": dict(ok_vocab)},
+            "normalizer": {
+                "type": "Sequence",
+                "normalizers": [dict(n) for n in ok_normalizer["normalizers"]],
+            },
+            "pre_tokenizer": {"type": "Whitespace"},
+        }
+        payload.update(overrides)
+        return payload
+
+    WordPieceTokenizer.from_hf_dict(hf())  # baseline accepted
+
+    with pytest.raises(ValueError, match="unsupported tokenizer model"):
+        WordPieceTokenizer.from_hf_dict({"model": {"type": "BPE", "vocab": {}}})
+    with pytest.raises(ValueError, match="unsupported normalizer"):
+        WordPieceTokenizer.from_hf_dict(hf(normalizer={"type": "NFC"}))
+    with pytest.raises(ValueError, match="normalizer pipeline must be"):
+        # a PARTIAL pipeline (e.g. cased vocab, no Lowercase) would silently
+        # diverge from the HF library — must be rejected, not accepted
+        WordPieceTokenizer.from_hf_dict(hf(normalizer={"type": "NFD"}))
+    with pytest.raises(ValueError, match="normalizer pipeline must be"):
+        WordPieceTokenizer.from_hf_dict(hf(normalizer=None))
+    with pytest.raises(ValueError, match="pre-tokenizer must be Whitespace"):
+        WordPieceTokenizer.from_hf_dict(hf(pre_tokenizer=None))
+    with pytest.raises(ValueError, match="added tokens"):
+        WordPieceTokenizer.from_hf_dict(hf(added_tokens=[
+            {"id": 3, "content": "[CLS]", "special": True},
+        ]))
+    with pytest.raises(ValueError, match="post_processor"):
+        WordPieceTokenizer.from_hf_dict(
+            hf(post_processor={"type": "TemplateProcessing"})
+        )
+    with pytest.raises(ValueError, match="unk_token"):
+        payload = hf()
+        payload["model"]["unk_token"] = "<unk>"
+        WordPieceTokenizer.from_hf_dict(payload)
+    with pytest.raises(ValueError, match="must have id"):
+        # specials not at ids 0/1/2 would break the masking op's
+        # first-ids assumption
+        payload = hf()
+        payload["model"]["vocab"] = {"[PAD]": 0, "[UNK]": 5, "[MASK]": 2, "a": 1}
+        WordPieceTokenizer.from_hf_dict(payload)
+    with pytest.raises(ValueError, match="Replace normalizers after"):
+        WordPieceTokenizer.from_hf_dict(hf(normalizer={
+            "type": "Sequence",
+            "normalizers": [
+                {"type": "NFD"}, {"type": "Lowercase"},
+                {"type": "Replace", "pattern": {"String": "x"}, "content": "y"},
+                {"type": "StripAccents"},
+            ],
+        }))

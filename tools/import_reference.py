@@ -1,0 +1,93 @@
+"""Import the reference's published artifacts into this framework.
+
+Two entry points, matching the two artifacts the reference ships
+(reference ``README.md:46-48``):
+
+- a PyTorch-Lightning checkpoint (``.ckpt``) → an Orbax checkpoint directory
+  in this framework's run layout, directly usable as ``--mlm_checkpoint DIR``
+  (transfer: encoder grafted into a fresh classifier, reference
+  ``train_seq_clf.py:18-24``), ``--clf_checkpoint DIR``, or
+  ``restore_params(DIR, …)`` for inference;
+- an HF ``tokenizers`` JSON (e.g. the cached ``imdb-tokenizer-10003.json``)
+  → verified loadable, optionally re-saved in either schema. Token ids index
+  embedding rows, so an imported checkpoint needs this exact vocab.
+
+Usage::
+
+    python tools/import_reference.py ckpt  epoch=198-val_loss=4.619.ckpt -o runs/imported-mlm
+    python tools/import_reference.py ckpt  model.ckpt -o out/ --encoder-only
+    python tools/import_reference.py tokenizer imdb-tokenizer-10003.json -o .cache/imdb-tokenizer-10003.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _import_ckpt(args: argparse.Namespace) -> None:
+    from perceiver_io_tpu.interop import (
+        export_orbax_checkpoint,
+        import_lightning_checkpoint,
+    )
+
+    params, hparams = import_lightning_checkpoint(
+        args.checkpoint, encoder_only=args.encoder_only
+    )
+    import jax
+
+    n_leaves = len(jax.tree.leaves(params))
+    n_params = sum(leaf.size for leaf in jax.tree.leaves(params))
+    export_orbax_checkpoint(params, args.out, hparams=hparams or None)
+    print(
+        f"imported {args.checkpoint} -> {args.out}: "
+        f"{n_leaves} arrays, {n_params:,} parameters"
+        + (" (encoder subtree only)" if args.encoder_only else "")
+    )
+    if hparams:
+        shape_keys = sorted(
+            k for k in hparams
+            if k.startswith(("num_", "vocab_", "max_seq", "dropout"))
+        )
+        print("hparams:", {k: hparams[k] for k in shape_keys})
+
+
+def _import_tokenizer(args: argparse.Namespace) -> None:
+    from perceiver_io_tpu.data.tokenizer import WordPieceTokenizer
+
+    tok = WordPieceTokenizer.from_file(args.tokenizer)
+    print(
+        f"loaded {args.tokenizer}: vocab {tok.get_vocab_size()}, "
+        f"replacements {tok.replacements}"
+    )
+    if args.out:
+        tok.save(args.out, format=args.format)
+        print(f"saved -> {args.out} ({args.format} schema)")
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_ckpt = sub.add_parser("ckpt", help="import a Lightning .ckpt")
+    p_ckpt.add_argument("checkpoint")
+    p_ckpt.add_argument("-o", "--out", required=True,
+                        help="Orbax checkpoint directory to write")
+    p_ckpt.add_argument("--encoder-only", action="store_true",
+                        help="import only the encoder subtree (transfer)")
+    p_ckpt.set_defaults(fn=_import_ckpt)
+
+    p_tok = sub.add_parser("tokenizer", help="import/convert an HF tokenizers JSON")
+    p_tok.add_argument("tokenizer")
+    p_tok.add_argument("-o", "--out", default=None,
+                       help="optionally re-save the tokenizer here")
+    p_tok.add_argument("--format", choices=("native", "hf"), default="native")
+    p_tok.set_defaults(fn=_import_tokenizer)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()
