@@ -327,7 +327,14 @@ def maybe_initialize_distributed(args) -> None:
     )
     if wants_distributed:
         from perceiver_io_tpu.parallel import initialize_distributed
+        from perceiver_io_tpu.utils.platform import (
+            drop_unselected_plugin_backends,
+        )
 
+        # a registered-but-unselected PJRT plugin can initialize backends
+        # mid-initialize, detaching the distributed client (process_count
+        # silently stays 1 and every rank trains alone)
+        drop_unselected_plugin_backends()
         try:
             initialize_distributed(
                 coordinator_address=getattr(args, "coordinator_address", None),

@@ -191,20 +191,28 @@ class IMDBDataModule:
 
     def prepare_data(self):
         """Download-if-absent, then train + cache the WordPiece tokenizer on
-        first run (rank-0 work; reference ``imdb.py:114-126``)."""
-        if not self.synthetic and self.download and not os.path.isdir(
-            os.path.join(self.root, "IMDB", "aclImdb", "train")
-        ):
-            from perceiver_io_tpu.data.download import ensure_imdb
+        first run. Rank-0 work with a cross-host barrier (the reference runs
+        ``prepare_data`` on rank 0 only, ``imdb.py:114-126``; here every rank
+        calls it and non-zero ranks wait instead of racing the filesystem)."""
+        import jax
 
-            ensure_imdb(self.root)
-        if os.path.exists(self.tokenizer_path):
-            return
-        os.makedirs(self.root, exist_ok=True)
-        texts, _ = self._train_texts()
-        tokenizer = create_tokenizer(("<br />", " "))
-        train_tokenizer(tokenizer, texts, vocab_size=self.vocab_size)
-        save_tokenizer(tokenizer, self.tokenizer_path)
+        if jax.process_index() == 0:
+            if not self.synthetic and self.download and not os.path.isdir(
+                os.path.join(self.root, "IMDB", "aclImdb", "train")
+            ):
+                from perceiver_io_tpu.data.download import ensure_imdb
+
+                ensure_imdb(self.root)
+            if not os.path.exists(self.tokenizer_path):
+                os.makedirs(self.root, exist_ok=True)
+                texts, _ = self._train_texts()
+                tokenizer = create_tokenizer(("<br />", " "))
+                train_tokenizer(tokenizer, texts, vocab_size=self.vocab_size)
+                save_tokenizer(tokenizer, self.tokenizer_path)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("imdb_prepare_data")
 
     def setup(self):
         self.tokenizer = load_tokenizer(self.tokenizer_path)

@@ -182,12 +182,28 @@ class MNISTDataModule:
         then validate local data exists (or synthetic mode)."""
         if self.synthetic:
             return
-        if self.download:
-            # per-file idempotent: fetches only what's missing, so a
-            # partially-populated raw/ dir is completed rather than trusted
-            from perceiver_io_tpu.data.download import ensure_mnist
 
-            ensure_mnist(self.root)
+        def all_present() -> bool:
+            try:
+                for base in _FILES.values():
+                    _find(self.root, base)
+                return True
+            except FileNotFoundError:
+                return False
+
+        # _find also accepts the flat <root>/*.gz layout, which ensure_mnist
+        # doesn't manage — only download when something is actually missing
+        if self.download and not all_present():
+            import jax
+
+            if jax.process_index() == 0:  # rank-0 work (Lightning semantics)
+                from perceiver_io_tpu.data.download import ensure_mnist
+
+                ensure_mnist(self.root)
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                multihost_utils.sync_global_devices("mnist_prepare_data")
         for base in _FILES.values():
             _find(self.root, base)
 

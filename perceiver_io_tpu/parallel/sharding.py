@@ -161,6 +161,33 @@ def zero_state_shardings(state, mesh: Mesh, rules=PARAM_RULES):
     return jax.tree_util.tree_map_with_path(add_data, state, shardings)
 
 
+def _place_tree(tree: Any, shardings: Any):
+    """Place host-resident values onto (possibly multi-process) shardings.
+
+    Single-process: plain ``device_put``. Multi-process: ``device_put``
+    rejects shardings spanning non-addressable devices, so each process
+    materializes only its addressable shards via ``make_array_from_callback``
+    — every host holds an identical full copy (the standard replicated-init
+    contract), and the callback slices this host's pieces out of it. Typed
+    PRNG-key leaves carry an extended dtype the callback path can't build
+    directly; they round-trip through their uint32 key data.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(tree, shardings)
+
+    def place(x, s):
+        if hasattr(x, "dtype") and jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key):
+            data = jax.random.key_data(x)
+            placed = jax.make_array_from_callback(
+                data.shape, s, lambda idx, d=np.asarray(data): d[idx]
+            )
+            return jax.random.wrap_key_data(placed, impl=jax.random.key_impl(x))
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(arr.shape, s, lambda idx: arr[idx])
+
+    return jax.tree.map(place, tree, shardings)
+
+
 def shard_train_state(state, mesh: Mesh, rules=PARAM_RULES, zero_opt: bool = False):
     """Place an existing TrainState onto the mesh per the rules.
 
@@ -181,7 +208,7 @@ def shard_train_state(state, mesh: Mesh, rules=PARAM_RULES, zero_opt: bool = Fal
         shardings = zero_state_shardings(state, mesh, rules)
     else:
         shardings = sharding_for_tree(state, mesh, rules)
-    return jax.device_put(state, shardings), shardings
+    return _place_tree(state, shardings), shardings
 
 
 def make_sharded_train_step(

@@ -158,6 +158,24 @@ class Trainer:
 
     # -- internals -----------------------------------------------------------
 
+    def _to_global(self, batch: Batch) -> Batch:
+        """Host-local loader batch → global sharded arrays (multi-host only).
+
+        Per-host loaders yield each process's shard of the global batch
+        (reference DDP semantics: Lightning's DistributedSampler gives every
+        rank its own slice). A mesh-sharded jit consumes GLOBAL arrays, so in
+        multi-process mode each local batch becomes this process's shard of a
+        global ``jax.Array`` — the multi-host equivalent of device_put.
+        """
+        if self._batch_shardings is None or jax.process_count() == 1:
+            return batch
+        return {
+            k: jax.make_array_from_process_local_data(
+                self._batch_shardings[k], np.asarray(batch[k])
+            )
+            for k in self._keys
+        }
+
     def _maybe_compute_flops(self, batch: Batch) -> None:
         """Lazily derive per-step FLOPs from XLA cost analysis (once).
 
@@ -169,6 +187,10 @@ class Trainer:
         if self._flops_attempted or not self.config.compute_mfu:
             return
         self._flops_attempted = True
+        if jax.process_count() > 1:
+            # lowering with a host-local example would trace a second (wrong)
+            # shape; per-host cost attribution is not meaningful anyway
+            return
         if profiling.device_peak_flops() is None:
             return
         self._flops_per_step = profiling.compiled_flops(
@@ -202,7 +224,10 @@ class Trainer:
         weight = 0.0
         for i, batch in enumerate(val_loader):
             self._eval_key, key = jax.random.split(self._eval_key)
-            metrics = self._eval_step(self.state, batch, key)
+            metrics = self._eval_step(self.state, self._to_global(batch), key)
+            # weight by the LOCAL shard size: with global eval batches every
+            # host computes identical metrics, and the cross-host sum below
+            # then weights each global batch by its true global size
             n = len(batch[self._keys[0]])
             for k, v in metrics.items():
                 totals[k] = totals.get(k, 0.0) + float(v) * n
@@ -341,7 +366,9 @@ class Trainer:
                         profile_start = step_i
 
                     with profiling.annotate_step(step_i):
-                        self.state, metrics = self._train_step(self.state, batch)
+                        self.state, metrics = self._train_step(
+                            self.state, self._to_global(batch)
+                        )
                     step_i += 1
                     window_steps += 1
                     steps_this_epoch += 1

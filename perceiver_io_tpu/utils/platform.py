@@ -15,6 +15,42 @@ from __future__ import annotations
 import os
 
 
+def drop_unselected_plugin_backends() -> None:
+    """Drop third-party PJRT plugin factories not named in ``JAX_PLATFORMS``.
+
+    Multi-host bring-up (``jax.distributed.initialize``) must complete before
+    any backend initializes — but probing a registered third-party plugin can
+    initialize backends mid-call, leaving the distributed client unattached
+    (``jax.process_count()`` stays 1 and every process trains alone). When the
+    user explicitly selected platforms via ``JAX_PLATFORMS``, unselected
+    plugins have no business initializing; standard platforms (cpu/tpu/...)
+    are left alone. No-op when ``JAX_PLATFORMS`` is unset (e.g. real TPU
+    pods, where auto-detection is the point).
+    """
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if not platforms:
+        return
+    allowed = {p.strip().lower() for p in platforms.split(",") if p.strip()}
+    standard = {"cpu", "tpu", "cuda", "gpu", "rocm", "metal"}
+    try:
+        import jax
+
+        # plugin registration at interpreter boot may have overridden the
+        # live config (e.g. to the plugin's own name) — realign with the env
+        # so the scrubbed factory is never requested
+        jax.config.update("jax_platforms", platforms)
+    except Exception:
+        pass
+    try:
+        import jax._src.xla_bridge as xb
+
+        for name in list(xb._backend_factories):
+            if name not in standard and name not in allowed:
+                xb._backend_factories.pop(name, None)
+    except Exception:
+        pass  # private API moved — JAX_PLATFORMS alone may still suffice
+
+
 def ensure_cpu_only(device_count: int | None = None) -> None:
     """Force this process to use only the CPU backend.
 
@@ -23,11 +59,19 @@ def ensure_cpu_only(device_count: int | None = None) -> None:
     """
     os.environ["JAX_PLATFORMS"] = "cpu"
     if device_count is not None:
+        import re
+
         flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={device_count}"
+        flag = f"--xla_force_host_platform_device_count={device_count}"
+        if "xla_force_host_platform_device_count" in flags:
+            # replace an inherited count (e.g. a test harness spawning
+            # subprocesses with a different virtual-device topology)
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, flags
             )
+            os.environ["XLA_FLAGS"] = flags
+        else:
+            os.environ["XLA_FLAGS"] = f"{flags} {flag}"
 
     # Site customization (e.g. an accelerator tunnel) may have imported jax at
     # interpreter boot, caching jax_platforms from the env before we ran —

@@ -1,0 +1,172 @@
+"""Worker for tests/test_multihost.py — one simulated host.
+
+Run as ``python multihost_worker.py --rank R --nprocs N --port P --workdir D``.
+Two CPU devices per process; ``jax.distributed`` over a localhost
+coordinator. Each rank writes a ``rank<R>.json`` with everything the test
+harness cross-checks, so assertions live in ONE place (the pytest side).
+
+Not named test_* on purpose: pytest must not collect it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--rank", type=int, required=True)
+    parser.add_argument("--nprocs", type=int, required=True)
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--workdir", required=True)
+    args = parser.parse_args()
+
+    from perceiver_io_tpu.utils.platform import ensure_cpu_only
+
+    ensure_cpu_only(device_count=2)
+
+    from perceiver_io_tpu.parallel import initialize_distributed
+
+    initialize_distributed(
+        coordinator_address=f"localhost:{args.port}",
+        num_processes=args.nprocs,
+        process_id=args.rank,
+    )
+
+    import jax
+    import numpy as np
+
+    out = {
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+    # -- per-host loader shards (reference DistributedSampler semantics) -----
+    from perceiver_io_tpu.data.pipeline import DataLoader
+
+    data = list(range(64))
+    loader = DataLoader(
+        data, batch_size=4, collate=lambda b: {"x": np.asarray(b)},
+        shuffle=True, seed=0, shard_id=jax.process_index(),
+        num_shards=jax.process_count(),
+    )
+    out["shard_items"] = sorted(
+        int(x) for batch in loader for x in batch["x"]
+    )
+
+    # -- next_version_dir: process-0's index must win over divergent scans ---
+    from perceiver_io_tpu.training.metrics import next_version_dir
+
+    logdir = os.path.join(args.workdir, "logs")
+    real_listdir = os.listdir
+    if jax.process_index() == 1:
+        # make rank 1's local directory scan LIE (as a raced mkdir would):
+        # the broadcast from process 0 must override the divergent local n
+        def lying_listdir(path):
+            names = real_listdir(path)
+            if os.path.basename(path) == "exp":
+                names = list(names) + ["version_7"]
+            return names
+
+        os.listdir = lying_listdir
+    try:
+        out["version_dir"] = next_version_dir(logdir, "exp")
+    finally:
+        os.listdir = real_listdir
+
+    # -- a real sharded fit: train + eval reduction + checkpoint -------------
+    import jax.numpy as jnp
+
+    import perceiver_io_tpu as pit
+    from perceiver_io_tpu.parallel import make_mesh
+    from perceiver_io_tpu.training import (
+        OptimizerConfig,
+        TrainState,
+        make_classifier_steps,
+        make_optimizer,
+    )
+    from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+    VOCAB, L, C, NLAT = 31, 16, 16, 4
+    model = pit.PerceiverIO(
+        encoder=pit.PerceiverEncoder(
+            input_adapter=pit.TextInputAdapter(
+                vocab_size=VOCAB, max_seq_len=L, num_channels=C),
+            latent_shape=(NLAT, C), num_layers=1,
+            num_cross_attention_heads=2, num_self_attention_heads=2,
+            num_self_attention_layers_per_block=1,
+        ),
+        decoder=pit.PerceiverDecoder(
+            output_adapter=pit.ClassificationOutputAdapter(
+                num_classes=2, num_output_channels=C),
+            latent_shape=(NLAT, C), num_cross_attention_heads=2,
+        ),
+    )
+
+    rng = np.random.default_rng(0)  # same on every host
+    n_examples = 64
+    ids_all = rng.integers(3, VOCAB, (n_examples, L)).astype(np.int32)
+    labels_all = (ids_all.sum(axis=1) % 2).astype(np.int32)
+    examples = [
+        {"token_ids": ids_all[i], "pad_mask": np.zeros(L, bool),
+         "label": labels_all[i]}
+        for i in range(n_examples)
+    ]
+
+    def collate(batch):
+        return {
+            k: np.stack([ex[k] for ex in batch]) for k in batch[0]
+        }
+
+    def make_loader(shuffle):
+        return DataLoader(
+            examples, batch_size=8, collate=collate, shuffle=shuffle, seed=0,
+            shard_id=jax.process_index(), num_shards=jax.process_count(),
+            drop_last=True,
+        )
+
+    variables = model.init(
+        jax.random.key(0), jnp.asarray(ids_all[:1]),
+        pad_mask=jnp.zeros((1, L), bool),
+    )
+    tx, sched = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    state = TrainState.create(variables["params"], tx, jax.random.key(1))
+    train_step, eval_step = make_classifier_steps(model, sched, input_kind="text")
+
+    mesh = make_mesh()  # all 4 global devices on the data axis
+    run_dir = os.path.join(args.workdir, "run")
+    trainer = Trainer(
+        train_step,
+        lambda s, b, k: eval_step(s, b),
+        state,
+        TrainerConfig(
+            logdir=os.path.join(args.workdir, "fitlogs"), experiment="mh",
+            max_steps=4, log_every_n_steps=2, use_tensorboard=False,
+            compute_mfu=False, async_checkpoint=False,
+        ),
+        example_batch=next(iter(make_loader(False))),
+        mesh=mesh,
+        run_dir=run_dir,
+    )
+    trainer.fit(make_loader(True), make_loader(False))
+    # test() runs the same weighted cross-host reduction as validation and
+    # RETURNS the reduced metrics on every rank — both ranks must agree
+    test_metrics = trainer.test(make_loader(False))
+    out["val_metrics"] = {
+        k.replace("test_", "val_", 1): float(v) for k, v in test_metrics.items()
+    }
+    steps = trainer.checkpoints.all_steps
+    out["ckpt_steps"] = sorted(int(s) for s in (steps() if callable(steps) else steps))
+    trainer.checkpoints.close()
+
+    with open(os.path.join(args.workdir, f"rank{args.rank}.json"), "w") as f:
+        json.dump(out, f)
+    print(f"rank {args.rank} done")
+
+
+if __name__ == "__main__":
+    main()

@@ -5,13 +5,14 @@ import numpy as np
 import pytest
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 import perceiver_io_tpu as pit
 from perceiver_io_tpu.ops.masking import TextMasking
 from perceiver_io_tpu.parallel import (
     AXIS_DATA,
     AXIS_MODEL,
+    AXIS_SEQ,
     batch_pspecs,
     make_mesh,
     make_sharded_train_step,
@@ -330,3 +331,133 @@ def test_zero_opt_state_sharding(mlm_setup):
 
     _, sharded = _run(step, sstate, jax.device_put(batch, bshard))
     np.testing.assert_allclose(sharded, ref, atol=1e-5)
+
+
+# -- Pallas kernel × SPMD composition ----------------------------------------
+# The long-context design sells blockwise-KV Pallas attention together with
+# seq/model sharding (SURVEY.md §5); these tests run the kernel (interpret
+# mode off-TPU) under jit with sharded inputs on the 8-device mesh so the
+# composition — GSPMD partitioning around pallas_call — is exercised, not
+# assumed.
+
+
+def build_mlm_pallas():
+    enc = pit.PerceiverEncoder(
+        input_adapter=pit.TextInputAdapter(vocab_size=VOCAB, max_seq_len=L, num_channels=C),
+        latent_shape=(NLAT, C),
+        num_layers=2,
+        attn_impl="pallas",
+    )
+    dec = pit.PerceiverDecoder(
+        output_adapter=pit.TextOutputAdapter(vocab_size=VOCAB, max_seq_len=L,
+                                             num_output_channels=C),
+        latent_shape=(NLAT, C),
+        attn_impl="pallas",
+    )
+    return pit.PerceiverMLM(
+        encoder=enc, decoder=dec, masking=TextMasking(VOCAB, 1, 2, 3)
+    )
+
+
+def test_pallas_step_sharded_matches_xla_single_device(mlm_parts):
+    """Full MLM train step on the Pallas kernel path, sharded dp×tp×sp —
+    must reproduce the single-device XLA-path loss trajectory (same param
+    tree: attn_impl changes the kernel, not the parameters)."""
+    _, params, tx, batch, xla_step = mlm_parts
+    fresh = lambda: TrainState.create(
+        jax.tree.map(jnp.copy, params), tx, jax.random.key(2)
+    )
+    _, ref = _run(jax.jit(xla_step), fresh(), batch)
+
+    model = build_mlm_pallas()
+    tx2, sched = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    pallas_step, _, _ = make_mlm_steps(model, sched)
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    step, sstate, bshard = make_sharded_train_step(
+        pallas_step, mesh, fresh(), batch, shard_seq=True
+    )
+    _, sharded = _run(step, sstate, jax.device_put(batch, bshard))
+    np.testing.assert_allclose(sharded, ref, atol=2e-5)
+
+
+def _kernel_ref(q, k, v, pad_mask):
+    """Plain softmax attention with the kernel's 1/sqrt(D) scaling."""
+    logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32)
+    logits = logits / np.sqrt(q.shape[-1])
+    if pad_mask is not None:
+        logits = jnp.where(pad_mask[:, None, None, :], -1e30, logits)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", probs.astype(q.dtype), v)
+
+
+@pytest.mark.parametrize("case", ["seq", "model", "seq+model"])
+def test_fused_attention_with_sharded_inputs(case, rng):
+    """fused_attention under jit with seq-sharded KV and/or model-sharded
+    heads: GSPMD must produce the same numbers as the unsharded call."""
+    from perceiver_io_tpu.ops.pallas_attention import fused_attention
+
+    B, T, S, H, D = 4, 8, 64, 4, 16
+    q = jnp.asarray(rng.normal(0, 1, (B, T, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(0, 1, (B, S, H, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(0, 1, (B, S, H, D)).astype(np.float32))
+    pad = jnp.zeros((B, S), dtype=bool).at[:, -7:].set(True)
+
+    ref = fused_attention(q, k, v, pad_mask=pad)
+    np.testing.assert_allclose(
+        np.asarray(ref), np.asarray(_kernel_ref(q, k, v, pad)), atol=1e-5
+    )
+
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    seq = AXIS_SEQ if "seq" in case else None
+    mdl = AXIS_MODEL if "model" in case else None
+    shard = lambda spec: NamedSharding(mesh, spec)
+    jitted = jax.jit(
+        lambda q, k, v, m: fused_attention(q, k, v, pad_mask=m),
+        in_shardings=(
+            shard(P(AXIS_DATA, None, mdl, None)),
+            shard(P(AXIS_DATA, seq, mdl, None)),
+            shard(P(AXIS_DATA, seq, mdl, None)),
+            shard(P(AXIS_DATA, seq)),
+        ),
+    )
+    out = jitted(q, k, v, pad)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_fused_attention_grads_with_sharded_inputs(rng):
+    """The custom-VJP flash backward must also compose with sharded inputs."""
+    from perceiver_io_tpu.ops.pallas_attention import fused_attention
+
+    B, T, S, H, D = 4, 8, 64, 2, 8
+    q = jnp.asarray(rng.normal(0, 1, (B, T, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(0, 1, (B, S, H, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(0, 1, (B, S, H, D)).astype(np.float32))
+    pad = jnp.zeros((B, S), dtype=bool).at[:, -5:].set(True)
+
+    def loss(q, k, v):
+        return jnp.sum(fused_attention(q, k, v, pad_mask=pad) ** 2)
+
+    ref_grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    mesh = make_mesh(dp=2, tp=1, sp=4)
+    shard = lambda spec: NamedSharding(mesh, spec)
+    jitted = jax.jit(
+        jax.grad(loss, argnums=(0, 1, 2)),
+        in_shardings=(
+            shard(P(AXIS_DATA, None, None, None)),
+            shard(P(AXIS_DATA, AXIS_SEQ, None, None)),
+            shard(P(AXIS_DATA, AXIS_SEQ, None, None)),
+        ),
+    )
+    out_grads = jitted(q, k, v)
+    for got, want in zip(out_grads, ref_grads):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+
+
+def test_dryrun_multichip_pallas_knob(monkeypatch):
+    """The driver's dry run exercises the kernel path when PIT_DRYRUN_ATTN
+    is set (VERDICT r1: Pallas × SPMD was never run together)."""
+    import __graft_entry__ as graft
+
+    monkeypatch.setenv("PIT_DRYRUN_ATTN", "pallas")
+    graft.dryrun_multichip(8)
