@@ -66,6 +66,10 @@ def add_trainer_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--no_tensorboard", action="store_true")
     g.add_argument("--profile_steps", type=int, default=0,
                    help="capture a profiler trace of N steps after warmup")
+    g.add_argument("--steps_per_dispatch", type=int, default=1,
+                   help="lax.scan N optimizer steps per device dispatch — "
+                        "amortizes per-call latency on remote/tunneled "
+                        "accelerators (PERF.md)")
     g.add_argument("--resume", default=None, metavar="RUN_DIR",
                    help="continue a previous run in place: restore the newest "
                         "checkpoint (the preemption last/ slot if present), "
@@ -160,6 +164,7 @@ def trainer_config(args) -> TrainerConfig:
         max_to_keep=args.max_to_keep,
         use_tensorboard=not args.no_tensorboard,
         profile_steps=args.profile_steps,
+        steps_per_dispatch=getattr(args, "steps_per_dispatch", 1),
     )
 
 

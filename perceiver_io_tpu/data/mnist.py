@@ -191,12 +191,16 @@ class MNISTDataModule:
             except FileNotFoundError:
                 return False
 
-        # _find also accepts the flat <root>/*.gz layout, which ensure_mnist
-        # doesn't manage — only download when something is actually missing
-        if self.download and not all_present():
+        if self.download:
             import jax
 
-            if jax.process_index() == 0:  # rank-0 work (Lightning semantics)
+            # _find also accepts the flat <root>/*.gz layout, which
+            # ensure_mnist doesn't manage — only download when something is
+            # actually missing. The barrier is UNCONDITIONAL for every rank:
+            # presence is re-evaluated per process and could disagree across
+            # ranks mid-download, so a barrier inside the branch could be
+            # entered by some ranks only (deadlock).
+            if jax.process_index() == 0 and not all_present():
                 from perceiver_io_tpu.data.download import ensure_mnist
 
                 ensure_mnist(self.root)

@@ -186,6 +186,21 @@ class ClassificationOutputAdapter(OutputAdapter):
             raise ValueError(f"pad_classes_to must be >= 1, got {m}")
         return ((self.num_classes + m - 1) // m) * m
 
+    def masked_head(self, adapter_params) -> Tuple[Array, Array]:
+        """(kernel, bias) of the linear head with padded classes masked out
+        of the bias — the single source of truth for the ``pad_classes_to``
+        scheme when a caller fuses the head into the loss
+        (``fused_linear_cross_entropy_with_ignore``) instead of applying this
+        adapter. Mirrors the -inf-stand-in masking ``__call__`` applies to
+        its logits: padded columns get a large-negative bias, so they vanish
+        from any downstream softmax/logsumexp and receive zero gradient."""
+        kernel = adapter_params["linear"]["kernel"]
+        bias = adapter_params["linear"]["bias"]
+        if self.padded_num_classes != self.num_classes:
+            col = jnp.arange(bias.shape[-1])
+            bias = jnp.where(col < self.num_classes, bias, jnp.asarray(-1e9, bias.dtype))
+        return kernel, bias
+
     @nn.compact
     def __call__(self, x: Array) -> Array:
         c_in = self.output_shape[-1]

@@ -155,7 +155,8 @@ class PerceiverDecoder(nn.Module):
     attn_impl: str = "auto"
 
     @nn.compact
-    def __call__(self, x, deterministic=True, positions: Optional[Array] = None):
+    def __call__(self, x, deterministic=True, positions: Optional[Array] = None,
+                 return_features: bool = False):
         """``positions``: optional (B, K) int — decode only these rows of the
         learned output-query array. Each output query attends to the latents
         independently (no query-query interaction anywhere in the decoder), so
@@ -164,6 +165,10 @@ class PerceiverDecoder(nn.Module):
         (the (B, 512, vocab) logits, SURVEY.md §3.1): callers that only need a
         few positions (e.g. the ~15% masked MLM positions) skip the dominant
         vocab-projection FLOPs for the rest.
+
+        ``return_features=True`` skips the output adapter and returns the
+        (B, K, C) decoder stream — for callers that fuse the head into the
+        loss (``fused_linear_cross_entropy_with_ignore``).
         """
         b, *d = x.shape
         if tuple(d) != tuple(self.latent_shape):
@@ -189,6 +194,8 @@ class PerceiverDecoder(nn.Module):
             attn_impl=self.attn_impl,
             name="cross_attention_layer",
         )(x_output, x, deterministic=deterministic)
+        if return_features:
+            return x_output
         return self.output_adapter(x_output)
 
 
@@ -230,6 +237,7 @@ class PerceiverMLM(nn.Module):
         masking: bool = True,
         deterministic: bool = True,
         loss_gather_capacity: Optional[int] = None,
+        return_features: bool = False,
     ) -> Tuple[Array, Optional[Array]]:
         """``loss_gather_capacity``: when set (and ``masking=True``), decode
         only the masked positions — up to that many per row — instead of all L.
@@ -260,10 +268,14 @@ class PerceiverMLM(nn.Module):
             # so gathered labels mark the padding slots ignored for free.
             valid = (x_labels != IGNORE_LABEL).astype(jnp.float32)
             _, positions = jax.lax.top_k(valid, loss_gather_capacity)
-            x_logits = self.decoder(
-                x_latent, deterministic=deterministic, positions=positions
+            x_out = self.decoder(
+                x_latent, deterministic=deterministic, positions=positions,
+                return_features=return_features,
             )
-            return x_logits, jnp.take_along_axis(x_labels, positions, axis=1)
+            return x_out, jnp.take_along_axis(x_labels, positions, axis=1)
 
-        x_logits = self.decoder(x_latent, deterministic=deterministic)[:, :l, :]
-        return x_logits, x_labels
+        x_out = self.decoder(
+            x_latent, deterministic=deterministic,
+            return_features=return_features,
+        )[:, :l, :]
+        return x_out, x_labels

@@ -27,6 +27,7 @@ def flagship_mlm(
     num_self_attention_layers_per_block: int = 6,
     dtype: jnp.dtype = jnp.float32,
     attn_impl: str = "auto",
+    remat: bool = False,
 ) -> PerceiverMLM:
     """The BASELINE.md north-star config: reference train_mlm shapes
     (SURVEY.md §3.1 — 512-token sequences, 256 latents, 3 encoder layers ×
@@ -43,6 +44,7 @@ def flagship_mlm(
             num_self_attention_layers_per_block=num_self_attention_layers_per_block,
             dtype=dtype,
             attn_impl=attn_impl,
+            remat=remat,
         ),
         decoder=PerceiverDecoder(
             output_adapter=TextOutputAdapter(

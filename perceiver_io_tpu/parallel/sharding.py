@@ -88,7 +88,10 @@ def sharding_for_tree(tree: Any, mesh: Mesh, rules: Sequence[Tuple[str, P]] = PA
     return jax.tree_util.tree_map_with_path(assign, tree)
 
 
-def batch_pspecs(batch: Dict[str, Any], mesh: Mesh, shard_seq: bool = False) -> Dict[str, P]:
+def batch_pspecs(
+    batch: Dict[str, Any], mesh: Mesh, shard_seq: bool = False,
+    stacked: bool = False,
+) -> Dict[str, P]:
     """PartitionSpecs for a batch dict: leading axis over ``data``, and
     optionally the sequence axis over ``seq`` — axis 1 for text tensors
     (token_ids/pad_mask) and for images/frames ('image': (B, H, W, C),
@@ -98,27 +101,38 @@ def batch_pspecs(batch: Dict[str, Any], mesh: Mesh, shard_seq: bool = False) -> 
     Sequence sharding is the Perceiver sequence-parallel scheme: the encoder
     cross-attention KV stream (derived from these tensors) is sharded over
     ``seq`` while latents replicate — no ring required (SURVEY.md §5).
+
+    ``stacked=True``: the batch leaves carry a leading scan axis of K
+    per-step batches (multi-step dispatch, ``TrainerConfig
+    .steps_per_dispatch``) — it stays unsharded and the usual specs apply
+    one axis later.
     """
     seq_axis = AXIS_SEQ if shard_seq and mesh.shape[AXIS_SEQ] > 1 else None
+    off = 1 if stacked else 0
 
     specs: Dict[str, P] = {}
     for key, value in batch.items():
         ndim = np.ndim(value) if not hasattr(value, "ndim") else value.ndim
+        ndim -= off
         if key in ("token_ids", "pad_mask") and ndim >= 2:
-            specs[key] = P(AXIS_DATA, seq_axis, *([None] * (ndim - 2)))
+            spec = (AXIS_DATA, seq_axis) + (None,) * (ndim - 2)
         elif key == "image" and ndim >= 3:
-            specs[key] = P(AXIS_DATA, seq_axis, *([None] * (ndim - 2)))
+            spec = (AXIS_DATA, seq_axis) + (None,) * (ndim - 2)
         elif key == "frames" and ndim >= 4:
-            specs[key] = P(AXIS_DATA, None, seq_axis, *([None] * (ndim - 3)))
+            spec = (AXIS_DATA, None, seq_axis) + (None,) * (ndim - 3)
         else:
-            specs[key] = P(AXIS_DATA, *([None] * (ndim - 1)))
+            spec = (AXIS_DATA,) + (None,) * (ndim - 1)
+        specs[key] = P(*(((None,) * off) + spec))
     return specs
 
 
-def batch_shardings(batch: Dict[str, Any], mesh: Mesh, shard_seq: bool = False):
+def batch_shardings(
+    batch: Dict[str, Any], mesh: Mesh, shard_seq: bool = False,
+    stacked: bool = False,
+):
     return {
         k: NamedSharding(mesh, spec)
-        for k, spec in batch_pspecs(batch, mesh, shard_seq).items()
+        for k, spec in batch_pspecs(batch, mesh, shard_seq, stacked).items()
     }
 
 
@@ -220,6 +234,7 @@ def make_sharded_train_step(
     shard_seq: bool = False,
     donate_state: bool = True,
     zero_opt: bool = False,
+    stacked: bool = False,
 ):
     """jit the pure ``(state, batch) → (state, metrics)`` step with explicit
     in/out shardings over the mesh. Returns ``(step_fn, sharded_state,
@@ -233,7 +248,7 @@ def make_sharded_train_step(
     """
     keys = tuple(sorted(example_batch))
     sharded_state, state_shardings = shard_train_state(state, mesh, rules, zero_opt=zero_opt)
-    b_shardings = batch_shardings(example_batch, mesh, shard_seq)
+    b_shardings = batch_shardings(example_batch, mesh, shard_seq, stacked)
 
     jitted = jax.jit(
         train_step,

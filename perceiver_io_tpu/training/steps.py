@@ -27,6 +27,7 @@ import optax
 from perceiver_io_tpu.training.losses import (
     classification_loss_and_accuracy,
     cross_entropy_with_ignore,
+    fused_linear_cross_entropy_with_ignore,
 )
 from perceiver_io_tpu.training.train_state import TrainState
 
@@ -58,6 +59,35 @@ def _lr_metric(schedule: Optional[Schedule], step: Array) -> dict:
     return {} if schedule is None else {"lr": schedule(step)}
 
 
+def make_scanned_step(train_step):
+    """Wrap a ``(state, batch) → (state, metrics)`` step into a
+    ``(state, stacked_batches) → (state, window_metrics)`` multi-step
+    dispatch: ``lax.scan`` over a leading K axis of per-step batches.
+
+    One dispatch then covers K optimizer steps — on dispatch-latency-bound
+    hosts (remote/tunneled accelerators, or very fast steps) this amortizes
+    the per-call overhead that otherwise gates the whole training loop
+    (PERF.md: the flagship trainer loop reached ~40% of the pure device-step
+    rate on the tunneled backend). Float metrics come back as the window
+    mean, others (e.g. step counters) as the last value.
+    """
+
+    def scanned(state, stacked):
+        def body(s, b):
+            return train_step(s, b)
+
+        state, ms = jax.lax.scan(body, state, stacked)
+
+        def reduce(leaf):
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                return leaf.mean(axis=0)
+            return leaf[-1]
+
+        return state, jax.tree.map(reduce, ms)
+
+    return scanned
+
+
 def mlm_gather_capacity(seq_len: int, mask_p: float = 0.15) -> int:
     """Default masked-decode capacity: 2·mask_p·L rounded up to a multiple of
     32 (sublane-friendly), capped at L. At 2× the expected masked count the
@@ -70,6 +100,7 @@ def make_mlm_steps(
     model,
     schedule: Optional[Schedule] = None,
     loss_gather_capacity: Optional[int] = None,
+    fused_head: bool = False,
 ):
     """(train_step, eval_step, predict_fn) for a ``PerceiverMLM``.
 
@@ -84,18 +115,37 @@ def make_mlm_steps(
     per row) in train/eval — gradient-equivalent to the full decode but skips
     most of the dominant vocab-projection FLOPs (see ``PerceiverMLM``). The
     predict path always decodes every position.
+
+    ``fused_head``: fuse the vocab projection into a chunked CE
+    (``fused_linear_cross_entropy_with_ignore``) so the (B, K, V) logits
+    never materialize in train/eval. A MEMORY lever, not a speed one:
+    on the flagship config it measured slower at every chunk size (PERF.md —
+    the unfused head ops already stream near HBM peak and overlap with the
+    latent stack, while the chunk scan serializes), so it stays opt-in for
+    configurations where the logits tensor itself is the memory wall
+    (very long full decodes / huge vocabs). Gradient-equivalent to the
+    unfused path (tested); predict is unaffected.
     """
 
     def loss_fn(params, batch, rngs, deterministic):
-        logits, labels = model.apply(
+        out, labels = model.apply(
             {"params": params},
             batch["token_ids"],
             batch["pad_mask"],
             rngs=rngs,
             deterministic=deterministic,
             loss_gather_capacity=loss_gather_capacity,
+            return_features=fused_head,
         )
-        return cross_entropy_with_ignore(logits, labels)
+        if fused_head:
+            # the adapter owns the head layout + class-padding scheme
+            kernel, bias = model.decoder.output_adapter.masked_head(
+                params["decoder"]["output_adapter"]
+            )
+            return fused_linear_cross_entropy_with_ignore(
+                out, kernel, bias, labels
+            )
+        return cross_entropy_with_ignore(out, labels)
 
     def train_step(state: TrainState, batch) -> Tuple[TrainState, Metrics]:
         rngs = state.step_rngs("masking", "dropout")

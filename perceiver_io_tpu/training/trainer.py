@@ -48,6 +48,12 @@ class TrainerConfig:
     max_steps: Optional[int] = None
     log_every_n_steps: int = 50
     eval_every_n_steps: Optional[int] = None  # None → validate per epoch
+    # Multi-step dispatch: lax.scan K optimizer steps per device call. On
+    # dispatch-latency-bound hosts (remote/tunneled accelerators) this is
+    # what closes the trainer-loop vs device-step gap (PERF.md); K=1 keeps
+    # classic per-step dispatch. Logging/eval cadences still count optimizer
+    # steps (boundaries are honored at the next dispatch edge).
+    steps_per_dispatch: int = 1
     logdir: str = "logs"
     experiment: str = "default"
     monitor: str = "val_loss"
@@ -131,15 +137,26 @@ class Trainer:
         )
 
         self._raw_train_step = train_step
+        self._k = max(1, int(config.steps_per_dispatch))
+        step_fn = train_step
+        step_example = self._example_batch
+        if self._k > 1:
+            from perceiver_io_tpu.training.steps import make_scanned_step
+
+            step_fn = make_scanned_step(train_step)
+            step_example = {
+                k: np.stack([v]) for k, v in self._example_batch.items()
+            }
         if mesh is not None:
             self._train_step, self.state, self._batch_shardings = (
                 make_sharded_train_step(
-                    train_step, mesh, state, self._example_batch,
+                    step_fn, mesh, state, step_example,
                     rules=rules, shard_seq=shard_seq, zero_opt=zero_opt,
+                    stacked=self._k > 1,
                 )
             )
         else:
-            jitted = jax.jit(train_step, donate_argnums=(0,))
+            jitted = jax.jit(step_fn, donate_argnums=(0,))
             self._train_step = lambda s, b: jitted(s, {k: b[k] for k in self._keys})
             self._train_step.jitted = jitted
             self.state = state
@@ -176,13 +193,14 @@ class Trainer:
             for k in self._keys
         }
 
-    def _maybe_compute_flops(self, batch: Batch) -> None:
+    def _maybe_compute_flops(self, batch: Batch, n_steps: int = 1) -> None:
         """Lazily derive per-step FLOPs from XLA cost analysis (once).
 
         Only attempted on devices with a known peak (TPUs) — elsewhere MFU is
         undefined and the lowering is wasted work. The lowering reuses the
         exact jit wrapper driving training (same shardings/donation), so the
         compiled executable comes from jit's cache — no second compile.
+        ``n_steps``: optimizer steps the dispatch covers (multi-step scan).
         """
         if self._flops_attempted or not self.config.compute_mfu:
             return
@@ -193,11 +211,36 @@ class Trainer:
             return
         if profiling.device_peak_flops() is None:
             return
-        self._flops_per_step = profiling.compiled_flops(
+        flops = profiling.compiled_flops(
             self._train_step.jitted,
             self.state,
             {k: batch[k] for k in self._keys},
         )
+        self._flops_per_step = flops / n_steps if flops else flops
+
+    def _dispatch_batches(self, loader):
+        """Yield ``(batch, n_steps)`` dispatch units: single loader batches
+        (K=1), or K of them stacked on a new leading scan axis. A partial
+        tail window is yielded at its own length (one extra compile, cached
+        across epochs)."""
+        if self._k <= 1:
+            for batch in loader:
+                yield batch, 1
+            return
+        buf = []
+        for batch in loader:
+            buf.append(batch)
+            if len(buf) == self._k:
+                yield self._stack(buf), self._k
+                buf = []
+        if buf:
+            yield self._stack(buf), len(buf)
+
+    def _stack(self, batches):
+        return {
+            k: np.stack([np.asarray(b[k]) for b in batches])
+            for k in self._keys
+        }
 
     def _throughput_metrics(
         self, n_steps: int, elapsed: float, batch_size: int
@@ -345,7 +388,7 @@ class Trainer:
                 if cfg.max_epochs is not None and epoch >= cfg.max_epochs:
                     break
                 steps_this_epoch = 0
-                for batch in train_loader:
+                for batch, ksteps in self._dispatch_batches(train_loader):
                     if self._sigterm:
                         self.checkpoints.save_last(step_i, self.state)
                         self.logger.log_text(
@@ -355,6 +398,14 @@ class Trainer:
                         self.logger.flush()
                         done = True
                         break
+                    if cfg.max_steps is not None:
+                        # never overshoot max_steps: trim the final window
+                        remaining = cfg.max_steps - step_i
+                        if remaining < ksteps:
+                            batch = {
+                                k: v[:remaining] for k, v in batch.items()
+                            }
+                            ksteps = remaining
                     if (
                         cfg.profile_steps > 0
                         and not profiling_active
@@ -369,9 +420,10 @@ class Trainer:
                         self.state, metrics = self._train_step(
                             self.state, self._to_global(batch)
                         )
-                    step_i += 1
-                    window_steps += 1
-                    steps_this_epoch += 1
+                    prev_step = step_i
+                    step_i += ksteps
+                    window_steps += ksteps
+                    steps_this_epoch += ksteps
 
                     if profiling_active and step_i >= profile_start + cfg.profile_steps:
                         jax.block_until_ready(metrics["loss"])
@@ -379,8 +431,9 @@ class Trainer:
                         profiling_active = False
                         profile_captured = True
 
-                    if step_i % cfg.log_every_n_steps == 0:
-                        self._maybe_compute_flops(batch)
+                    n = cfg.log_every_n_steps
+                    if step_i // n > prev_step // n:
+                        self._maybe_compute_flops(batch, ksteps)
                         # the float() conversions are the only host syncs in the loop
                         host_metrics = {
                             f"train_{k}" if k in ("loss", "acc") else k: float(v)
@@ -403,7 +456,10 @@ class Trainer:
                                 f"halt_on_nonfinite=False)"
                             )
                         now = time.perf_counter()
-                        batch_size = len(batch[self._keys[0]])
+                        leaf = batch[self._keys[0]]
+                        # per-step batch size: stacked dispatches carry the
+                        # scan axis in front
+                        batch_size = leaf.shape[1] if self._k > 1 else len(leaf)
                         if self.mesh is not None:
                             # loaders are per-host; the global batch spans processes
                             batch_size *= jax.process_count()
@@ -415,7 +471,8 @@ class Trainer:
                         self.logger.log_scalars(step_i, host_metrics)
                         window_start, window_steps = now, 0
 
-                    if cfg.eval_every_n_steps and step_i % cfg.eval_every_n_steps == 0:
+                    ev = cfg.eval_every_n_steps
+                    if ev and step_i // ev > prev_step // ev:
                         self._validate_and_checkpoint(step_i, val_loader)
                         last_validated_step = step_i
                         window_start, window_steps = time.perf_counter(), 0

@@ -242,3 +242,163 @@ def test_lean_ce_matches_optax(rng):
             np.asarray(g_ours, np.float32), np.asarray(g_ref, np.float32),
             atol=atol,
         )
+
+
+def test_fused_head_matches_unfused(rng):
+    """fused_linear_cross_entropy_with_ignore == Dense + cross_entropy_with_ignore
+    in value AND gradients (all inputs), f32."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.training.losses import (
+        cross_entropy_with_ignore,
+        fused_linear_cross_entropy_with_ignore,
+    )
+
+    B, K, C, V = 3, 7, 16, 1003  # V deliberately not a chunk multiple
+    x = jnp.asarray(rng.normal(0, 1, (B, K, C)).astype(np.float32))
+    w = jnp.asarray(rng.normal(0, 0.1, (C, V)).astype(np.float32))
+    b = jnp.asarray(rng.normal(0, 0.1, (V,)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, V, (B, K)).astype(np.int32))
+    labels = labels.at[0, :3].set(-100).at[2, -1].set(-100)
+
+    def unfused(x, w, b):
+        return cross_entropy_with_ignore(x @ w + b, labels)
+
+    def fused(x, w, b):
+        return fused_linear_cross_entropy_with_ignore(
+            x, w, b, labels, chunk=256
+        )
+
+    ref, ref_grads = jax.value_and_grad(unfused, argnums=(0, 1, 2))(x, w, b)
+    got, got_grads = jax.value_and_grad(fused, argnums=(0, 1, 2))(x, w, b)
+    np.testing.assert_allclose(float(got), float(ref), rtol=1e-6)
+    for g, r in zip(got_grads, ref_grads):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=1e-6)
+
+
+def test_mlm_step_fused_head_matches_unfused(rng):
+    """Full MLM train step: fused_head=True tracks the unfused loss/grads."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import perceiver_io_tpu as pit
+    from perceiver_io_tpu.ops.masking import TextMasking
+    from perceiver_io_tpu.training import (
+        OptimizerConfig,
+        TrainState,
+        make_mlm_steps,
+        make_optimizer,
+    )
+
+    VOCAB, L, C, NLAT = 60, 24, 16, 8
+    model = pit.PerceiverMLM(
+        encoder=pit.PerceiverEncoder(
+            input_adapter=pit.TextInputAdapter(
+                vocab_size=VOCAB, max_seq_len=L, num_channels=C),
+            latent_shape=(NLAT, C), num_layers=2,
+        ),
+        decoder=pit.PerceiverDecoder(
+            output_adapter=pit.TextOutputAdapter(
+                vocab_size=VOCAB, max_seq_len=L, num_output_channels=C),
+            latent_shape=(NLAT, C),
+        ),
+        masking=TextMasking(VOCAB, 1, 2, 3),
+    )
+    ids = jnp.asarray(rng.integers(3, VOCAB, (4, L)).astype(np.int32))
+    batch = {"token_ids": ids, "pad_mask": jnp.zeros((4, L), bool)}
+    variables = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)}, ids,
+        batch["pad_mask"],
+    )
+    tx, sched = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+
+    losses = {}
+    params_out = {}
+    for fused in (False, True):
+        state = TrainState.create(
+            jax.tree.map(jnp.copy, variables["params"]), tx, jax.random.key(2)
+        )
+        step, eval_step, _ = make_mlm_steps(
+            model, sched, loss_gather_capacity=8, fused_head=fused
+        )
+        jit_step = jax.jit(step)
+        ls = []
+        for _ in range(3):
+            state, m = jit_step(state, batch)
+            ls.append(float(m["loss"]))
+        losses[fused] = ls
+        params_out[fused] = state.params
+        # eval path too
+        losses[(fused, "eval")] = float(
+            eval_step(state, batch, jax.random.key(9))["loss"]
+        )
+    # the loss trajectory is the tight assertion: a wrong gradient would
+    # compound through the 3 Adam steps and break it
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)
+    np.testing.assert_allclose(
+        losses[(True, "eval")], losses[(False, "eval")], rtol=1e-5
+    )
+    # params agree to Adam noise: where a gradient is ~0, float-level
+    # association differences (chunked vs full reductions) decide the
+    # update's sign, bounding per-step divergence at O(lr) — the same
+    # tolerance reasoning as test_golden_model's trajectory test
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2.5e-3
+        ),
+        params_out[True], params_out[False],
+    )
+
+
+def test_fused_head_with_padded_vocab(rng):
+    """pad_classes_to: padded columns must not leak into the fused lse."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import perceiver_io_tpu as pit
+    from perceiver_io_tpu.ops.masking import TextMasking
+    from perceiver_io_tpu.training import (
+        OptimizerConfig,
+        TrainState,
+        make_mlm_steps,
+        make_optimizer,
+    )
+
+    VOCAB, L, C, NLAT = 60, 16, 16, 8
+    def build(pad):
+        return pit.PerceiverMLM(
+            encoder=pit.PerceiverEncoder(
+                input_adapter=pit.TextInputAdapter(
+                    vocab_size=VOCAB, max_seq_len=L, num_channels=C),
+                latent_shape=(NLAT, C), num_layers=1,
+            ),
+            decoder=pit.PerceiverDecoder(
+                output_adapter=pit.TextOutputAdapter(
+                    vocab_size=VOCAB, max_seq_len=L, num_output_channels=C,
+                    pad_classes_to=pad),
+                latent_shape=(NLAT, C),
+            ),
+            masking=TextMasking(VOCAB, 1, 2, 3),
+        )
+
+    padded = build(64)
+    ids = jnp.asarray(rng.integers(3, VOCAB, (4, L)).astype(np.int32))
+    batch = {"token_ids": ids, "pad_mask": jnp.zeros((4, L), bool)}
+    variables = padded.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)}, ids,
+        batch["pad_mask"],
+    )
+    tx, sched = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    out = {}
+    for fused in (False, True):
+        state = TrainState.create(
+            jax.tree.map(jnp.copy, variables["params"]), tx, jax.random.key(2)
+        )
+        step, _, _ = make_mlm_steps(padded, sched, fused_head=fused)
+        state, m = jax.jit(step)(state, batch)
+        out[fused] = float(m["loss"])
+    np.testing.assert_allclose(out[True], out[False], rtol=1e-5)

@@ -355,3 +355,53 @@ def test_cli_resume_continues_run(tmp_path):
     assert resumed_dir == run_dir
     steps2 = {r["step"] for r in read_metrics(run_dir) if "train_loss" in r}
     assert max(steps2) == 6 and steps1 < steps2
+
+
+@pytest.mark.parametrize("mesh", [None, "dp"])
+def test_steps_per_dispatch_matches_per_step(tmp_path, mesh):
+    """Multi-step dispatch (lax.scan over K stacked batches) must reproduce
+    the per-step loop: same step count, same final loss trajectory, eval
+    cadence honored, max_steps never overshot — incl. a partial tail window
+    (7 steps at K=4) and mesh mode with stacked batch shardings."""
+    mesh = make_mesh() if mesh else None
+
+    def run(k):
+        trainer, _ = _make_parts(tmp_path / f"k{k}", mesh=mesh)
+        cfg = dataclasses.replace(
+            trainer.config, max_epochs=None, max_steps=7,
+            log_every_n_steps=2, steps_per_dispatch=k,
+        )
+        t = Trainer(
+            trainer._raw_train_step,
+            None,
+            trainer.state,
+            cfg,
+            example_batch=trainer._example_batch,
+            mesh=mesh,
+        )
+        loader = DataLoader(_Blobs(64), 8, _collate, shuffle=True, prefetch=0)
+        with t:
+            state = t.fit(loader, None)
+            rows = read_metrics(t.run_dir)
+        return state, [r for r in rows if "train_loss" in r]
+
+    s1, rows1 = run(1)
+    s4, rows4 = run(4)
+    assert int(jax.device_get(s1.step)) == 7
+    assert int(jax.device_get(s4.step)) == 7
+    # identical data order (same seed) -> identical final params. Mesh mode
+    # compiles different programs for the two dispatch shapes, so collective
+    # reduction order differs at float level and Adam amplifies near-zero
+    # grads to O(lr) per step — same tolerance reasoning as the golden
+    # trajectory test; single-device stays tight.
+    atol = 2.5e-3 if mesh is not None else 1e-5
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=atol
+        ),
+        s1.params, s4.params,
+    )
+    # logging cadence: K=1 logs at steps 2,4,6; K=4 logs at the dispatch
+    # edges that cross those boundaries (4 and 7)
+    assert [r["step"] for r in rows1] == [2, 4, 6]
+    assert [r["step"] for r in rows4] == [4, 7]

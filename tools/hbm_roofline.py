@@ -1,0 +1,243 @@
+"""Measure the flagship-MLM step's achieved HBM bandwidth / MXU utilization
+from a device profile (the roofline evidence VERDICT r1 asked for).
+
+Captures a ``jax.profiler`` trace of the bench train step on the real TPU,
+parses the xplane directly (the tensorboard-plugin converter is incompatible
+with this TF build), and reports:
+
+- device-measured step time (from the trace's Steps line — immune to the
+  tunneled-backend timing lies PERF.md documents),
+- achieved HBM bytes/s vs the device's own advertised peak, plus MXU TF/s
+  and on-chip (VMEM) bytes/s,
+- a per-component table (duration, HBM/VMEM bandwidth, TF/s) so the binding
+  resource of each phase is visible.
+
+Byte counts come from XLA's per-op cost analysis embedded in the trace
+(``memory_access_breakdown``); durations are hardware-measured. This is the
+same bytes-modeled/time-measured definition the TensorBoard profiler's
+"memory BW utilization" uses. Memory-space code 1 is HBM, 3 is on-chip
+(verified empirically: space-3 aggregate bandwidth exceeds the HBM peak
+severalfold, and known-HBM-resident tensors — the vocab embedding table,
+optimizer state — report space 1).
+
+Usage: ``timeout 600 python tools/hbm_roofline.py [--steps 10] [--components 12]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import tempfile
+from collections import defaultdict
+
+HBM_SPACE, ONCHIP_SPACE = 1, 3
+
+
+def _varint(buf: bytes, i: int):
+    r = 0
+    s = 0
+    while True:
+        b = buf[i]
+        i += 1
+        r |= (b & 0x7F) << s
+        if not b & 0x80:
+            return r, i
+        s += 7
+
+
+def parse_memory_breakdown(buf: bytes):
+    """Decode the repeated {operation_type, memory_space, bytes} submessages
+    of the ``memory_access_breakdown`` stat."""
+    out = []
+    i = 0
+    while i < len(buf):
+        tag, i = _varint(buf, i)
+        if tag != 0x0A:
+            break
+        ln, i = _varint(buf, i)
+        sub = buf[i : i + ln]
+        i += ln
+        j = 0
+        op = space = nbytes = 0
+        while j < len(sub):
+            t, j = _varint(sub, j)
+            v, j = _varint(sub, j)
+            if t == 0x08:
+                op = v
+            elif t == 0x10:
+                space = v
+            elif t == 0x18:
+                nbytes = v
+        out.append((op, space, nbytes))
+    return out
+
+
+def capture_trace(trace_dir: str, steps: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.models.presets import flagship_mlm
+    from perceiver_io_tpu.training import (
+        OptimizerConfig,
+        TrainState,
+        make_mlm_steps,
+        make_optimizer,
+        mlm_gather_capacity,
+    )
+
+    vocab, seq = 10003, 512
+    model = flagship_mlm(
+        vocab_size=vocab, max_seq_len=seq, num_latents=256, num_channels=64,
+        dtype=jnp.bfloat16, attn_impl="xla",
+    )
+    rng = np.random.default_rng(0)
+    batch = {
+        "token_ids": jnp.asarray(
+            rng.integers(3, vocab, (64, seq)).astype(np.int32)),
+        "pad_mask": jnp.zeros((64, seq), dtype=bool),
+    }
+    variables = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        batch["token_ids"], batch["pad_mask"],
+    )
+    tx, sched = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    state = TrainState.create(variables["params"], tx, jax.random.key(2))
+    train_step, _, _ = make_mlm_steps(
+        model, sched, loss_gather_capacity=mlm_gather_capacity(seq),
+        fused_head=False,
+    )
+    step = jax.jit(train_step, donate_argnums=(0,))
+    state, m = step(state, batch)  # compile + warm
+    float(m["loss"])
+    jax.profiler.start_trace(trace_dir)
+    for _ in range(steps):
+        state, m = step(state, batch)
+    float(m["loss"])
+    jax.profiler.stop_trace()
+
+
+def analyze(trace_dir: str, n_components: int) -> dict:
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(
+        os.path.join(trace_dir, "plugins", "profile", "*", "*.xplane.pb")
+    )
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
+    xs = xplane_pb2.XSpace()
+    with open(sorted(paths)[-1], "rb") as f:
+        xs.ParseFromString(f.read())
+    tpu_planes = [p for p in xs.planes if "/device:TPU" in p.name and p.lines]
+    if not tpu_planes:
+        raise RuntimeError("no TPU device plane in trace (ran on CPU?)")
+    tpu = tpu_planes[0]
+    names = {k: v.name for k, v in tpu.stat_metadata.items()}
+
+    peaks = {}
+    for s in tpu.stats:
+        peaks[names.get(s.metadata_id)] = s.double_value
+    peak_hbm = peaks.get("peak_hbm_bw_gigabytes_per_second") or 819.0
+    peak_tf = peaks.get("peak_teraflops_per_second") or 197.0
+
+    step_line = [l for l in tpu.lines if l.name == "Steps"][0]
+    windows = [
+        (e.offset_ps, e.offset_ps + e.duration_ps) for e in step_line.events
+    ]
+    windows = windows[2:] if len(windows) > 4 else windows  # steady state
+    n_steps = len(windows)
+    step_s = sum(b - a for a, b in windows) / 1e12 / n_steps
+
+    meta = {}
+    for mid, em in tpu.event_metadata.items():
+        st = {names.get(s.metadata_id): s for s in em.stats}
+        if "memory_access_breakdown" not in st:
+            continue
+        brk = parse_memory_breakdown(st["memory_access_breakdown"].bytes_value)
+        hbm = sum(b for _, sp, b in brk if sp == HBM_SPACE)
+        onchip = sum(b for _, sp, b in brk if sp == ONCHIP_SPACE)
+        flops = st["flops"].int64_value if "flops" in st else 0
+        src = st["tf_op"].str_value if "tf_op" in st else ""
+        key = (
+            src.split("jvp(")[-1].split(":")[0][:64]
+            if src else em.name.split(" = ")[0][:40]
+        )
+        meta[mid] = (hbm, onchip, flops, key)
+
+    ops_line = [l for l in tpu.lines if l.name == "XLA Ops"][0]
+    tot_hbm = tot_onchip = tot_flops = 0
+    comp = defaultdict(lambda: [0, 0, 0, 0])
+    for e in ops_line.events:
+        if not any(a <= e.offset_ps < b for a, b in windows):
+            continue
+        m = meta.get(e.metadata_id)
+        if m is None:
+            continue
+        hbm, onchip, flops, key = m
+        tot_hbm += hbm
+        tot_onchip += onchip
+        tot_flops += flops
+        row = comp[key]
+        row[0] += e.duration_ps
+        row[1] += hbm
+        row[2] += onchip
+        row[3] += flops
+
+    result = {
+        "step_ms": step_s * 1e3,
+        "tokens_per_sec": 64 * 512 / step_s,
+        "hbm_gb_per_step": tot_hbm / n_steps / 1e9,
+        "hbm_gb_s": tot_hbm / n_steps / step_s / 1e9,
+        "hbm_peak_gb_s": peak_hbm,
+        "hbm_util": tot_hbm / n_steps / step_s / 1e9 / peak_hbm,
+        "onchip_gb_s": tot_onchip / n_steps / step_s / 1e9,
+        "tf_s": tot_flops / n_steps / step_s / 1e12,
+        "mxu_util": tot_flops / n_steps / step_s / 1e12 / peak_tf,
+    }
+
+    print(
+        f"device step: {result['step_ms']:.3f} ms "
+        f"({result['tokens_per_sec']/1e6:.2f}M tokens/s/chip)"
+    )
+    print(
+        f"HBM: {result['hbm_gb_per_step']:.2f} GB/step -> "
+        f"{result['hbm_gb_s']:.0f} GB/s = {result['hbm_util']*100:.1f}% of "
+        f"{peak_hbm:.0f} GB/s peak"
+    )
+    print(
+        f"MXU: {result['tf_s']:.1f} TF/s = {result['mxu_util']*100:.1f}% of "
+        f"{peak_tf:.0f} TF/s peak; on-chip {result['onchip_gb_s']:.0f} GB/s"
+    )
+    print(f"\n{'ms':>7} {'HBM GB/s':>8} {'chip GB/s':>9} {'TF/s':>6}  component")
+    rows = sorted(comp.items(), key=lambda kv: -kv[1][0])[:n_components]
+    for key, (d, h, o, f) in rows:
+        sec = d / 1e12 / n_steps
+        if sec <= 0:
+            continue
+        print(
+            f"{sec*1e3:7.3f} {h/n_steps/sec/1e9:8.0f} "
+            f"{o/n_steps/sec/1e9:9.0f} {f/n_steps/sec/1e12:6.2f}  {key[:66]}"
+        )
+    return result
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--components", type=int, default=12)
+    parser.add_argument("--trace-dir", default=None,
+                        help="analyze an existing trace instead of capturing")
+    args = parser.parse_args()
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+
+    trace_dir = args.trace_dir
+    if trace_dir is None:
+        trace_dir = tempfile.mkdtemp(prefix="hbm_roofline_")
+        print(f"capturing {args.steps}-step trace to {trace_dir} ...")
+        capture_trace(trace_dir, args.steps)
+    analyze(trace_dir, args.components)
+
+
+if __name__ == "__main__":
+    main()
