@@ -28,8 +28,9 @@ jnp/einsum (XLA fuses this well on the MXU); ``attn_impl='pallas'`` dispatches
 to the streaming fused Pallas kernel in ``perceiver_io_tpu.ops.pallas_attention``;
 ``attn_impl='packed'`` is the experimental small-latent packed-heads kernel
 (opt-in — see PERF.md's negative-results note); ``'auto'`` (default) picks per
-call site by KV-stream length — the fused kernel for long streams (image/flow
-inputs), XLA for short ones (text).
+call site: the fused kernel for long KV streams (image/flow inputs) and for
+big-logits self-attention stacks, XLA for small/shallow shapes (text) — see
+``auto_attention_impl``.
 """
 
 from __future__ import annotations
@@ -59,8 +60,40 @@ LN_EPS = 1e-5
 # at d=128, S=50k). d=512 measures a wash on time, where the kernel's O(S)
 # memory breaks the tie. Short streams (text, S<=512 latents) are always XLA:
 # those MXU-hostile d=16 shapes express worse in Mosaic than in the einsum.
+#
+# A second, area-based trigger covers big SELF-attention stacks whose S sits
+# under the KV threshold: at flow's (2, 2048, 2048, 8, 64) the materialized
+# logits are 67M elements and the kernel measures 2.0x fwd+bwd (1.44 vs
+# 2.85 ms — it never writes the 134 MB/layer logits). The d >= 32 guard keeps
+# the MXU-hostile d=16 text shapes on XLA at any batch (measured 6x slower in
+# Mosaic at d=16).
 AUTO_PALLAS_MIN_KV = 4096
 AUTO_PALLAS_MAX_HEAD_DIM = 512
+AUTO_PALLAS_MIN_LOGITS = 32 * 1024 * 1024  # B·H·T·S elements
+AUTO_PALLAS_AREA_MIN_HEAD_DIM = 32
+
+
+def auto_attention_impl(
+    b: int, t: int, s: int, h: int, d: int, backend: Optional[str] = None
+) -> str:
+    """Resolve ``attn_impl='auto'`` for a (B, T, S, H, D) attention call.
+
+    Pallas iff the backend is TPU, D ≤ 512, and either the KV stream is long
+    (S ≥ 4096 — the streaming-cross case) or the materialized logits would be
+    large with a non-tiny head (B·H·T·S ≥ 32M and D ≥ 32 — the big
+    self-attention case). Encodes the `tools/attn_shapes_bench.py`
+    measurements in PERF.md; change only with new rows there.
+    """
+    if backend is None:
+        backend = jax.default_backend()
+    if backend != "tpu" or d > AUTO_PALLAS_MAX_HEAD_DIM:
+        return "xla"
+    long_kv = s >= AUTO_PALLAS_MIN_KV
+    big_logits = (
+        b * h * t * s >= AUTO_PALLAS_MIN_LOGITS
+        and d >= AUTO_PALLAS_AREA_MIN_HEAD_DIM
+    )
+    return "pallas" if (long_kv or big_logits) else "xla"
 
 
 def layer_norm(dtype, name: str) -> nn.LayerNorm:
@@ -218,13 +251,12 @@ class MultiHeadAttention(nn.Module):
         # opt-in while its end-to-end wins are shape-dependent.
         impl = self.attn_impl
         if impl == "auto":
-            # TPU-only: off-TPU the kernel would run in interpreter mode
-            # (orders of magnitude slower); explicit 'pallas' keeps that
-            # fallback for tests.
-            long_kv = (s >= AUTO_PALLAS_MIN_KV
-                       and d <= AUTO_PALLAS_MAX_HEAD_DIM
-                       and jax.default_backend() == "tpu")
-            impl = "pallas" if long_kv else "xla"
+            # TPU-only (off-TPU the kernel would run in interpreter mode,
+            # orders of magnitude slower; explicit 'pallas' keeps that
+            # fallback for tests): long KV streams and big-logits
+            # self-attention go to the fused kernel, everything else to XLA
+            # (see auto_attention_impl).
+            impl = auto_attention_impl(b, t, s, h, d)
         fusable = attn_mask is None and not dropout_active
         if impl == "packed" and fusable:
             from perceiver_io_tpu.ops.pallas_attention import (

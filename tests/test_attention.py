@@ -217,3 +217,29 @@ def test_dropout_nonzero_varies_and_preserves_mean(rng):
     o2 = layer.apply(variables, x_q, x_kv, deterministic=False,
                      rngs={"dropout": jax.random.key(2)})
     assert not np.allclose(_np(o1), _np(o2))
+
+
+def test_auto_attention_impl_rule():
+    """The 'auto' dispatch table (ops/attention.py constants encode real
+    attn_shapes_bench measurements — PERF.md). Covers BOTH arms: the long-KV
+    trigger and the round-2 big-logits area trigger with its d >= 32 guard."""
+    from perceiver_io_tpu.ops.attention import auto_attention_impl as impl
+
+    # off-TPU: always XLA (the kernel would run in interpreter mode)
+    assert impl(2, 2048, 2048, 8, 64, backend="cpu") == "xla"
+
+    # long-KV arm (streaming cross-attention)
+    assert impl(2, 512, 50176, 8, 128, backend="tpu") == "pallas"   # in-8h
+    assert impl(1, 2048, 182528, 1, 512, backend="tpu") == "pallas" # flow-cross
+    assert impl(2, 512, 50176, 1, 1024, backend="tpu") == "xla"     # d>512
+    assert impl(8, 256, 512, 4, 16, backend="tpu") == "xla"         # mlm-cross
+
+    # big-logits arm (self-attention stacks under the KV threshold)
+    assert impl(2, 2048, 2048, 8, 64, backend="tpu") == "pallas"    # flow-self
+    assert impl(2, 182528, 2048, 1, 512, backend="tpu") == "pallas" # flow dec
+    assert impl(16, 512, 512, 8, 128, backend="tpu") == "pallas"    # in-self b16
+    # d >= 32 guard: MXU-hostile d=16 text shapes stay on XLA at ANY batch
+    # (B*H*T*S = 512*4*256*256 = 134M would otherwise trigger)
+    assert impl(512, 256, 256, 4, 16, backend="tpu") == "xla"
+    # area below threshold: ImageNet self-attn at batch 8 stays on XLA
+    assert impl(8, 512, 512, 8, 128, backend="tpu") == "xla"

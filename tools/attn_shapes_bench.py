@@ -26,6 +26,11 @@ SHAPES = [
     ("in-cross", 2, 512, 50176, 1, 1024),
     ("in-8h", 2, 512, 50176, 8, 128),
     ("flow-cross", 1, 2048, 182528, 1, 512),
+    ("flow-self", 2, 2048, 2048, 8, 64),
+    # shapes the area-based auto trigger also flips: the flow DECODER cross
+    # (many queries, few keys) and ImageNet self-attn at batch >= 16
+    ("flow-dec-cross", 2, 182528, 2048, 1, 512),
+    ("in-self-b16", 16, 512, 512, 8, 128),
 ]
 
 
