@@ -74,7 +74,17 @@ class MLMPredictor:
         from perceiver_io_tpu.training.checkpoint import load_hparams, restore_params
 
         hparams = load_hparams(checkpoint_dir)
-        args = SimpleNamespace(**hparams)
+        # Framework-only knobs absent from older / imported-reference
+        # checkpoints (a torch .ckpt's hparams carry only the reference's
+        # argparse surface); the checkpoint's own values override. dtype is
+        # DELIBERATELY float32 (not the CLI's bf16 training default):
+        # imported weights come from an f32 torch model and f32 is the
+        # golden-parity inference path.
+        defaults = {
+            "dtype": "float32", "attn_impl": "auto", "remat": False,
+            "dropout": 0.0,
+        }
+        args = SimpleNamespace(**{**defaults, **hparams})
         vocab_size = tokenizer.get_vocab_size()
         max_seq_len = hparams["max_seq_len"]
         model = common.build_mlm(args, vocab_size, max_seq_len)

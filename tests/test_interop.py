@@ -521,3 +521,44 @@ def test_from_hf_dict_rejects_unsupported():
                 {"type": "StripAccents"},
             ],
         }))
+
+
+def test_mlm_predictor_from_imported_checkpoint(tmp_path, rng):
+    """The full imported-artifact inference path: reference .ckpt -> Orbax
+    export -> MLMPredictor.from_checkpoint rebuilds the model from the
+    RENAMED hparams and serves fill-mask predictions that match the torch
+    model's logits."""
+    from perceiver_io_tpu.data.tokenizer import WordPieceTokenizer
+    from perceiver_io_tpu.inference import MLMPredictor
+
+    torch.manual_seed(5)
+    ref = RefMLM().eval()
+    ckpt = tmp_path / "mlm.ckpt"
+    torch.save(_lightning_ckpt(ref, REF_HPARAMS), ckpt)
+    out = tmp_path / "imported"
+    params, hparams = import_lightning_checkpoint(str(ckpt))
+    export_orbax_checkpoint(params, str(out), hparams=hparams)
+
+    # a VOCAB-sized tokenizer: specials + simple word tokens
+    vocab = {"[PAD]": 0, "[UNK]": 1, "[MASK]": 2}
+    for i in range(3, VOCAB):
+        vocab[f"w{i}"] = i
+    tok = WordPieceTokenizer(vocab=vocab)
+
+    pred = MLMPredictor.from_checkpoint(str(out), tok)
+    assert pred.max_seq_len == L
+
+    texts = ["w3 w4 [MASK] w6"]
+    results = pred.fill_masks(texts, k=3)
+    assert len(results) == 1 and len(results[0]) == 1  # one mask position
+    assert len(results[0][0]) == 3  # top-3 candidates
+
+    # logits parity at the masked position vs the torch model
+    ids = np.full((1, L), 0, np.int64)
+    ids[0, :4] = [3, 4, 2, 6]
+    pad = ids == 0
+    with torch.no_grad():
+        t_logits = ref(torch.tensor(ids), torch.tensor(pad)).numpy()
+    j_logits, j_ids = pred.logits(texts)
+    np.testing.assert_array_equal(j_ids[0, :4], [3, 4, 2, 6])
+    np.testing.assert_allclose(j_logits[0, 2], t_logits[0, 2], atol=2e-5)
