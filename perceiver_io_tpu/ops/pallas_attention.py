@@ -338,32 +338,12 @@ def _bwd(t_blk, s_blk, interpret, residuals, g):
 _fused_attention.defvjp(_fwd, _bwd)
 
 
-def fused_attention(
-    q: Array,
-    k: Array,
-    v: Array,
-    pad_mask: Optional[Array] = None,
-    kv_block_size: int = DEFAULT_KV_BLOCK,
-    q_block_size: int = DEFAULT_Q_BLOCK,
-    interpret: Optional[bool] = None,
-) -> Array:
-    """Fused multi-head attention over (B, T, H, D) q and (B, S, H, D) k/v.
-
-    ``pad_mask``: optional (B, S) bool, True = key position masked out (the
-    torch ``key_padding_mask`` convention). Off-TPU backends run the kernel in
-    interpreter mode (slow — for tests), overridable via ``interpret``.
-    """
-    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
-        raise ValueError(f"expected (B, T/S, H, D) tensors, got {q.shape=} {k.shape=}")
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-
-    b, t, h, d = q.shape
+def _prepare_blocks(q, k, v, bias, kv_block_size, q_block_size, interpret):
+    """Shared preamble: heads-major transpose, KV/query block sizing, and
+    tiling-legal padding. Returns ``(q, k, v, bias, t_blk, s_blk, t_pad)``
+    with q/k/v in (B, H, T/S, D) layout."""
+    t = q.shape[1]
     s = k.shape[1]
-    if pad_mask is None:
-        bias = jnp.zeros((b, s), jnp.float32)
-    else:
-        bias = jnp.where(pad_mask, MASK_VALUE, 0.0).astype(jnp.float32)
 
     # heads-major layout so each (b, h) grid step reads contiguous KV rows
     q = jnp.transpose(q, (0, 2, 1, 3))
@@ -401,10 +381,187 @@ def fused_attention(
             t_pad = -t % t_blk
             q = jnp.pad(q, ((0, 0), (0, 0), (0, t_pad), (0, 0)))
 
+    return q, k, v, bias, t_blk, s_blk, t_pad
+
+
+def fused_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    pad_mask: Optional[Array] = None,
+    kv_block_size: int = DEFAULT_KV_BLOCK,
+    q_block_size: int = DEFAULT_Q_BLOCK,
+    interpret: Optional[bool] = None,
+) -> Array:
+    """Fused multi-head attention over (B, T, H, D) q and (B, S, H, D) k/v.
+
+    ``pad_mask``: optional (B, S) bool, True = key position masked out (the
+    torch ``key_padding_mask`` convention). Off-TPU backends run the kernel in
+    interpreter mode (slow — for tests), overridable via ``interpret``.
+    """
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        raise ValueError(f"expected (B, T/S, H, D) tensors, got {q.shape=} {k.shape=}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    b, t, h, d = q.shape
+    s = k.shape[1]
+    if pad_mask is None:
+        bias = jnp.zeros((b, s), jnp.float32)
+    else:
+        bias = jnp.where(pad_mask, MASK_VALUE, 0.0).astype(jnp.float32)
+
+    q, k, v, bias, t_blk, s_blk, t_pad = _prepare_blocks(
+        q, k, v, bias, kv_block_size, q_block_size, interpret
+    )
     out = _fused_attention(q, k, v, bias, t_blk, s_blk, interpret)
     if t_pad:
         out = out[:, :, :t]
     return jnp.transpose(out, (0, 2, 1, 3))
+
+
+# -- sequence-parallel fused attention ---------------------------------------
+#
+# The distributed-flash combine: each device runs the streaming kernel over
+# its LOCAL KV shard, then the per-shard softmax statistics (running max m,
+# denominator l) merge across the mesh axis with one pmax + two psums — the
+# Perceiver-shaped equivalent of ring attention (latents/queries are
+# replicated along the axis and S is the only long dimension, so a single
+# all-reduce of O(B·H·T) stats replaces a ring of KV exchanges). The
+# backward reruns the flash backward per shard against the GLOBAL (m, l)
+# and psums only dq (dk/dv stay shard-local).
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _sp_fused(q, k, v, bias, t_blk, s_blk, interpret, axis):
+    out, _, _ = _sp_forward(q, k, v, bias, t_blk, s_blk, interpret, axis)
+    return out
+
+
+def _sp_forward(q, k, v, bias, t_blk, s_blk, interpret, axis):
+    out_l, m_l, l_l = _fused_attention_fwd_impl(
+        q, k, v, bias, t_blk, s_blk, interpret, with_lse=True
+    )
+    # the kernel saves stats lane-broadcast as (B, H, T, LANES); collect the
+    # collectives on the [:, :, :, :1] slice so each stat all-reduce moves
+    # O(B·H·T), not 128x that, then re-broadcast for the backward residuals
+    m_g = jax.lax.pmax(m_l[..., :1], axis)
+    # a shard whose keys are all padded has m_l pinned at MASK_VALUE: its
+    # weight underflows to exactly 0 against any real shard, and when EVERY
+    # shard is padded (fully masked row) the weights reduce to l_l > 0 — the
+    # same uniform-attention semantics as the single-device kernel
+    w = jnp.exp(m_l[..., :1] - m_g) * l_l[..., :1]  # (B, H, T, 1) f32
+    l_g = jax.lax.psum(w, axis)
+    out = jax.lax.psum(out_l.astype(jnp.float32) * (w / l_g), axis)
+    bcast = lambda x: jnp.broadcast_to(x, x.shape[:-1] + (m_l.shape[-1],))
+    return out.astype(out_l.dtype), bcast(m_g), bcast(l_g)
+
+
+def _sp_fwd(q, k, v, bias, t_blk, s_blk, interpret, axis):
+    out, m_g, l_g = _sp_forward(q, k, v, bias, t_blk, s_blk, interpret, axis)
+    return out, (q, k, v, bias, out, m_g, l_g)
+
+
+def _sp_bwd(t_blk, s_blk, interpret, axis, residuals, g):
+    q, k, v, bias, out, m_g, l_g = residuals
+    # shard_map's transpose conventions under check_rep=False (empirically
+    # pinned by the gradient tests across dp/tp/sp mesh mixes): the
+    # cotangent of an output replicated over mesh axes arrives DIVIDED by
+    # the product of those axis sizes, and the returned input cotangents are
+    # psum'd over each input's own unmentioned axes on the way out. Those
+    # outgoing psums already restore the factor for every replicated NON-seq
+    # axis (each of its replicas computes an identical cotangent), so the
+    # only factor to reconstruct here is the seq axis itself — its replicas
+    # hold genuinely PARTIAL contributions, not copies.
+    g = jax.lax.psum(g, axis)
+    # global (m, l) make each shard's recomputed tile probabilities the
+    # GLOBAL softmax restricted to its keys; out/g are replicated, so the
+    # in-kernel delta = Σ g·out is already global
+    dq_partial, dk, dv = _fused_attention_bwd_impl(
+        q, k, v, bias, out, m_g, l_g, g, t_blk, s_blk, interpret
+    )
+    return dq_partial, dk, dv, jnp.zeros_like(bias)
+
+
+_sp_fused.defvjp(_sp_fwd, _sp_bwd)
+
+
+def seq_parallel_fused_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    pad_mask: Optional[Array] = None,
+    *,
+    mesh,
+    axis: str = "seq",
+    batch_axis: Optional[str] = None,
+    kv_block_size: int = DEFAULT_KV_BLOCK,
+    q_block_size: int = DEFAULT_Q_BLOCK,
+    interpret: Optional[bool] = None,
+) -> Array:
+    """:func:`fused_attention` with the KV axis SHARDED over a mesh axis.
+
+    Sequence/context parallelism for the kernel path: under plain ``jit``
+    GSPMD cannot partition a ``pallas_call``, so a seq-sharded KV stream gets
+    all-gathered before the kernel — the memory benefit of sharding M is
+    lost exactly where it matters (SURVEY.md §5's long-context plan). This
+    wrapper runs the kernel under ``shard_map`` instead: every device
+    processes only its S/n_shards slice of keys/values (O(S/n) HBM and VMEM),
+    and the softmax statistics merge with one ``pmax`` + two ``psum`` of
+    O(B·H·T) — no ring, because Perceiver attention has replicated queries
+    and a single long axis. Gradients: flash backward per shard against the
+    global statistics; only dq is psum'd (dk/dv are shard-local like k/v).
+
+    Args mirror :func:`fused_attention`, plus:
+      mesh: the ``jax.sharding.Mesh`` to shard over.
+      axis: mesh axis name carrying the KV shards (default ``'seq'``).
+      batch_axis: optional mesh axis for the leading batch dimension (compose
+        with data parallelism).
+    Inputs may be global ``jax.Array``s (sharded or not) or host arrays; S
+    must divide evenly by the axis size.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
+        raise ValueError(f"expected (B, T/S, H, D) tensors, got {q.shape=} {k.shape=}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n_shards = mesh.shape[axis]
+    b, t, h, d = q.shape
+    s = k.shape[1]
+    if s % n_shards:
+        raise ValueError(
+            f"KV length {s} must be divisible by the '{axis}' mesh axis "
+            f"size ({n_shards}) — pad S to a multiple"
+        )
+
+    if pad_mask is None:
+        bias = jnp.zeros((b, s), jnp.float32)
+    else:
+        bias = jnp.where(pad_mask, MASK_VALUE, 0.0).astype(jnp.float32)
+
+    def local(q_l, k_l, v_l, bias_l):
+        qh, kh, vh, bias_p, t_blk, s_blk, t_pad = _prepare_blocks(
+            q_l, k_l, v_l, bias_l, kv_block_size, q_block_size, interpret
+        )
+        out = _sp_fused(qh, kh, vh, bias_p, t_blk, s_blk, interpret, axis)
+        if t_pad:
+            out = out[:, :, :t]
+        return jnp.transpose(out, (0, 2, 1, 3))
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P(batch_axis),
+            P(batch_axis, axis),
+            P(batch_axis, axis),
+            P(batch_axis, axis),
+        ),
+        out_specs=P(batch_axis),
+        check_rep=False,  # custom_vjp + collectives confuse the rep checker
+    )(q, k, v, bias)
 
 
 # -- packed-heads latent kernel ----------------------------------------------

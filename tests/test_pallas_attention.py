@@ -7,7 +7,10 @@ import numpy as np
 import pytest
 
 from perceiver_io_tpu.ops.attention import MultiHeadAttention, _dot_product_attention
-from perceiver_io_tpu.ops.pallas_attention import fused_attention
+from perceiver_io_tpu.ops.pallas_attention import (
+    fused_attention,
+    seq_parallel_fused_attention,
+)
 
 
 def _rand(rng, *shape, dtype=jnp.float32):
@@ -336,3 +339,116 @@ class TestPackedLatentAttention:
 
         assert packed_fits_vmem(256, 512, 64)          # MLM cross
         assert not packed_fits_vmem(1024, 1024, 512)   # backward can't fit
+
+
+# -- sequence-parallel fused attention ---------------------------------------
+
+
+class TestSeqParallelFusedAttention:
+    """seq_parallel_fused_attention == fused_attention with KV sharded over
+    the mesh: each device touches only its S/n slice, stats merge via
+    pmax/psum, gradients flow through the shard_map'd custom VJP."""
+
+    def _inputs(self, rng, B=2, T=16, S=96, H=2, D=8):
+        q = jnp.asarray(rng.normal(0, 1, (B, T, H, D)).astype(np.float32))
+        k = jnp.asarray(rng.normal(0, 1, (B, S, H, D)).astype(np.float32))
+        v = jnp.asarray(rng.normal(0, 1, (B, S, H, D)).astype(np.float32))
+        return q, k, v
+
+    def test_forward_matches_single_device(self, rng):
+        from perceiver_io_tpu.parallel import make_mesh
+
+        q, k, v = self._inputs(rng)
+        pad = jnp.zeros((2, 96), bool).at[0, -13:].set(True)
+        ref = fused_attention(q, k, v, pad_mask=pad)
+
+        mesh = make_mesh(dp=2, tp=1, sp=4)
+        out = seq_parallel_fused_attention(
+            q, k, v, pad_mask=pad, mesh=mesh, axis="seq"
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_forward_with_batch_axis(self, rng):
+        from perceiver_io_tpu.parallel import make_mesh
+
+        q, k, v = self._inputs(rng)
+        ref = fused_attention(q, k, v)
+        mesh = make_mesh(dp=2, tp=1, sp=4)
+        out = seq_parallel_fused_attention(
+            q, k, v, mesh=mesh, axis="seq", batch_axis="data"
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_fully_padded_shard(self, rng):
+        """A shard whose keys are ALL padding must contribute nothing."""
+        from perceiver_io_tpu.parallel import make_mesh
+
+        q, k, v = self._inputs(rng)
+        pad = jnp.zeros((2, 96), bool).at[:, -24:].set(True)  # last shard
+        ref = fused_attention(q, k, v, pad_mask=pad)
+        mesh = make_mesh(dp=2, tp=1, sp=4)
+        out = seq_parallel_fused_attention(
+            q, k, v, pad_mask=pad, mesh=mesh, axis="seq"
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    @pytest.mark.parametrize("dp,tp,sp,batch_axis", [
+        (1, 1, 8, None),
+        # replicated non-seq axes of size > 1: the transpose convention
+        # double-counted these before the round-2 fix (grads came back
+        # exactly dp*tp times too large while the forward stayed correct)
+        (2, 1, 4, None),
+        (1, 2, 4, None),
+        (2, 2, 2, "data"),
+    ])
+    def test_gradients_match_single_device(self, rng, dp, tp, sp, batch_axis):
+        from perceiver_io_tpu.parallel import make_mesh
+
+        q, k, v = self._inputs(rng, S=64)
+        pad = jnp.zeros((2, 64), bool).at[1, -9:].set(True)
+        mesh = make_mesh(dp=dp, tp=tp, sp=sp)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(fused_attention(q, k, v, pad_mask=pad) ** 2)
+
+        def loss_sp(q, k, v):
+            return jnp.sum(
+                seq_parallel_fused_attention(
+                    q, k, v, pad_mask=pad, mesh=mesh, axis="seq",
+                    batch_axis=batch_axis,
+                ) ** 2
+            )
+
+        ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        got = jax.grad(loss_sp, argnums=(0, 1, 2))(q, k, v)
+        for g, r in zip(got, ref):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=1e-4)
+
+    def test_under_jit_with_sharded_inputs(self, rng):
+        """The intended deployment: jit + pre-sharded global arrays."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from perceiver_io_tpu.parallel import make_mesh
+
+        q, k, v = self._inputs(rng)
+        mesh = make_mesh(dp=2, tp=1, sp=4)
+        ref = fused_attention(q, k, v)
+
+        q_s = jax.device_put(q, NamedSharding(mesh, P("data")))
+        k_s = jax.device_put(k, NamedSharding(mesh, P("data", "seq")))
+        v_s = jax.device_put(v, NamedSharding(mesh, P("data", "seq")))
+        fn = jax.jit(
+            lambda q, k, v: seq_parallel_fused_attention(
+                q, k, v, mesh=mesh, axis="seq", batch_axis="data"
+            )
+        )
+        out = fn(q_s, k_s, v_s)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_uneven_kv_rejected(self, rng):
+        from perceiver_io_tpu.parallel import make_mesh
+
+        q, k, v = self._inputs(rng, S=90)  # 90 % 4 != 0
+        mesh = make_mesh(dp=2, tp=1, sp=4)
+        with pytest.raises(ValueError, match="divisible by the 'seq' mesh axis"):
+            seq_parallel_fused_attention(q, k, v, mesh=mesh, axis="seq")
