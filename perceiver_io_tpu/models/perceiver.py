@@ -69,6 +69,10 @@ class PerceiverLayer(nn.Module):
             dropout=self.dropout,
             dtype=self.dtype,
             attn_impl=self.attn_impl,
+            # this KV stream is the adapted input — the tensor shard_seq=True
+            # shards over the mesh's seq axis — so it may route to the
+            # sequence-parallel kernel when that regime is active
+            seq_shard_kv=True,
             name="cross_attention_layer",
         )(x_latent, x_input, pad_mask=pad_mask, deterministic=deterministic)
         return SelfAttentionBlock(

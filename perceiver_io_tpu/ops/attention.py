@@ -31,6 +31,18 @@ to the streaming fused Pallas kernel in ``perceiver_io_tpu.ops.pallas_attention`
 call site: the fused kernel for long KV streams (image/flow inputs) and for
 big-logits self-attention stacks, XLA for small/shallow shapes (text) — see
 ``auto_attention_impl``.
+
+Sequence parallelism: under an active regime
+(``parallel.mesh.sequence_parallel_context`` — entered by
+``make_sharded_train_step(shard_seq=True)``), attention calls marked
+``seq_shard_kv=True`` (the encoder cross-attention, whose KV stream is the
+seq-sharded input) route the kernel path through
+``seq_parallel_fused_attention``: each device's ``pallas_call`` streams only
+its S/n KV shard and softmax statistics merge with O(B·H·T) collectives,
+instead of GSPMD all-gathering the KV stream around the kernel.
+``attn_impl='pallas_sp'`` forces the kernel path with sp routing (degrading
+to plain 'pallas' where sp doesn't apply); ``'auto'`` picks sp whenever it
+would have picked the kernel and the regime is active.
 """
 
 from __future__ import annotations
@@ -197,7 +209,14 @@ class MultiHeadAttention(nn.Module):
     num_heads: int
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
-    attn_impl: str = "auto"  # 'auto' | 'xla' | 'pallas' | 'packed'
+    attn_impl: str = "auto"  # 'auto' | 'xla' | 'pallas' | 'pallas_sp' | 'packed'
+    # Structural marker set by the ENCODER on its cross-attention: this call's
+    # KV stream is the adapted input whose sequence axis shards over the mesh's
+    # seq axis under shard_seq=True. Only such calls may route to the
+    # sequence-parallel kernel — the latent self-attention and decoder
+    # cross-attention have replicated (latent-sized) KV, where sp routing
+    # would be legal but pointless collective traffic.
+    seq_shard_kv: bool = False
 
     @nn.compact
     def __call__(
@@ -250,15 +269,47 @@ class MultiHeadAttention(nn.Module):
         # (B, T, E) layout (head separation in-VMEM by channel masking) —
         # opt-in while its end-to-end wins are shape-dependent.
         impl = self.attn_impl
+        # Sequence-parallel routing: active regime (make_sharded_train_step
+        # shard_seq=True over a mesh with seq > 1) + this call marked as the
+        # seq-sharded KV consumer + KV length divisible by the axis. Explicit
+        # 'pallas_sp' degrades to 'pallas' wherever sp doesn't apply, so one
+        # model-level flag flips only the encoder cross-attention.
+        sp = None
+        if self.seq_shard_kv and impl in ("auto", "pallas", "pallas_sp"):
+            from perceiver_io_tpu.parallel.mesh import active_sequence_parallel
+
+            ctx = active_sequence_parallel()
+            if ctx is not None and s % ctx.mesh.shape[ctx.axis] == 0 and (
+                ctx.batch_axis is None
+                or b % ctx.mesh.shape[ctx.batch_axis] == 0
+            ):
+                # both divisibility guards matter: shard_map's in_specs
+                # require exact splits, and eval batches (e.g. a drop_last=
+                # False tail) may not divide the data axis — those fall back
+                # to the plain kernel/XLA path, which GSPMD handles
+                sp = ctx
+        if impl == "pallas_sp":
+            impl = "pallas"
         if impl == "auto":
             # TPU-only (off-TPU the kernel would run in interpreter mode,
             # orders of magnitude slower; explicit 'pallas' keeps that
             # fallback for tests): long KV streams and big-logits
             # self-attention go to the fused kernel, everything else to XLA
-            # (see auto_attention_impl).
+            # (see auto_attention_impl). Mesh-aware: under an active
+            # seq-parallel regime the same shapes route to the sp kernel.
             impl = auto_attention_impl(b, t, s, h, d)
         fusable = attn_mask is None and not dropout_active
-        if impl == "packed" and fusable:
+        if impl == "pallas" and fusable and sp is not None:
+            from perceiver_io_tpu.ops.pallas_attention import (
+                seq_parallel_fused_attention,
+            )
+
+            out = seq_parallel_fused_attention(
+                q.reshape(b, t, h, d), k.reshape(b, s, h, d),
+                v.reshape(b, s, h, d), pad_mask=pad_mask,
+                mesh=sp.mesh, axis=sp.axis, batch_axis=sp.batch_axis,
+            ).reshape(b, t, e)
+        elif impl == "packed" and fusable:
             from perceiver_io_tpu.ops.pallas_attention import (
                 packed_fits_vmem,
                 packed_latent_attention,
@@ -308,6 +359,7 @@ class CrossAttention(nn.Module):
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
     attn_impl: str = "auto"
+    seq_shard_kv: bool = False
 
     @nn.compact
     def __call__(self, x_q, x_kv, pad_mask=None, attn_mask=None, deterministic=True):
@@ -320,6 +372,7 @@ class CrossAttention(nn.Module):
             dropout=self.dropout,
             dtype=self.dtype,
             attn_impl=self.attn_impl,
+            seq_shard_kv=self.seq_shard_kv,
             name="attention",
         )(x_q, x_kv, pad_mask=pad_mask, attn_mask=attn_mask, deterministic=deterministic)
 
@@ -391,6 +444,7 @@ class CrossAttentionLayer(nn.Module):
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
     attn_impl: str = "auto"
+    seq_shard_kv: bool = False
 
     @nn.compact
     def __call__(self, x_q, x_kv, pad_mask=None, deterministic=True):
@@ -404,6 +458,7 @@ class CrossAttentionLayer(nn.Module):
             dropout=self.dropout,
             dtype=self.dtype,
             attn_impl=self.attn_impl,
+            seq_shard_kv=self.seq_shard_kv,
             name="cross_attention",
         )(x_q, x_kv, pad_mask=pad_mask, deterministic=deterministic)
         x = drop(attn_out, deterministic=deterministic) + x_q

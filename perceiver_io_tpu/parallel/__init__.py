@@ -2,8 +2,11 @@ from perceiver_io_tpu.parallel.mesh import (
     AXIS_DATA,
     AXIS_MODEL,
     AXIS_SEQ,
+    SequenceParallelContext,
+    active_sequence_parallel,
     make_mesh,
     initialize_distributed,
+    sequence_parallel_context,
 )
 from perceiver_io_tpu.parallel.sharding import (
     PARAM_RULES,
@@ -19,8 +22,11 @@ __all__ = [
     "AXIS_DATA",
     "AXIS_MODEL",
     "AXIS_SEQ",
+    "SequenceParallelContext",
+    "active_sequence_parallel",
     "make_mesh",
     "initialize_distributed",
+    "sequence_parallel_context",
     "PARAM_RULES",
     "batch_pspecs",
     "replicated",

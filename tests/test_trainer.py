@@ -405,3 +405,37 @@ def test_steps_per_dispatch_matches_per_step(tmp_path, mesh):
     # edges that cross those boundaries (4 and 7)
     assert [r["step"] for r in rows1] == [2, 4, 6]
     assert [r["step"] for r in rows4] == [4, 7]
+
+
+def test_eval_shardings_unstacked_with_multistep_dispatch(tmp_path):
+    """With steps_per_dispatch>1 in mesh mode, the TRAIN batch shardings carry
+    a leading scan axis but eval batches never do — the trainer must keep a
+    separate unstacked plan for eval (ADVICE r2: multi-host eval crashed when
+    both were combined, because make_array_from_process_local_data got a spec
+    one rank longer than the eval array). The multi-process leg runs in
+    tests/test_multihost.py (worker uses steps_per_dispatch=2 + val_loader);
+    this checks the plan structurally."""
+    mesh = make_mesh()
+    trainer, (train_loader, val_loader) = _make_parts(tmp_path, mesh=mesh)
+    cfg = dataclasses.replace(trainer.config, steps_per_dispatch=4)
+    t = Trainer(
+        trainer._raw_train_step,
+        trainer._eval_step and (lambda s, b, k: trainer._eval_step(s, b, k)),
+        trainer.state,
+        cfg,
+        example_batch=trainer._example_batch,
+        mesh=mesh,
+    )
+    for key, example in t._example_batch.items():
+        train_spec = t._batch_shardings[key].spec
+        eval_spec = t._eval_batch_shardings[key].spec
+        # train plan: leading None for the scan axis, then the eval plan
+        assert len(train_spec) == np.ndim(example) + 1
+        assert train_spec[0] is None
+        assert tuple(train_spec[1:]) == tuple(eval_spec)
+        assert len(eval_spec) <= np.ndim(example)
+    # and eval actually runs (single-process: batches pass through unchanged)
+    with t:
+        t.fit(train_loader, val_loader)
+        rows = read_metrics(t.run_dir)
+    assert any("val_loss" in r for r in rows)
