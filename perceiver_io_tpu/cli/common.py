@@ -41,9 +41,17 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
 
 def add_optimizer_args(parser: argparse.ArgumentParser) -> None:
     g = parser.add_argument_group("optimizer")
-    g.add_argument("--optimizer", choices=("Adam", "AdamW"), default="Adam")
+    g.add_argument("--optimizer",
+                   choices=("Adam", "AdamW", "SGD", "RMSprop", "Adagrad"),
+                   default="Adam",
+                   help="torch.optim name (the reference resolves any name "
+                        "via getattr; these are mapped to optax with torch's "
+                        "exact update semantics)")
     g.add_argument("--learning_rate", type=float, default=1e-3)
     g.add_argument("--weight_decay", type=float, default=0.0)
+    g.add_argument("--momentum", type=float, default=0.0,
+                   help="SGD momentum (torch trace semantics; ignored by "
+                        "other optimizers)")
     g.add_argument("--one_cycle_lr", action="store_true")
     g.add_argument("--one_cycle_pct_start", type=float, default=0.1)
     g.add_argument("--grad_clip_norm", type=float, default=None,
@@ -105,11 +113,16 @@ def add_mesh_args(parser: argparse.ArgumentParser) -> None:
 def add_compute_args(parser: argparse.ArgumentParser) -> None:
     g = parser.add_argument_group("compute")
     g.add_argument("--dtype", choices=sorted(DTYPES), default="bfloat16")
-    g.add_argument("--attn_impl", choices=("auto", "xla", "pallas", "packed"),
+    g.add_argument("--attn_impl",
+                   choices=("auto", "xla", "pallas", "pallas_sp", "packed"),
                    default="auto",
                    help="attention inner-product impl; auto picks the fused "
-                        "Pallas kernel for long KV streams, XLA otherwise; "
-                        "packed = experimental small-latent kernel (PERF.md)")
+                        "Pallas kernel for long KV streams, XLA otherwise "
+                        "(and routes the encoder cross-attention through the "
+                        "sequence-parallel kernel when --sp > 1 and "
+                        "--shard_seq are active); pallas_sp forces the kernel "
+                        "path with that sp routing; packed = experimental "
+                        "small-latent kernel (PERF.md)")
     g.add_argument("--remat", action="store_true",
                    help="rematerialize encoder layers (HBM for FLOPs)")
     g.add_argument("--pad_vocab_multiple", type=int, default=None,
@@ -177,6 +190,7 @@ def optimizer_from_args(args):
             one_cycle_lr=args.one_cycle_lr,
             one_cycle_pct_start=args.one_cycle_pct_start,
             max_steps=args.max_steps,
+            momentum=getattr(args, "momentum", 0.0),
             grad_clip_norm=getattr(args, "grad_clip_norm", None),
             accumulate_steps=getattr(args, "accumulate_steps", 1),
         )

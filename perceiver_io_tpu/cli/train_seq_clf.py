@@ -113,6 +113,11 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--clf_checkpoint", default=None,
                    help="checkpoints dir of a train_seq_clf run: resume")
     g.add_argument("--freeze_encoder", action="store_true")
+    g.add_argument("--unsafe_load", action="store_true",
+                   help="when a checkpoint flag points at a torch .ckpt that "
+                        "the safe weights-only loader rejects, fall back to "
+                        "the unrestricted pickle loader (executes code "
+                        "embedded in the file — only for trusted artifacts)")
     # reference per-task defaults (train_seq_clf.py:56-68)
     parser.set_defaults(experiment="seq_clf", batch_size=128, weight_decay=1e-3,
                         dropout=0.1, num_latents=64, num_latent_channels=64,
@@ -138,7 +143,9 @@ def main(argv: Optional[Sequence[str]] = None):
     if source_ckpt and _is_torch_ckpt(source_ckpt):
         from perceiver_io_tpu.interop import import_lightning_checkpoint
 
-        imported_params, source_hparams = import_lightning_checkpoint(source_ckpt)
+        imported_params, source_hparams = import_lightning_checkpoint(
+            source_ckpt, allow_unsafe_pickle=args.unsafe_load
+        )
         common.override_model_args(args, source_hparams)
     elif source_ckpt:
         source_hparams = load_hparams(source_ckpt)

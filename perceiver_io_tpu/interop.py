@@ -207,7 +207,15 @@ def _finalize_mha(group: Dict[str, np.ndarray], where: str) -> Dict[str, Any]:
         qw, kw, vw = (
             group["q_proj_weight"], group["k_proj_weight"], group["v_proj_weight"]
         )
-    bias = group["in_proj_bias"]
+    bias = group.get("in_proj_bias")
+    if bias is None:
+        # the reference always builds nn.MultiheadAttention with bias=True,
+        # so this is a malformed/foreign checkpoint — name the path instead
+        # of dying on a bare KeyError
+        raise ValueError(
+            f"attention at {where} missing in_proj_bias (bias=False "
+            f"checkpoints are not the reference layout)"
+        )
     return {
         "q_proj": {"kernel": qw.T.copy(), "bias": bias[:e].copy()},
         "k_proj": {"kernel": kw.T.copy(), "bias": bias[e:2 * e].copy()},
@@ -269,15 +277,53 @@ def convert_state_dict(state_dict: Mapping[str, Any]) -> Dict[str, Any]:
     return params
 
 
-def load_lightning_checkpoint(path: str) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+def load_lightning_checkpoint(
+    path: str, allow_unsafe_pickle: bool = False
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     """Read a Lightning ``.ckpt`` (a torch pickle) → (state_dict, hparams).
 
     torch is only needed here, at the import boundary — never on the device
     path.
+
+    Loads with ``weights_only=True`` first: these files are third-party
+    artifacts, and an unrestricted pickle executes arbitrary code at load
+    time. Lightning checkpoints store an ``argparse.Namespace`` in
+    ``hyper_parameters``, which the safe loader admits via
+    ``add_safe_globals``. Only when a checkpoint needs classes outside that
+    allowlist does ``allow_unsafe_pickle=True`` (an explicit caller opt-in,
+    surfaced as ``--unsafe_load`` on the import CLI) fall back to the
+    unrestricted loader, with a warning.
     """
+    import argparse as _argparse
+
     import torch
 
-    ckpt = torch.load(path, map_location="cpu", weights_only=False)
+    import pickle
+
+    try:
+        with torch.serialization.safe_globals([_argparse.Namespace]):
+            ckpt = torch.load(path, map_location="cpu", weights_only=True)
+    # Only unpickling failures get the --unsafe_load advice/fallback: a
+    # missing file (OSError) or corrupted archive (torch RuntimeError)
+    # fails identically under the unrestricted loader, and advising users
+    # to disable a security control for those would teach the wrong habit.
+    except pickle.UnpicklingError as e:
+        if not allow_unsafe_pickle:
+            raise ValueError(
+                f"checkpoint {path!r} does not load under torch's safe "
+                f"weights-only unpickler ({type(e).__name__}: {e}); if you "
+                f"trust its origin, retry with allow_unsafe_pickle=True "
+                f"(CLI: --unsafe_load)"
+            ) from e
+        import warnings
+
+        warnings.warn(
+            f"loading {path!r} with the unrestricted pickle loader — this "
+            f"executes code embedded in the file; only do this for artifacts "
+            f"you trust",
+            stacklevel=2,
+        )
+        ckpt = torch.load(path, map_location="cpu", weights_only=False)
     if "state_dict" not in ckpt:  # a bare state_dict file also works
         return ckpt, {}
     hparams = ckpt.get("hyper_parameters", {}) or {}
@@ -306,15 +352,18 @@ def convert_hparams(hparams: Mapping[str, Any]) -> Dict[str, Any]:
 
 
 def import_lightning_checkpoint(
-    path: str, encoder_only: bool = False
+    path: str, encoder_only: bool = False, allow_unsafe_pickle: bool = False
 ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     """Lightning ``.ckpt`` → (flax params pytree, converted hparams).
 
     ``encoder_only=True`` returns just the ``encoder`` subtree — the transfer
     entry (reference ``train_seq_clf.py:18-24`` moves the pretrained MLM
-    encoder into a fresh classifier).
+    encoder into a fresh classifier). ``allow_unsafe_pickle``: see
+    :func:`load_lightning_checkpoint`.
     """
-    state_dict, hparams = load_lightning_checkpoint(path)
+    state_dict, hparams = load_lightning_checkpoint(
+        path, allow_unsafe_pickle=allow_unsafe_pickle
+    )
     params = convert_state_dict(state_dict)
     if encoder_only:
         params = {"encoder": params["encoder"]}

@@ -464,6 +464,15 @@ def _sp_fwd(q, k, v, bias, t_blk, s_blk, interpret, axis):
 
 def _sp_bwd(t_blk, s_blk, interpret, axis, residuals, g):
     q, k, v, bias, out, m_g, l_g = residuals
+    # JAX-version sensitivity: the scaling below encodes shard_map's
+    # check_rep=False transpose convention as observed on jax 0.9.x. It is
+    # not a documented contract — a future upgrade could change it SILENTLY
+    # (gradients off by exactly the product of some mesh axis sizes, forward
+    # unchanged). The canary is TestSeqParallelFusedAttention
+    # .test_gradients_match_single_device (dp/tp/sp parametrized): if it
+    # fails with grads wrong by an integer factor after a JAX upgrade, this
+    # is the first place to look.
+    #
     # shard_map's transpose conventions under check_rep=False (empirically
     # pinned by the gradient tests across dp/tp/sp mesh mixes): the
     # cotangent of an output replicated over mesh axes arrives DIVIDED by

@@ -1,8 +1,11 @@
 """Optimizer and LR-schedule factory.
 
-Mirrors the reference optimizer surface (``lightning.py:50-79``): Adam or
-AdamW selected by name, optional OneCycle LR stepped per optimizer step and
-requiring ``max_steps``.
+Mirrors the reference optimizer surface (``lightning.py:50-79``): the
+reference resolves ``--optimizer`` with ``getattr(torch.optim, name)``
+(``lightning.py:60``), so any torch optimizer name works from its CLI. Here
+the common names — Adam, AdamW, SGD, RMSprop, Adagrad — map to optax with
+torch's exact update semantics; unknown names raise the same clear error as
+before (a silent near-miss optimizer is worse than a loud gap).
 
 Semantic parity notes:
 
@@ -10,6 +13,16 @@ Semantic parity notes:
   the moment updates → ``optax.chain(add_decayed_weights, scale_by_adam, lr)``.
 - torch ``AdamW(weight_decay=w)`` is decoupled, decay scaled by the lr →
   ``optax.adamw``.
+- torch ``SGD(momentum=m)`` keeps ``buf = m·buf + grad`` (dampening 0) and
+  steps by ``lr·buf`` → ``optax.trace(decay=m)``; weight decay is coupled L2
+  applied before the momentum buffer.
+- torch ``RMSprop``: ``sq = α·sq + (1−α)·g²``, step ``lr·g/(√sq + eps)`` with
+  α=0.99, eps=1e-8 — the eps sits OUTSIDE the sqrt →
+  ``optax.scale_by_rms(decay=0.99, eps=1e-8, eps_in_sqrt=False)``.
+- torch ``Adagrad``: ``sum += g²``, step ``lr·g/(√sum + eps)`` with eps=1e-10
+  and zero initial accumulator. optax's ``scale_by_rss`` puts eps inside the
+  sqrt and special-cases sum==0, so ``_scale_by_adagrad_torch`` below
+  reproduces the torch update directly.
 - torch ``OneCycleLR(max_lr, pct_start, total_steps, cycle_momentum=False)``
   uses cosine annealing with ``div_factor=25``, ``final_div_factor=1e4``, a
   peak at step ``pct_start*total_steps - 1`` and the minimum at step
@@ -24,8 +37,9 @@ log the current LR (the reference's per-step ``LearningRateMonitor``,
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Optional, Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import optax
 
@@ -64,15 +78,53 @@ def torch_one_cycle_schedule(
 class OptimizerConfig:
     """Reference optimizer argparse group (``lightning.py:50-57``)."""
 
-    optimizer: str = "Adam"  # 'Adam' | 'AdamW'
+    optimizer: str = "Adam"  # 'Adam' | 'AdamW' | 'SGD' | 'RMSprop' | 'Adagrad'
     learning_rate: float = 1e-3
     weight_decay: float = 0.0
     one_cycle_lr: bool = False
     one_cycle_pct_start: float = 0.1
     max_steps: Optional[int] = None
+    # torch SGD momentum (the reference never sets it — its getattr call
+    # passes only lr/weight_decay — but torch's default surface has it)
+    momentum: float = 0.0
     # TPU-framework extensions beyond the reference surface:
     grad_clip_norm: Optional[float] = None  # global-norm clipping before moments
     accumulate_steps: int = 1  # micro-batches averaged per optimizer update
+
+
+class _AdagradState(NamedTuple):
+    sum_of_squares: object
+
+
+def _scale_by_adagrad_torch(
+    eps: float = 1e-10, initial_accumulator_value: float = 0.0
+) -> optax.GradientTransformation:
+    """torch ``Adagrad``'s exact scaling: ``sum += g²; g / (sqrt(sum) + eps)``.
+
+    optax's ``scale_by_rss`` differs in two observable ways (eps inside the
+    sqrt; a where() that zeroes updates while the accumulator is zero), so the
+    torch update is implemented directly. State mirrors the param-tree paths
+    like Adam's moments, so the ZeRO sharding rules apply unchanged.
+    """
+
+    def init_fn(params):
+        return _AdagradState(
+            sum_of_squares=jax.tree.map(
+                lambda p: jnp.full_like(p, initial_accumulator_value), params
+            )
+        )
+
+    def update_fn(updates, state, params=None):
+        del params
+        sums = jax.tree.map(
+            lambda g, s: s + jnp.square(g), updates, state.sum_of_squares
+        )
+        updates = jax.tree.map(
+            lambda g, s: g / (jnp.sqrt(s) + eps), updates, sums
+        )
+        return updates, _AdagradState(sum_of_squares=sums)
+
+    return optax.GradientTransformation(init_fn, update_fn)
 
 
 def make_optimizer(
@@ -101,19 +153,47 @@ def make_optimizer(
         schedule = optax.constant_schedule(config.learning_rate)
 
     name = config.optimizer
+    # coupled L2 (torch's default weight_decay semantics for everything but
+    # AdamW): grad += wd * param BEFORE any moment/accumulator update
+    coupled_wd = (
+        [optax.add_decayed_weights(config.weight_decay)]
+        if config.weight_decay
+        else []
+    )
     if name == "Adam":
-        chain = []
-        if config.weight_decay:
-            chain.append(optax.add_decayed_weights(config.weight_decay))
-        chain += [
+        tx = optax.chain(
+            *coupled_wd,
             optax.scale_by_adam(),
             optax.scale_by_learning_rate(schedule),
-        ]
-        tx = optax.chain(*chain)
+        )
     elif name == "AdamW":
         tx = optax.adamw(schedule, weight_decay=config.weight_decay)
+    elif name == "SGD":
+        momentum = (
+            [optax.trace(decay=config.momentum)] if config.momentum else []
+        )
+        tx = optax.chain(
+            *coupled_wd, *momentum, optax.scale_by_learning_rate(schedule)
+        )
+    elif name == "RMSprop":
+        # torch defaults: alpha=0.99, eps=1e-8, eps OUTSIDE the sqrt
+        tx = optax.chain(
+            *coupled_wd,
+            optax.scale_by_rms(decay=0.99, eps=1e-8, eps_in_sqrt=False),
+            optax.scale_by_learning_rate(schedule),
+        )
+    elif name == "Adagrad":
+        tx = optax.chain(
+            *coupled_wd,
+            _scale_by_adagrad_torch(),
+            optax.scale_by_learning_rate(schedule),
+        )
     else:
-        raise ValueError(f"unknown optimizer {name!r} (expected 'Adam' or 'AdamW')")
+        raise ValueError(
+            f"unknown optimizer {name!r} (expected one of 'Adam', 'AdamW', "
+            f"'SGD', 'RMSprop', 'Adagrad' — the torch.optim names the "
+            f"reference CLI accepts)"
+        )
 
     if config.grad_clip_norm is not None:
         if config.grad_clip_norm <= 0:

@@ -17,7 +17,7 @@ def test_one_cycle_requires_max_steps():
 
 def test_unknown_optimizer():
     with pytest.raises(ValueError, match="unknown optimizer"):
-        make_optimizer(OptimizerConfig(optimizer="SGD"))
+        make_optimizer(OptimizerConfig(optimizer="LBFGS"))
 
 
 def test_one_cycle_schedule_matches_torch():
@@ -88,6 +88,52 @@ def test_adamw_matches_torch(rng, wd):
     ours = _run_optax(tx, w0, grads)
     theirs = _run_torch(torch.optim.AdamW, w0, grads, lr=1e-2, weight_decay=wd)
     np.testing.assert_allclose(ours[-1], theirs[-1], rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("wd", [0.0, 0.1])
+@pytest.mark.parametrize("momentum", [0.0, 0.9])
+def test_sgd_matches_torch(rng, wd, momentum):
+    """'SGD' incl. momentum-buffer semantics (buf = m·buf + g, step lr·buf)
+    and coupled L2 weight decay — reference lightning.py:60 getattr surface."""
+    w0 = rng.standard_normal(16).astype(np.float32)
+    grads = [rng.standard_normal(16).astype(np.float32) for _ in range(10)]
+    tx, _ = make_optimizer(
+        OptimizerConfig(optimizer="SGD", learning_rate=1e-2, weight_decay=wd,
+                        momentum=momentum)
+    )
+    ours = _run_optax(tx, w0, grads)
+    theirs = _run_torch(torch.optim.SGD, w0, grads, lr=1e-2, weight_decay=wd,
+                        momentum=momentum)
+    np.testing.assert_allclose(ours[-1], theirs[-1], rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.parametrize("wd", [0.0, 0.1])
+def test_rmsprop_matches_torch(rng, wd):
+    """'RMSprop' with torch defaults (alpha 0.99, eps 1e-8 outside the sqrt)."""
+    w0 = rng.standard_normal(16).astype(np.float32)
+    grads = [rng.standard_normal(16).astype(np.float32) for _ in range(10)]
+    tx, _ = make_optimizer(
+        OptimizerConfig(optimizer="RMSprop", learning_rate=1e-2, weight_decay=wd)
+    )
+    ours = _run_optax(tx, w0, grads)
+    theirs = _run_torch(torch.optim.RMSprop, w0, grads, lr=1e-2, weight_decay=wd)
+    np.testing.assert_allclose(ours[-1], theirs[-1], rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("wd", [0.0, 0.1])
+def test_adagrad_matches_torch(rng, wd):
+    """'Adagrad' with torch defaults (eps 1e-10 outside the sqrt, zero
+    initial accumulator) — incl. the first step, where optax's scale_by_rss
+    would diverge from torch."""
+    w0 = rng.standard_normal(16).astype(np.float32)
+    grads = [rng.standard_normal(16).astype(np.float32) for _ in range(10)]
+    tx, _ = make_optimizer(
+        OptimizerConfig(optimizer="Adagrad", learning_rate=1e-2, weight_decay=wd)
+    )
+    ours = _run_optax(tx, w0, grads)
+    theirs = _run_torch(torch.optim.Adagrad, w0, grads, lr=1e-2, weight_decay=wd)
+    for step_ours, step_theirs in zip(ours, theirs):
+        np.testing.assert_allclose(step_ours, step_theirs, rtol=1e-5, atol=1e-7)
 
 
 def test_constant_schedule_without_one_cycle():

@@ -33,7 +33,8 @@ def _import_ckpt(args: argparse.Namespace) -> None:
     )
 
     params, hparams = import_lightning_checkpoint(
-        args.checkpoint, encoder_only=args.encoder_only
+        args.checkpoint, encoder_only=args.encoder_only,
+        allow_unsafe_pickle=args.unsafe_load,
     )
     import jax
 
@@ -76,6 +77,11 @@ def main(argv=None) -> None:
                         help="Orbax checkpoint directory to write")
     p_ckpt.add_argument("--encoder-only", action="store_true",
                         help="import only the encoder subtree (transfer)")
+    p_ckpt.add_argument("--unsafe_load", action="store_true",
+                        help="fall back to torch's unrestricted pickle loader "
+                             "when the safe weights-only loader rejects the "
+                             "file (executes code embedded in the artifact — "
+                             "only for checkpoints you trust)")
     p_ckpt.set_defaults(fn=_import_ckpt)
 
     p_tok = sub.add_parser("tokenizer", help="import/convert an HF tokenizers JSON")
