@@ -200,6 +200,28 @@ def fused_linear_cross_entropy_with_ignore(
     return jnp.where(valid, per_pos, 0.0).sum() / denom
 
 
+def pallas_linear_cross_entropy_with_ignore(
+    features: Array,
+    kernel: Array,
+    bias: Array,
+    labels: Array,
+    ignore_label: int = IGNORE_LABEL,
+) -> Array:
+    """:func:`fused_linear_cross_entropy_with_ignore` semantics on the fused
+    Pallas flash-CE kernel (``ops.pallas_ce``): head matmul + online-logsumexp
+    CE in one kernel, logits never in HBM, forward or backward. The measured
+    winner at the flagship MLM head shapes (PERF.md round 3) — unlike the XLA
+    chunked variant, the vocab loop is a sequential grid inside ONE kernel
+    rather than a scan of dispatches."""
+    from perceiver_io_tpu.ops.pallas_ce import pallas_linear_ce_integer
+
+    valid = labels != ignore_label
+    safe_labels = jnp.where(valid, labels, 0)
+    per_pos = pallas_linear_ce_integer(features, kernel, bias, safe_labels)
+    denom = jnp.maximum(valid.sum(), 1)
+    return jnp.where(valid, per_pos, 0.0).sum() / denom
+
+
 def cross_entropy_with_ignore(
     logits: Array, labels: Array, ignore_label: int = IGNORE_LABEL
 ) -> Array:

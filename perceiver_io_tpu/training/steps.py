@@ -28,6 +28,7 @@ from perceiver_io_tpu.training.losses import (
     classification_loss_and_accuracy,
     cross_entropy_with_ignore,
     fused_linear_cross_entropy_with_ignore,
+    pallas_linear_cross_entropy_with_ignore,
 )
 from perceiver_io_tpu.training.train_state import TrainState
 
@@ -100,7 +101,7 @@ def make_mlm_steps(
     model,
     schedule: Optional[Schedule] = None,
     loss_gather_capacity: Optional[int] = None,
-    fused_head: bool = False,
+    fused_head: bool | str = False,
 ):
     """(train_step, eval_step, predict_fn) for a ``PerceiverMLM``.
 
@@ -116,16 +117,27 @@ def make_mlm_steps(
     most of the dominant vocab-projection FLOPs (see ``PerceiverMLM``). The
     predict path always decodes every position.
 
-    ``fused_head``: fuse the vocab projection into a chunked CE
-    (``fused_linear_cross_entropy_with_ignore``) so the (B, K, V) logits
-    never materialize in train/eval. A MEMORY lever, not a speed one:
-    on the flagship config it measured slower at every chunk size (PERF.md —
-    the unfused head ops already stream near HBM peak and overlap with the
-    latent stack, while the chunk scan serializes), so it stays opt-in for
-    configurations where the logits tensor itself is the memory wall
-    (very long full decodes / huge vocabs). Gradient-equivalent to the
-    unfused path (tested); predict is unaffected.
+    ``fused_head``: fuse the vocab projection into the CE so the (B, K, V)
+    logits never materialize in train/eval.
+
+    - ``'pallas'``: the fused flash-CE kernel (``ops.pallas_ce``) — matmul +
+      online-logsumexp + label pick inside ONE ``pallas_call``, gradients by
+      blockwise recomputation. The measured WINNER at the flagship MLM head
+      shapes (PERF.md round 3: the unfused head complex streams the 206 MB
+      logits tensor ~5x at HBM peak, ~1.4 ms of a 10.4 ms step).
+    - ``True``: the XLA chunked variant
+      (``fused_linear_cross_entropy_with_ignore``) — a MEMORY lever only; on
+      the flagship config it measured slower at every chunk size (PERF.md
+      negative result #7: the chunk scan serializes 10-20 skinny dispatches).
+      Kept for environments where the Pallas path is unavailable.
+
+    Both are gradient-equivalent to the unfused path (tested); predict is
+    unaffected.
     """
+    if fused_head not in (False, True, "pallas"):
+        raise ValueError(
+            f"fused_head must be False, True or 'pallas', got {fused_head!r}"
+        )
 
     def loss_fn(params, batch, rngs, deterministic):
         out, labels = model.apply(
@@ -135,16 +147,19 @@ def make_mlm_steps(
             rngs=rngs,
             deterministic=deterministic,
             loss_gather_capacity=loss_gather_capacity,
-            return_features=fused_head,
+            return_features=bool(fused_head),
         )
         if fused_head:
             # the adapter owns the head layout + class-padding scheme
             kernel, bias = model.decoder.output_adapter.masked_head(
                 params["decoder"]["output_adapter"]
             )
-            return fused_linear_cross_entropy_with_ignore(
-                out, kernel, bias, labels
+            fused_ce = (
+                pallas_linear_cross_entropy_with_ignore
+                if fused_head == "pallas"
+                else fused_linear_cross_entropy_with_ignore
             )
+            return fused_ce(out, kernel, bias, labels)
         return cross_entropy_with_ignore(out, labels)
 
     def train_step(state: TrainState, batch) -> Tuple[TrainState, Metrics]:

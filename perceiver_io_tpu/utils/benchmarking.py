@@ -62,3 +62,42 @@ def time_train_step(
 
     samples = sorted(one_window() for _ in range(max(windows, 1)))
     return samples[len(samples) // 2], state
+
+
+def time_train_step_device(
+    train_step, state, batch, steps: int, jitted=None, trace_dir=None
+) -> Tuple[float, int, object]:
+    """DEVICE-measured seconds/step via a ``jax.profiler`` trace.
+
+    The host-clock recipe above is honest but still rides the tunnel: its
+    number moves with session-to-session tunnel throughput (PERF.md documents
+    ±2x swings). The device trace records each step's hardware duration on
+    the TPU itself, so this measurement is tunnel-insensitive — it is the
+    basis of the headline metric (``bench.py``), with the host clock kept as
+    the fallback for backends whose traces lack a TPU plane.
+
+    Returns ``(seconds_per_step, n_steps_used, final_state)``. Raises on
+    backends/toolchains where the trace cannot be captured or parsed
+    (caller falls back to :func:`time_train_step`).
+    """
+    import tempfile
+
+    from perceiver_io_tpu.utils.xplane import device_step_seconds
+
+    step = jitted if jitted is not None else jax.jit(train_step, donate_argnums=(0,))
+    for _ in range(3):
+        state, metrics = step(state, batch)
+    float(metrics["loss"])  # sync before the trace window opens
+
+    if trace_dir is None:
+        trace_dir = tempfile.mkdtemp(prefix="pit_bench_trace_")
+    jax.profiler.start_trace(trace_dir)
+    try:
+        for _ in range(steps):
+            state, metrics = step(state, batch)
+        float(metrics["loss"])  # device sync INSIDE the trace window
+    finally:
+        jax.profiler.stop_trace()
+
+    seconds, n_used = device_step_seconds(trace_dir)
+    return seconds, n_used, state

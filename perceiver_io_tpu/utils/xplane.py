@@ -1,0 +1,75 @@
+"""Minimal xplane (jax.profiler trace) reader for DEVICE-measured step time.
+
+The tunneled PJRT backend this dev environment uses makes host-side timing
+unreliable (PERF.md: ``block_until_ready`` lies ~10x, scalar fetches cost a
+~100 ms round trip, and the tunnel's throughput swings ±2x between sessions).
+The device trace is the one clock the tunnel cannot distort: the TPU itself
+records each step's start/duration, and this module extracts them.
+
+Used by ``bench.py`` (the headline metric rides the device clock, VERDICT r2
+item 2) and ``tools/hbm_roofline.py`` (roofline analysis on the same trace).
+
+Requires the tensorflow protobufs for xplane decoding (baked into this image);
+callers should catch ImportError/RuntimeError and fall back to host timing.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import List, Tuple
+
+
+def load_tpu_plane(trace_dir: str):
+    """The first TPU device plane of the newest xplane.pb under trace_dir."""
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(
+        os.path.join(trace_dir, "plugins", "profile", "*", "*.xplane.pb")
+    )
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {trace_dir}")
+    xs = xplane_pb2.XSpace()
+    with open(sorted(paths)[-1], "rb") as f:
+        xs.ParseFromString(f.read())
+    tpu_planes = [p for p in xs.planes if "/device:TPU" in p.name and p.lines]
+    if not tpu_planes:
+        raise RuntimeError("no TPU device plane in trace (ran on CPU?)")
+    return tpu_planes[0]
+
+
+def step_windows(plane) -> List[Tuple[int, int]]:
+    """(start_ps, end_ps) per step from the plane's Steps line."""
+    step_lines = [l for l in plane.lines if l.name == "Steps"]
+    if not step_lines:
+        raise RuntimeError("trace has no Steps line")
+    return [
+        (e.offset_ps, e.offset_ps + e.duration_ps)
+        for e in step_lines[0].events
+    ]
+
+
+def device_step_seconds(trace_dir: str, skip_first: int = 2) -> Tuple[float, int]:
+    """Device-measured seconds/step: the LOWER QUARTILE of per-step durations.
+
+    On a time-shared chip the per-step distribution is (true program
+    duration) + (occasional co-tenant interference): measured on the bench
+    step, ~half the steps land in a ±0.1% cluster at the true duration and
+    the rest are inflated up to ~1.7x by contention (PERF.md round 3). The
+    mean/median move with whoever else is on the chip; the lower quartile
+    sits inside the tight cluster and reproduces across sessions — it is the
+    program's capability on this chip, which is what the headline metric
+    claims.
+
+    ``skip_first`` leading steps are dropped (warm caches / first-dispatch
+    effects) when enough remain. Returns ``(seconds_per_step, n_steps_used)``.
+    """
+    windows = step_windows(load_tpu_plane(trace_dir))
+    if len(windows) > skip_first + 2:
+        windows = windows[skip_first:]
+    if not windows:
+        raise RuntimeError("trace recorded zero steps")
+    durations = sorted(b - a for a, b in windows)
+    lower_quartile = durations[len(durations) // 4]
+    return lower_quartile / 1e12, len(durations)

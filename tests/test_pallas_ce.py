@@ -1,0 +1,156 @@
+"""Fused flash-CE Pallas kernel (ops/pallas_ce.py): exactness vs the unfused
+XLA path, gradients, vocab padding, ignore-label semantics, and the MLM
+fused_head='pallas' integration. Runs in interpreter mode on the CPU
+conftest; the compiled path is exercised on hardware by bench.py (its
+default head) and tools/tpu_pallas_spmd_check.py."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from perceiver_io_tpu.ops.pallas_ce import pallas_linear_ce_integer
+from perceiver_io_tpu.training.losses import (
+    cross_entropy_with_ignore,
+    pallas_linear_cross_entropy_with_ignore,
+    softmax_ce_integer,
+)
+
+
+def _setup(rng, B=2, K=24, C=16, V=275):
+    x = jnp.asarray(rng.normal(0, 1, (B, K, C)).astype(np.float32))
+    w = jnp.asarray(rng.normal(0, 0.1, (C, V)).astype(np.float32))
+    b = jnp.asarray(rng.normal(0, 0.1, V).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, V, (B, K)).astype(np.int32))
+    return x, w, b, labels
+
+
+class TestPallasLinearCE:
+    @pytest.mark.parametrize("v_blk", [128, 512])
+    def test_matches_unfused_with_grads(self, rng, v_blk):
+        """Loss and all three gradients vs logits-materializing XLA CE —
+        incl. a vocab (275) that forces kernel-side padding at v_blk=128."""
+        x, w, b, labels = _setup(rng)
+
+        def ref(x, w, b):
+            return softmax_ce_integer(x @ w + b, labels).sum()
+
+        def ker(x, w, b):
+            return pallas_linear_ce_integer(
+                x, w, b, labels, v_block_size=v_blk
+            ).sum()
+
+        ref_l, ref_g = jax.value_and_grad(ref, argnums=(0, 1, 2))(x, w, b)
+        ker_l, ker_g = jax.value_and_grad(ker, argnums=(0, 1, 2))(x, w, b)
+        np.testing.assert_allclose(float(ker_l), float(ref_l), rtol=1e-5)
+        for name, got, want in zip("x w b".split(), ker_g, ref_g):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=2e-4,
+                err_msg=f"d{name} mismatch",
+            )
+
+    def test_single_block_vocab(self, rng):
+        """V smaller than the block size → one full-dim block."""
+        x, w, b, labels = _setup(rng, V=64)
+        ref = softmax_ce_integer(x @ w + b, labels)
+        got = pallas_linear_ce_integer(x, w, b, labels)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-5)
+
+    def test_bf16_features(self, rng):
+        """bf16 compute path: kernel loss tracks the bf16 XLA loss."""
+        x, w, b, labels = _setup(rng)
+        xb = x.astype(jnp.bfloat16)
+        ref = softmax_ce_integer(xb @ w.astype(jnp.bfloat16) + b.astype(jnp.bfloat16), labels)
+        got = pallas_linear_ce_integer(xb, w, b, labels, v_block_size=128)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=3e-2, atol=3e-2
+        )
+
+    def test_ignore_label_semantics(self, rng):
+        """The with-ignore wrapper == cross_entropy_with_ignore on the
+        materialized logits, incl. zero grads for ignored rows."""
+        x, w, b, labels = _setup(rng)
+        labels = labels.at[0, :7].set(-100)
+
+        def ref(x):
+            return cross_entropy_with_ignore(x @ w + b, labels)
+
+        def ker(x):
+            return pallas_linear_cross_entropy_with_ignore(x, w, b, labels)
+
+        ref_l, ref_g = jax.value_and_grad(ref)(x)
+        ker_l, ker_g = jax.value_and_grad(ker)(x)
+        np.testing.assert_allclose(float(ker_l), float(ref_l), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(ker_g), np.asarray(ref_g), atol=2e-5)
+        # ignored rows get exactly zero feature gradient
+        np.testing.assert_allclose(np.asarray(ker_g)[0, :7], 0.0, atol=0)
+
+    def test_shape_validation(self, rng):
+        x, w, b, labels = _setup(rng)
+        with pytest.raises(ValueError, match="disagree"):
+            pallas_linear_ce_integer(x, w, b, labels[:, :3])
+        with pytest.raises(ValueError, match="does not match"):
+            pallas_linear_ce_integer(x, w[:, :-1], b, labels)
+
+
+class TestMLMFusedHeadPallas:
+    def test_train_step_matches_unfused(self, rng):
+        """fused_head='pallas' must reproduce the unfused loss trajectory
+        (gradient equivalence through Adam updates)."""
+        import perceiver_io_tpu as pit
+        from perceiver_io_tpu.ops.masking import TextMasking
+        from perceiver_io_tpu.training import (
+            OptimizerConfig,
+            TrainState,
+            make_mlm_steps,
+            make_optimizer,
+        )
+
+        VOCAB, L, C, NLAT = 50, 32, 64, 16
+        enc = pit.PerceiverEncoder(
+            input_adapter=pit.TextInputAdapter(
+                vocab_size=VOCAB, max_seq_len=L, num_channels=C),
+            latent_shape=(NLAT, C), num_layers=2,
+        )
+        dec = pit.PerceiverDecoder(
+            output_adapter=pit.TextOutputAdapter(
+                vocab_size=VOCAB, max_seq_len=L, num_output_channels=C),
+            latent_shape=(NLAT, C),
+        )
+        model = pit.PerceiverMLM(
+            encoder=enc, decoder=dec, masking=TextMasking(VOCAB, 1, 2, 3)
+        )
+        rng_np = np.random.default_rng(0)
+        batch = {
+            "token_ids": jnp.asarray(
+                rng_np.integers(3, VOCAB, (8, L)).astype(np.int32)),
+            "pad_mask": jnp.zeros((8, L), dtype=bool),
+        }
+        variables = model.init(
+            {"params": jax.random.key(0), "masking": jax.random.key(1)},
+            batch["token_ids"], batch["pad_mask"],
+        )
+        tx, sched = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+
+        def run(fused):
+            step, _, _ = make_mlm_steps(
+                model, sched, loss_gather_capacity=16, fused_head=fused
+            )
+            state = TrainState.create(
+                jax.tree.map(jnp.copy, variables["params"]), tx,
+                jax.random.key(2),
+            )
+            jitted = jax.jit(step)
+            losses = []
+            for _ in range(3):
+                state, m = jitted(state, batch)
+                losses.append(float(m["loss"]))
+            return losses
+
+        np.testing.assert_allclose(run("pallas"), run(False), atol=2e-5)
+
+    def test_invalid_fused_head_rejected(self):
+        from perceiver_io_tpu.training import make_mlm_steps
+
+        with pytest.raises(ValueError, match="fused_head"):
+            make_mlm_steps(object(), fused_head="nope")

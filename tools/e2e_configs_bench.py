@@ -34,11 +34,16 @@ from perceiver_io_tpu.training import (
 
 STEPS = int(os.environ.get("PIT_BENCH_STEPS", "10"))
 DTYPE = jnp.bfloat16
+# Force one attention impl across every config (e.g. 'xla' so XLA cost
+# analysis sees ALL the flops — Pallas custom-calls count zero there; see
+# tools/hbm_roofline.py's MFU method). Default: each config's own choice.
+ATTN_IMPL = os.environ.get("PIT_E2E_ATTN")
 rng = np.random.default_rng(0)
 
 
 def _image_classifier(image_shape, num_classes, latents, channels, blocks,
                       cross_heads, self_heads, bands):
+    attn = ATTN_IMPL or "auto"
     return pit.PerceiverIO(
         encoder=pit.PerceiverEncoder(
             input_adapter=pit.ImageInputAdapter(
@@ -50,6 +55,7 @@ def _image_classifier(image_shape, num_classes, latents, channels, blocks,
             num_self_attention_heads=self_heads,
             num_self_attention_layers_per_block=blocks,
             dtype=DTYPE,
+            attn_impl=attn,
         ),
         decoder=pit.PerceiverDecoder(
             output_adapter=pit.ClassificationOutputAdapter(
@@ -58,17 +64,21 @@ def _image_classifier(image_shape, num_classes, latents, channels, blocks,
             latent_shape=(latents, channels),
             num_cross_attention_heads=cross_heads,
             dtype=DTYPE,
+            attn_impl=attn,
         ),
     )
 
 
 def config_mlm():
     """Flagship IMDB MLM (512 seq, 256x64 latents, 3x6 layers, batch 64).
-    Matches bench.py's default env knobs (attn_impl='xla', gather decode)."""
+    Matches bench.py's defaults (attn_impl='xla', gather decode, fused
+    flash-CE head on TPU). PIT_E2E_HEAD overrides the head
+    ('pallas'|'xla'|'none' — 'none' also feeds hbm_roofline's MFU-numerator
+    build, where cost analysis must see the head's flops)."""
     from perceiver_io_tpu.models.presets import flagship_mlm
 
     vocab, seq, b = 10003, 512, 64
-    model = flagship_mlm(dtype=DTYPE, attn_impl="xla")
+    model = flagship_mlm(dtype=DTYPE, attn_impl=ATTN_IMPL or "xla")
     batch = {
         "token_ids": jnp.asarray(rng.integers(3, vocab, (b, seq)).astype(np.int32)),
         "pad_mask": jnp.zeros((b, seq), bool),
@@ -77,8 +87,13 @@ def config_mlm():
         {"params": jax.random.key(0), "masking": jax.random.key(1)},
         batch["token_ids"], batch["pad_mask"],
     )
+    head = os.environ.get(
+        "PIT_E2E_HEAD", "pallas" if jax.default_backend() == "tpu" else "none"
+    )
+    fused_head = {"pallas": "pallas", "xla": True, "none": False}[head]
     train_step, _, _ = make_mlm_steps(
-        model, loss_gather_capacity=mlm_gather_capacity(seq)
+        model, loss_gather_capacity=mlm_gather_capacity(seq),
+        fused_head=fused_head,
     )
     return variables, train_step, batch, b
 
@@ -122,8 +137,8 @@ def config_flow():
     """Sintel optical flow (368x496, 2048x512 latents, dense 2D queries)."""
     from perceiver_io_tpu.models.flow import build_optical_flow_model
 
-    b = 1
-    model = build_optical_flow_model(dtype=DTYPE)
+    b = int(os.environ.get("PIT_FLOW_BATCH", "1"))
+    model = build_optical_flow_model(dtype=DTYPE, attn_impl=ATTN_IMPL or "auto")
     batch = {
         "frames": jnp.asarray(rng.normal(0, 1, (b, 2, 368, 496, 3)), jnp.float32),
         "flow": jnp.asarray(rng.normal(0, 1, (b, 368, 496, 2)), jnp.float32),
@@ -140,7 +155,8 @@ def config_multimodal():
     b = 2
     video_shape = (16, 224, 224, 3)
     model = build_multimodal_autoencoder(
-        video_shape=video_shape, num_audio_samples=30720, dtype=DTYPE, remat=True
+        video_shape=video_shape, num_audio_samples=30720, dtype=DTYPE,
+        remat=True, attn_impl=ATTN_IMPL or "auto",
     )
     batch = {
         "video": jnp.asarray(rng.normal(0, 1, (b, *video_shape)), jnp.float32),
