@@ -147,6 +147,53 @@ def add_imdb_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--synthetic_size", type=int, default=2048)
     g.add_argument("--no_download", action="store_true",
                    help="fail fast if data is absent instead of fetching it")
+    g.add_argument("--bucket_widths", type=int, nargs="+", default=None,
+                   help="pad each batch to the smallest of these sequence "
+                        "widths that fits it (SPMD-safe bucketed padding — "
+                        "the reference's pad-to-longest without dynamic "
+                        "shapes; one cached compile per width). Combine with "
+                        "--length_sort_window. Incompatible with "
+                        "--steps_per_dispatch > 1 (stacked dispatch windows "
+                        "need one width) and with multi-host runs (per-host "
+                        "collation would pick inconsistent widths)")
+    g.add_argument("--length_sort_window", type=int, default=8,
+                   help="with --bucket_widths: sort examples by length within "
+                        "windows of this many batches so batches are "
+                        "length-homogeneous (batch order re-shuffled inside "
+                        "the window; 0 = off)")
+
+
+def validate_bucket_args(args) -> None:
+    """Cross-flag constraints for bucketed-width batches."""
+    widths = getattr(args, "bucket_widths", None)
+    if not widths:
+        return
+    import jax
+
+    if jax.process_count() > 1:
+        # each host collates only its shard, and the length-sorted slices
+        # give hosts DIFFERENT max lengths for the same global batch — they
+        # would pick different widths and deadlock global-array assembly.
+        # A globally-consistent width needs the collator to see the global
+        # batch's lengths; until then, fail loudly instead.
+        raise SystemExit(
+            "--bucket_widths is not supported in multi-host runs: per-host "
+            "collation would pick inconsistent widths for the same global "
+            "batch"
+        )
+    if getattr(args, "steps_per_dispatch", 1) > 1:
+        raise SystemExit(
+            "--bucket_widths is incompatible with --steps_per_dispatch > 1: "
+            "a stacked dispatch window cannot mix sequence widths"
+        )
+    if getattr(args, "shard_seq", False):
+        sp = getattr(args, "sp", 1)
+        bad = [w for w in widths if w % sp]
+        if bad:
+            raise SystemExit(
+                f"--bucket_widths {bad} not divisible by --sp {sp} "
+                f"(seq-sharded batches need width % sp == 0)"
+            )
 
 
 def add_mnist_args(parser: argparse.ArgumentParser) -> None:

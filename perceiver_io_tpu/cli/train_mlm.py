@@ -96,6 +96,8 @@ def make_predict_hook(predict_fn, collator, samples: Sequence[str], k: int):
 def main(argv: Optional[Sequence[str]] = None):
     args = common.parse_with_resume(build_parser(), argv)
     common.maybe_initialize_distributed(args)
+    # after distributed init: the multi-host guard reads jax.process_count()
+    common.validate_bucket_args(args)
 
     data = IMDBDataModule(
         root=args.root,
@@ -108,6 +110,8 @@ def main(argv: Optional[Sequence[str]] = None):
         shard_id=jax.process_index(),
         num_shards=jax.process_count(),
         download=not args.no_download,
+        bucket_widths=args.bucket_widths,
+        length_sort_window=args.length_sort_window,
     )
     data.prepare_data()
     data.setup()

@@ -159,6 +159,7 @@ def main(argv: Optional[Sequence[str]] = None):
             if key in hparams:
                 setattr(args, key, hparams[key])
 
+    common.validate_bucket_args(args)
     data = IMDBDataModule(
         root=args.root,
         max_seq_len=args.max_seq_len,
@@ -170,6 +171,8 @@ def main(argv: Optional[Sequence[str]] = None):
         shard_id=jax.process_index(),
         num_shards=jax.process_count(),
         download=not args.no_download,
+        bucket_widths=args.bucket_widths,
+        length_sort_window=args.length_sort_window,
     )
     data.prepare_data()
     data.setup()

@@ -108,12 +108,42 @@ class IMDBDataset:
 
 class Collator:
     """Pad/truncate to ``max_seq_len``; emit labels, ids and pad mask
-    (reference ``data/imdb.py:52-68`` contract, dict-of-arrays form)."""
+    (reference ``data/imdb.py:52-68`` contract, dict-of-arrays form).
 
-    def __init__(self, tokenizer: WordPieceTokenizer, max_seq_len: int):
+    ``bucket_widths``: optional sorted set of sequence widths — each batch is
+    padded to the SMALLEST bucket that fits its longest (truncated) sequence
+    instead of always to ``max_seq_len``. This is the SPMD-safe version of
+    the reference's pad-to-longest (``enable_padding``, reference
+    ``data/imdb.py:56-57``): shapes stay static per bucket (one compiled
+    executable each — 2-3 compiles, cached), while short batches skip most of
+    the padded-token work. Pair with the loader's length-sorted windows
+    (``DataLoader(sort_key=..., sort_window=...)``) so batches are
+    length-homogeneous and actually land in small buckets — under plain
+    shuffling the per-batch MAX length is near the cap almost always.
+    ``max_seq_len`` is always included as the final bucket.
+    """
+
+    def __init__(
+        self,
+        tokenizer: WordPieceTokenizer,
+        max_seq_len: int,
+        bucket_widths: Optional[Sequence[int]] = None,
+    ):
         self.tokenizer = tokenizer
         self.max_seq_len = max_seq_len
         self.pad_id = tokenizer.token_to_id(PAD_TOKEN)
+        if bucket_widths:
+            widths = sorted({int(w) for w in bucket_widths})
+            if widths[0] <= 0 or widths[-1] > max_seq_len:
+                raise ValueError(
+                    f"bucket_widths must lie in [1, max_seq_len={max_seq_len}], "
+                    f"got {widths}"
+                )
+            if widths[-1] != max_seq_len:
+                widths.append(max_seq_len)
+            self.bucket_widths: Optional[List[int]] = widths
+        else:
+            self.bucket_widths = None
         # truncation only: collate writes ids into a pre-filled pad_id array,
         # so tokenizer-level padding would be duplicated work on the hot path
         tokenizer.enable_truncation(max_seq_len)
@@ -122,9 +152,13 @@ class Collator:
         labels = np.asarray([y for y, _ in batch], dtype=np.int32)
         encoded = self.tokenizer.encode_batch([x for _, x in batch])
         width = self.max_seq_len  # static width: SPMD-friendly, no recompiles
+        if self.bucket_widths is not None:
+            longest = max((len(e) for e in encoded), default=1)
+            longest = min(max(longest, 1), self.max_seq_len)
+            width = next(w for w in self.bucket_widths if w >= longest)
         ids = np.full((len(batch), width), self.pad_id, dtype=np.int32)
         for i, e in enumerate(encoded):
-            ids[i, : len(e)] = e[:width]
+            ids[i, : min(len(e), width)] = e[:width]
         pad_mask = ids == self.pad_id
         return {"label": labels, "token_ids": ids, "pad_mask": pad_mask}
 
@@ -150,6 +184,8 @@ class IMDBDataModule:
         shard_id: int = 0,
         num_shards: int = 1,
         download: bool = True,
+        bucket_widths: Optional[Sequence[int]] = None,
+        length_sort_window: int = 8,
     ):
         self.root = root
         self.download = download
@@ -161,6 +197,19 @@ class IMDBDataModule:
         self.seed = seed
         self.shard_id = shard_id
         self.num_shards = num_shards
+        # width buckets (see Collator) + the loader-side length grouping that
+        # makes them effective; the sort window only applies when buckets are
+        # on, so the default path is byte-identical to previous rounds
+        if bucket_widths and num_shards > 1:
+            # each host collates only its shard of the (length-sorted)
+            # global batch — hosts would pick different widths for the same
+            # step and deadlock global-array assembly
+            raise ValueError(
+                "bucket_widths is not supported with num_shards > 1: "
+                "per-host collation picks inconsistent widths"
+            )
+        self.bucket_widths = bucket_widths
+        self.length_sort_window = length_sort_window
 
         suffix = "synthetic-" if synthetic else ""
         self.tokenizer_path = os.path.join(root, f"imdb-{suffix}tokenizer-{vocab_size}.json")
@@ -177,6 +226,8 @@ class IMDBDataModule:
             vocab_size=args.vocab_size,
             batch_size=args.batch_size,
             synthetic=getattr(args, "synthetic", False),
+            bucket_widths=getattr(args, "bucket_widths", None),
+            length_sort_window=getattr(args, "length_sort_window", 8),
         )
 
     def _train_texts(self) -> Tuple[List[str], List[int]]:
@@ -216,11 +267,20 @@ class IMDBDataModule:
 
     def setup(self):
         self.tokenizer = load_tokenizer(self.tokenizer_path)
-        self.collator = Collator(self.tokenizer, self.max_seq_len)
+        self.collator = Collator(
+            self.tokenizer, self.max_seq_len, bucket_widths=self.bucket_widths
+        )
         self.ds_train = IMDBDataset(*self._train_texts())
         self.ds_valid = IMDBDataset(*self._valid_texts())
 
     def train_dataloader(self) -> DataLoader:
+        sort_key = None
+        sort_window = 0
+        if self.bucket_widths:
+            # character count ~ token count: good enough to group lengths
+            # without tokenizing the corpus up front
+            sort_key = np.asarray([len(t) for t in self.ds_train.texts])
+            sort_window = self.length_sort_window
         return DataLoader(
             self.ds_train,
             batch_size=self.batch_size,
@@ -229,6 +289,8 @@ class IMDBDataModule:
             seed=self.seed,
             shard_id=self.shard_id,
             num_shards=self.num_shards,
+            sort_key=sort_key,
+            sort_window=sort_window,
         )
 
     def val_dataloader(self) -> DataLoader:

@@ -54,9 +54,17 @@ class DataLoader:
         num_shards: int = 1,
         prefetch: int = 2,
         num_workers: int = 0,
+        sort_key: Optional[np.ndarray] = None,
+        sort_window: int = 0,
     ):
         if not (0 <= shard_id < num_shards):
             raise ValueError(f"shard_id {shard_id} out of range for {num_shards} shards")
+        if sort_window and sort_key is None:
+            raise ValueError("sort_window requires a sort_key array")
+        if sort_key is not None and len(sort_key) != len(dataset):
+            raise ValueError(
+                f"sort_key length {len(sort_key)} != dataset size {len(dataset)}"
+            )
         if batch_size % num_shards != 0:
             raise ValueError(
                 f"global batch_size {batch_size} not divisible by num_shards {num_shards}"
@@ -79,6 +87,18 @@ class DataLoader:
         # PIL/numpy release the GIL in the hot parts, and threads share the
         # dataset's page cache / mmap state for free.
         self.num_workers = num_workers
+        # Length-grouped batching: within each window of ``sort_window``
+        # batches of the shuffled order, examples are sorted by ``sort_key``
+        # (e.g. text length) so batches become length-homogeneous — the
+        # enabler for the collator-side width buckets (short batches land in
+        # small buckets instead of being dragged to the cap by one long
+        # example). Batch ORDER within the window is re-shuffled so training
+        # sees no short-to-long curriculum; the window bounds how far
+        # examples can migrate, preserving shuffle quality. Deterministic in
+        # (seed, epoch) and applied to the GLOBAL order before host sharding,
+        # so multi-host stays consistent.
+        self.sort_key = None if sort_key is None else np.asarray(sort_key)
+        self.sort_window = sort_window
         self.epoch = 0
         self._skip = 0
 
@@ -92,8 +112,31 @@ class DataLoader:
         n = len(self.dataset)
         if self.shuffle:
             rng = np.random.default_rng(np.uint32(self.seed) + np.uint32(epoch))
-            return rng.permutation(n)
-        return np.arange(n)
+            idx = rng.permutation(n)
+        else:
+            idx = np.arange(n)
+        if self.sort_key is not None and self.sort_window > 0:
+            idx = self._length_grouped(idx, epoch)
+        return idx
+
+    def _length_grouped(self, idx: np.ndarray, epoch: int) -> np.ndarray:
+        window = max(self.sort_window, 1) * self.batch_size
+        rng = np.random.default_rng(
+            (np.uint32(self.seed) ^ np.uint32(0x9E3779B9)) + np.uint32(epoch)
+        )
+        out = []
+        for start in range(0, len(idx), window):
+            win = idx[start : start + window]
+            win = win[np.argsort(self.sort_key[win], kind="stable")]
+            nb = len(win) // self.batch_size
+            batches = [
+                win[i * self.batch_size : (i + 1) * self.batch_size]
+                for i in range(nb)
+            ]
+            for j in rng.permutation(nb):
+                out.append(batches[j])
+            out.append(win[nb * self.batch_size :])  # window tail, in place
+        return np.concatenate(out) if out else idx
 
     def skip_next(self, num_batches: int) -> None:
         """Skip the first ``num_batches`` of the NEXT iteration — deterministic

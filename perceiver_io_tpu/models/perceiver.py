@@ -266,12 +266,17 @@ class PerceiverMLM(nn.Module):
 
         x_latent = self.encoder(x_masked, pad_mask=pad_mask, deterministic=deterministic)
 
-        if masking and loss_gather_capacity is not None and loss_gather_capacity < l:
+        if masking and loss_gather_capacity is not None:
             # First-K masked indices per row (lax.top_k is index-stable), then
             # earliest unmasked indices; the latter carry label -100 already,
             # so gathered labels mark the padding slots ignored for free.
+            # Capacity clamps to the (static) batch width: bucketed-width
+            # batches shorter than the configured capacity decode l positions
+            # (a permutation of the full decode), never the max_seq_len
+            # query count the unclamped full-decode branch would cost.
+            capacity = min(loss_gather_capacity, l)
             valid = (x_labels != IGNORE_LABEL).astype(jnp.float32)
-            _, positions = jax.lax.top_k(valid, loss_gather_capacity)
+            _, positions = jax.lax.top_k(valid, capacity)
             x_out = self.decoder(
                 x_latent, deterministic=deterministic, positions=positions,
                 return_features=return_features,

@@ -124,6 +124,148 @@ def test_imdb_synthetic_module(tmp_path):
     assert set(val) == {"label", "token_ids", "pad_mask"}
 
 
+def test_collator_bucket_widths(imdb_tok):
+    """Bucketed padding: each batch lands in the smallest width that fits its
+    longest sequence; max_seq_len is always the final cap."""
+    col = Collator(imdb_tok, max_seq_len=32, bucket_widths=[8, 16])
+    assert col.bucket_widths == [8, 16, 32]  # cap appended
+
+    def expected_width(texts):
+        longest = max(
+            min(len(e), 32) for e in imdb_tok.encode_batch(list(texts))
+        )
+        return next(w for w in col.bucket_widths if w >= longest)
+
+    for texts in (
+        ["terrible"],
+        ["terrible", "awesome movie"],
+        [" ".join(["movie"] * 6)],
+        [" ".join(["movie"] * 100)],  # truncated at the cap
+    ):
+        batch = col.collate([(0, t) for t in texts])
+        assert batch["token_ids"].shape[1] == expected_width(texts)
+        # contract invariants hold at every width
+        np.testing.assert_array_equal(
+            batch["pad_mask"], batch["token_ids"] == 0
+        )
+    assert col.collate([(0, " ".join(["movie"] * 100))])[
+        "token_ids"].shape[1] == 32
+
+    with pytest.raises(ValueError, match="bucket_widths"):
+        Collator(imdb_tok, max_seq_len=16, bucket_widths=[8, 64])
+
+
+def test_loader_length_grouped_windows():
+    """sort_key + sort_window: every example still appears exactly once per
+    epoch, examples cannot migrate across windows, batches become
+    length-homogeneous inside each window, and the order is deterministic."""
+    n, bs, win = 64, 8, 2
+    lengths = np.arange(n)[::-1].copy()  # strictly decreasing keys
+
+    def mk():
+        return DataLoader(
+            RangeDataset(n), batch_size=bs, collate=collate_ids,
+            shuffle=True, seed=11, prefetch=0,
+            sort_key=lengths, sort_window=win,
+        )
+
+    batches = [b["x"] for b in mk()]
+    seen = np.concatenate(batches)
+    assert sorted(seen.tolist()) == list(range(n))  # coverage, no dupes
+    np.testing.assert_array_equal(np.concatenate([b["x"] for b in mk()]), seen)
+
+    # window locality: reconstruct the pre-sort shuffle and check each
+    # window's examples stay within it
+    base = np.random.default_rng(np.uint32(11) + np.uint32(0)).permutation(n)
+    for w in range(0, n // (bs * win)):
+        window_members = set(base[w * bs * win : (w + 1) * bs * win])
+        got = set(seen[w * bs * win : (w + 1) * bs * win].tolist())
+        assert got == window_members
+    # within a window, each batch is a contiguous run of the sorted order
+    for w in range(0, n // (bs * win)):
+        window_batches = batches[w * win : (w + 1) * win]
+        spans = sorted(
+            (min(lengths[b]), max(lengths[b])) for b in window_batches
+        )
+        for (lo1, hi1), (lo2, hi2) in zip(spans, spans[1:]):
+            assert hi1 < lo2  # non-overlapping length ranges
+
+    with pytest.raises(ValueError, match="sort_key"):
+        DataLoader(RangeDataset(8), batch_size=4, collate=collate_ids,
+                   sort_window=2)
+    with pytest.raises(ValueError, match="sort_key length"):
+        DataLoader(RangeDataset(8), batch_size=4, collate=collate_ids,
+                   sort_key=np.arange(5), sort_window=2)
+
+
+def test_bucketed_module_rejects_multihost(tmp_path):
+    """Per-host collation picks widths from local shards only — inconsistent
+    across hosts — so the module fails loudly instead of deadlocking."""
+    with pytest.raises(ValueError, match="num_shards"):
+        IMDBDataModule(root=str(tmp_path), synthetic=True,
+                       bucket_widths=[16], num_shards=2)
+
+
+def test_imdb_bucketed_module_and_predict_parity(tmp_path):
+    """End to end: the module with buckets yields mixed widths whose batches
+    all satisfy the contract, and the MLM predict logits for a short text are
+    identical whether the batch was padded to a small bucket or to the cap
+    (padding is masked out of attention, so width must not change results)."""
+    import jax
+    import jax.numpy as jnp
+
+    import perceiver_io_tpu as pit
+    from perceiver_io_tpu.ops.masking import TextMasking
+
+    dm = IMDBDataModule(root=str(tmp_path), max_seq_len=32, vocab_size=200,
+                        batch_size=8, synthetic=True, synthetic_size=128,
+                        bucket_widths=[16], length_sort_window=2)
+    dm.prepare_data()
+    dm.setup()
+    widths = {b["token_ids"].shape[1] for b in dm.train_dataloader()}
+    assert widths <= {16, 32}
+    for batch in dm.train_dataloader():
+        np.testing.assert_array_equal(
+            batch["pad_mask"], batch["token_ids"] == 0
+        )
+
+    vocab = dm.tokenizer.get_vocab_size()
+    C, NLAT = 16, 4
+    model = pit.PerceiverMLM(
+        encoder=pit.PerceiverEncoder(
+            input_adapter=pit.TextInputAdapter(
+                vocab_size=vocab, max_seq_len=32, num_channels=C),
+            latent_shape=(NLAT, C), num_layers=1,
+            num_cross_attention_heads=2, num_self_attention_heads=2,
+            num_self_attention_layers_per_block=1,
+        ),
+        decoder=pit.PerceiverDecoder(
+            output_adapter=pit.TextOutputAdapter(
+                vocab_size=vocab, max_seq_len=32, num_output_channels=C),
+            latent_shape=(NLAT, C), num_cross_attention_heads=2,
+        ),
+        masking=TextMasking(vocab, 1, 2, 3),
+    )
+    text = "an awesome movie"
+    col_bucket = dm.collator
+    col_full = Collator(dm.tokenizer, max_seq_len=32)
+    ids_b, mask_b = col_bucket.encode([text])
+    ids_f, mask_f = col_full.encode([text])
+    assert ids_b.shape[1] == 16 and ids_f.shape[1] == 32
+
+    params = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        jnp.asarray(ids_f), jnp.asarray(mask_f),
+    )["params"]
+    out_b, _ = model.apply({"params": params}, jnp.asarray(ids_b),
+                           jnp.asarray(mask_b), masking=False)
+    out_f, _ = model.apply({"params": params}, jnp.asarray(ids_f),
+                           jnp.asarray(mask_f), masking=False)
+    np.testing.assert_allclose(
+        np.asarray(out_b), np.asarray(out_f)[:, :16], atol=1e-5
+    )
+
+
 def test_imdb_missing_data_raises(tmp_path):
     dm = IMDBDataModule(root=str(tmp_path), synthetic=False, download=False)
     with pytest.raises(FileNotFoundError, match="aclImdb"):

@@ -333,3 +333,84 @@ def test_training_trajectory_matches_torch(rng):
             np.asarray(ours), theirs, atol=2.5 * lr,
             err_msg=f"param drift at {jax.tree_util.keystr(path)}",
         )
+
+
+def test_training_trajectory_matches_torch_at_schedule_scale(rng):
+    """Trajectory parity over 80 steps with the PRODUCTION training recipe:
+    AdamW + decoupled weight decay + OneCycle LR (pct_start 0.25 → a full
+    20-step warmup phase plus most of the anneal engage). At 5 steps
+    (the test above) schedule effects barely move the LR; this run covers
+    the regime the reference's north-star config actually trains in
+    (reference lightning.py:59-79: OneCycleLR stepped per optimizer step)
+    and asserts the per-step loss ratio holds THROUGHOUT, not just at the
+    end."""
+    from perceiver_io_tpu.training import (
+        OptimizerConfig,
+        TrainState,
+        make_classifier_steps,
+        make_optimizer,
+    )
+
+    torch.manual_seed(0)
+    oracle = TorchOracle().train()
+
+    steps = 80
+    lr, wd, pct_start = 3e-3, 0.01, 0.25
+    batches = [
+        (
+            rng.integers(0, VOCAB, size=(B, L)).astype(np.int64),
+            rng.integers(0, 3, size=(B,)).astype(np.int64),
+        )
+        for _ in range(steps)
+    ]
+
+    opt = torch.optim.AdamW(oracle.parameters(), lr=lr, weight_decay=wd)
+    sched = torch.optim.lr_scheduler.OneCycleLR(
+        opt, max_lr=lr, total_steps=steps, pct_start=pct_start,
+        cycle_momentum=False,
+    )
+    model = build_flax_model()
+    params = jax.tree.map(jnp.asarray, flax_params_from_oracle(oracle))
+    tx, schedule = make_optimizer(OptimizerConfig(
+        optimizer="AdamW", learning_rate=lr, weight_decay=wd,
+        one_cycle_lr=True, one_cycle_pct_start=pct_start, max_steps=steps,
+    ))
+    state = TrainState.create(params, tx, jax.random.key(0))
+    train_step, _ = make_classifier_steps(model, schedule, input_kind="text")
+    jit_step = jax.jit(train_step)
+
+    torch_losses, jax_losses = [], []
+    torch_lrs, jax_lrs = [], []
+    for ids, labels in batches:
+        opt.zero_grad()
+        torch_lrs.append(opt.param_groups[0]["lr"])
+        t_logits = oracle(torch.tensor(ids))
+        t_loss = torch.nn.functional.cross_entropy(t_logits, torch.tensor(labels))
+        t_loss.backward()
+        opt.step()
+        sched.step()
+        torch_losses.append(float(t_loss))
+
+        batch = {
+            "token_ids": jnp.asarray(ids.astype(np.int32)),
+            "pad_mask": jnp.zeros((B, L), bool),
+            "label": jnp.asarray(labels.astype(np.int32)),
+        }
+        state, metrics = jit_step(state, batch)
+        jax_losses.append(float(metrics["loss"]))
+        jax_lrs.append(float(metrics["lr"]))
+
+    # the schedules themselves agree step-for-step (warmup, peak, anneal)
+    np.testing.assert_allclose(jax_lrs, torch_lrs, rtol=5e-4, atol=1e-10)
+    # per-step loss parity through the whole run. Tolerance reasoning: the
+    # 5-step test holds 2e-4; over 80 steps at a 3x higher peak LR,
+    # float-level Adam sign-noise on near-zero gradients accumulates into
+    # the params, and losses drift by O(1e-3) relative while remaining
+    # lockstep in shape — a wrong decay coupling or schedule off-by-one
+    # diverges 10-100x faster than this bound.
+    np.testing.assert_allclose(jax_losses, torch_losses, rtol=4e-3, atol=1e-3)
+    # the schedule actually engaged (warmup rose to the peak, anneal fell
+    # well below it) — the parity above isn't a trivially-flat-LR run
+    peak = max(jax_lrs)
+    assert peak == pytest.approx(lr, rel=1e-3)
+    assert jax_lrs[0] < 0.1 * peak and jax_lrs[-1] < 0.01 * peak
