@@ -6,7 +6,7 @@ the current backend. Usage:
 
     python tools/e2e_configs_bench.py [config ...]   # default: all
 
-Configs: mlm, mnist, imagenet, imagenet8h, flow, multimodal.
+Configs: mlm, seqclf, mnist, imagenet, imagenet8h, flow, multimodal.
 """
 
 from __future__ import annotations
@@ -98,6 +98,47 @@ def config_mlm():
     return variables, train_step, batch, b
 
 
+def config_seqclf():
+    """IMDB sequence classification (the transfer target: same text encoder
+    as MLM, classification decoder; reference train_seq_clf.py defaults —
+    batch 128, 64x64 latents, 1 decoder cross-attention head,
+    reference ``train_seq_clf.py:56-68``)."""
+    vocab, seq, b = 10003, 512, 128
+    attn = ATTN_IMPL or "xla"
+    model = pit.PerceiverIO(
+        encoder=pit.PerceiverEncoder(
+            input_adapter=pit.TextInputAdapter(
+                vocab_size=vocab, max_seq_len=seq, num_channels=64, dtype=DTYPE
+            ),
+            latent_shape=(64, 64),
+            num_layers=3,
+            num_self_attention_layers_per_block=6,
+            dtype=DTYPE,
+            attn_impl=attn,
+        ),
+        decoder=pit.PerceiverDecoder(
+            output_adapter=pit.ClassificationOutputAdapter(
+                num_classes=2, num_output_channels=64, dtype=DTYPE
+            ),
+            latent_shape=(64, 64),
+            num_cross_attention_heads=1,
+            dtype=DTYPE,
+            attn_impl=attn,
+        ),
+    )
+    batch = {
+        "token_ids": jnp.asarray(rng.integers(3, vocab, (b, seq)).astype(np.int32)),
+        "pad_mask": jnp.zeros((b, seq), bool),
+        "label": jnp.asarray(rng.integers(0, 2, b).astype(np.int32)),
+    }
+    variables = model.init(
+        {"params": jax.random.key(0)}, batch["token_ids"],
+        pad_mask=batch["pad_mask"],
+    )
+    train_step, _ = make_classifier_steps(model, input_kind="text")
+    return variables, train_step, batch, b
+
+
 def config_mnist():
     """MNIST recipe (28x28, 32x128 latents, 3 self-attn, batch 128)."""
     b = 128
@@ -173,6 +214,7 @@ def config_multimodal():
 
 CONFIGS = {
     "mlm": config_mlm,
+    "seqclf": config_seqclf,
     "mnist": config_mnist,
     "imagenet": config_imagenet,
     "imagenet8h": config_imagenet8h,
