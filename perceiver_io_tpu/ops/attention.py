@@ -304,10 +304,14 @@ class MultiHeadAttention(nn.Module):
                 seq_parallel_fused_attention,
             )
 
+            head_axis = sp.head_axis
+            if head_axis is not None and h % sp.mesh.shape[head_axis]:
+                head_axis = None  # indivisible heads replicate over tp
             out = seq_parallel_fused_attention(
                 q.reshape(b, t, h, d), k.reshape(b, s, h, d),
                 v.reshape(b, s, h, d), pad_mask=pad_mask,
                 mesh=sp.mesh, axis=sp.axis, batch_axis=sp.batch_axis,
+                head_axis=head_axis,
             ).reshape(b, t, e)
         elif impl == "packed" and fusable:
             from perceiver_io_tpu.ops.pallas_attention import (

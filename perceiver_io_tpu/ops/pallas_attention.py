@@ -504,6 +504,7 @@ def seq_parallel_fused_attention(
     mesh,
     axis: str = "seq",
     batch_axis: Optional[str] = None,
+    head_axis: Optional[str] = None,
     kv_block_size: int = DEFAULT_KV_BLOCK,
     q_block_size: int = DEFAULT_Q_BLOCK,
     interpret: Optional[bool] = None,
@@ -526,10 +527,17 @@ def seq_parallel_fused_attention(
       axis: mesh axis name carrying the KV shards (default ``'seq'``).
       batch_axis: optional mesh axis for the leading batch dimension (compose
         with data parallelism).
+      head_axis: optional mesh axis for the head dimension (compose with
+        tensor parallelism: each device keeps only its H/tp heads — without
+        this, a tp mesh axis is unmentioned in the specs and shard_map forces
+        an all-gather of all heads onto every device). Heads are independent
+        in every matmul and in the softmax-stat merge (the collectives reduce
+        over ``axis`` only), so the math is unchanged. The axis size must
+        divide H (e.g. 8 heads on tp=4: two heads per device).
     Inputs may be global ``jax.Array``s (sharded or not) or host arrays; S
     must divide evenly by the axis size.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map  # jax.experimental.shard_map deprecated in 0.8
     from jax.sharding import PartitionSpec as P
 
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
@@ -543,6 +551,11 @@ def seq_parallel_fused_attention(
         raise ValueError(
             f"KV length {s} must be divisible by the '{axis}' mesh axis "
             f"size ({n_shards}) — pad S to a multiple"
+        )
+    if head_axis is not None and h % mesh.shape[head_axis]:
+        raise ValueError(
+            f"head count {h} must be divisible by the '{head_axis}' mesh "
+            f"axis size ({mesh.shape[head_axis]})"
         )
 
     if pad_mask is None:
@@ -563,13 +576,17 @@ def seq_parallel_fused_attention(
         local,
         mesh=mesh,
         in_specs=(
-            P(batch_axis),
-            P(batch_axis, axis),
-            P(batch_axis, axis),
+            P(batch_axis, None, head_axis),
+            P(batch_axis, axis, head_axis),
+            P(batch_axis, axis, head_axis),
             P(batch_axis, axis),
         ),
-        out_specs=P(batch_axis),
-        check_rep=False,  # custom_vjp + collectives confuse the rep checker
+        out_specs=P(batch_axis, None, head_axis),
+        # disable varying-manual-axes checking (jax.shard_map's successor to
+        # the legacy check_rep) — custom_vjp + collectives confuse it. The
+        # transpose convention _sp_bwd compensates for is pinned by the
+        # gradient-parity tests; see its docstring.
+        check_vma=False,
     )(q, k, v, bias)
 
 

@@ -57,6 +57,10 @@ class SequenceParallelContext:
     mesh: Mesh
     axis: str = AXIS_SEQ
     batch_axis: Optional[str] = AXIS_DATA
+    # head (tensor-parallel) axis: attention passes it through when the head
+    # count divides the axis size, so tp meshes keep heads sharded inside the
+    # shard_map instead of all-gathering them
+    head_axis: Optional[str] = AXIS_MODEL
 
 
 _ACTIVE_SP: contextvars.ContextVar[Optional[SequenceParallelContext]] = (

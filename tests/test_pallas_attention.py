@@ -392,16 +392,21 @@ class TestSeqParallelFusedAttention:
         )
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
-    @pytest.mark.parametrize("dp,tp,sp,batch_axis", [
-        (1, 1, 8, None),
+    @pytest.mark.parametrize("dp,tp,sp,batch_axis,head_axis", [
+        (1, 1, 8, None, None),
         # replicated non-seq axes of size > 1: the transpose convention
         # double-counted these before the round-2 fix (grads came back
         # exactly dp*tp times too large while the forward stayed correct)
-        (2, 1, 4, None),
-        (1, 2, 4, None),
-        (2, 2, 2, "data"),
+        (2, 1, 4, None, None),
+        (1, 2, 4, None, None),
+        (2, 2, 2, "data", None),
+        # head (tensor-parallel) sharding: each device keeps H/tp heads
+        # inside the shard_map instead of all-gathering them
+        (1, 2, 4, None, "model"),
+        (2, 2, 2, "data", "model"),
     ])
-    def test_gradients_match_single_device(self, rng, dp, tp, sp, batch_axis):
+    def test_gradients_match_single_device(self, rng, dp, tp, sp, batch_axis,
+                                           head_axis):
         from perceiver_io_tpu.parallel import make_mesh
 
         q, k, v = self._inputs(rng, S=64)
@@ -415,7 +420,7 @@ class TestSeqParallelFusedAttention:
             return jnp.sum(
                 seq_parallel_fused_attention(
                     q, k, v, pad_mask=pad, mesh=mesh, axis="seq",
-                    batch_axis=batch_axis,
+                    batch_axis=batch_axis, head_axis=head_axis,
                 ) ** 2
             )
 
@@ -423,6 +428,25 @@ class TestSeqParallelFusedAttention:
         got = jax.grad(loss_sp, argnums=(0, 1, 2))(q, k, v)
         for g, r in zip(got, ref):
             np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=1e-4)
+
+    def test_head_sharded_forward_and_validation(self, rng):
+        from perceiver_io_tpu.parallel import make_mesh
+
+        q, k, v = self._inputs(rng)  # H=2
+        pad = jnp.zeros((2, 96), bool).at[0, -13:].set(True)
+        mesh = make_mesh(dp=2, tp=2, sp=2)
+        ref = fused_attention(q, k, v, pad_mask=pad)
+        out = seq_parallel_fused_attention(
+            q, k, v, pad_mask=pad, mesh=mesh, axis="seq",
+            batch_axis="data", head_axis="model",
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+        q3, k3, v3 = self._inputs(rng, H=3)  # 3 % 2 != 0
+        with pytest.raises(ValueError, match="head count"):
+            seq_parallel_fused_attention(
+                q3, k3, v3, mesh=mesh, axis="seq", head_axis="model"
+            )
 
     def test_under_jit_with_sharded_inputs(self, rng):
         """The intended deployment: jit + pre-sharded global arrays."""

@@ -2,13 +2,14 @@
 
 PERF.md r2 pinned flow's remaining headroom on the encoder-cross kernel's
 14-16 TF/s MXU rate and left block tuning "blocked by infra". Subtlety the
-sweep must cover: S = 368·496 = 182528 = 2^7·23·31·2 has NO lane-aligned
-divisor between 256 and 3968, so the default kv_block_size=512 silently
-degrades to 256 (`_kv_block_size` picks the largest aligned divisor ≤
-request) — larger blocks require the PAD path (S padded up to a block
-multiple with PAD_BIAS keys). This script times fwd+bwd at the flow
-encoder-cross shape across (kv_block, q_block) grids, including the padded
-configurations the divisor logic avoids by default.
+sweep must cover: S = 368·496 = 182528 = 2^8·23·31, whose lane-aligned
+divisors are 128, 256, then nothing until 2944 (= 128·23) and 3968
+(= 128·31) — so the default kv_block_size=512 silently degrades to 256
+(`_kv_block_size` picks the largest aligned divisor ≤ request), mid-range
+blocks require the PAD path (S padded up to a block multiple with PAD_BIAS
+keys), and the big exact divisors stream with no padding at all. This
+script times fwd+bwd at the flow encoder-cross shape across (kv_block,
+q_block) grids covering all three regimes.
 
 Usage: ``timeout 1800 python tools/flow_block_sweep.py [--batch 4]``
 """
@@ -31,7 +32,7 @@ from attn_shapes_bench import grad_of, timeit
 from perceiver_io_tpu.ops.pallas_attention import fused_attention
 
 T, S, H, D = 2048, 182528, 1, 512
-KV_BLOCKS = [256, 512, 1024, 2048]
+KV_BLOCKS = [256, 512, 1024, 2048, 2944, 3968]  # 2944/3968: exact divisors
 Q_BLOCKS = [256, 512, 1024]
 
 

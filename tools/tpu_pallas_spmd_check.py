@@ -15,6 +15,10 @@ Prints one summary line per configuration; non-zero exit on failure.
 from __future__ import annotations
 
 import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
