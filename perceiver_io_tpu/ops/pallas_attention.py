@@ -59,6 +59,18 @@ PAD_BIAS = 2.0 * MASK_VALUE
 _LANES = 128
 DEFAULT_KV_BLOCK = 512
 DEFAULT_Q_BLOCK = 512
+# Larger query blocks measure +3.7-5.1% at streamed-KV shapes (flow
+# encoder-cross sweep, PERF.md r3), but VMEM safety depends on the RESOLVED
+# block triple, not the raw shape: the sweep's compile boundary at d=512 is
+# (t_blk 1024, s_blk 256) OK vs (t_blk 1024, s_blk 512) an 18 MB > 16 MB
+# scoped-VMEM OOM in the dkv backward. The auto bump (``q_block_size=None``,
+# applied inside ``_prepare_blocks`` AFTER s_blk resolution) therefore
+# requires BOTH: the resolved s_blk·d product within the measured-safe
+# 256×512 bound, and T dividing the big block exactly (no query padding and
+# no widening of the full-residency ``t <= 2·q_block`` fallback — shapes the
+# sweep never measured).
+LONG_KV_Q_BLOCK = 1024
+LONG_KV_SAFE_SBLK_D = 256 * 512
 
 
 def _dot(a, b, contract):
@@ -341,9 +353,11 @@ _fused_attention.defvjp(_fwd, _bwd)
 def _prepare_blocks(q, k, v, bias, kv_block_size, q_block_size, interpret):
     """Shared preamble: heads-major transpose, KV/query block sizing, and
     tiling-legal padding. Returns ``(q, k, v, bias, t_blk, s_blk, t_pad)``
-    with q/k/v in (B, H, T/S, D) layout."""
+    with q/k/v in (B, H, T/S, D) layout. ``q_block_size=None`` resolves per
+    shape after s_blk is known (see LONG_KV_Q_BLOCK)."""
     t = q.shape[1]
     s = k.shape[1]
+    d = q.shape[-1]
 
     # heads-major layout so each (b, h) grid step reads contiguous KV rows
     q = jnp.transpose(q, (0, 2, 1, 3))
@@ -366,6 +380,14 @@ def _prepare_blocks(q, k, v, bias, kv_block_size, q_block_size, interpret):
             v = jnp.pad(v, ((0, 0), (0, 0), (0, s_pad), (0, 0)))
             bias = jnp.pad(bias, ((0, 0), (0, s_pad)), constant_values=PAD_BIAS)
             s_blk = block
+
+    if q_block_size is None:
+        # auto: the big query block only in its measured-safe regime (see
+        # the LONG_KV_Q_BLOCK note — both guards are load-bearing)
+        if t % LONG_KV_Q_BLOCK == 0 and s_blk * d <= LONG_KV_SAFE_SBLK_D:
+            q_block_size = LONG_KV_Q_BLOCK
+        else:
+            q_block_size = DEFAULT_Q_BLOCK
 
     # Block the query axis too: a fully resident query block (plus its f32
     # accumulator and double-buffered output) blows the VMEM scoped limit once
@@ -390,14 +412,16 @@ def fused_attention(
     v: Array,
     pad_mask: Optional[Array] = None,
     kv_block_size: int = DEFAULT_KV_BLOCK,
-    q_block_size: int = DEFAULT_Q_BLOCK,
+    q_block_size: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> Array:
     """Fused multi-head attention over (B, T, H, D) q and (B, S, H, D) k/v.
 
     ``pad_mask``: optional (B, S) bool, True = key position masked out (the
-    torch ``key_padding_mask`` convention). Off-TPU backends run the kernel in
-    interpreter mode (slow — for tests), overridable via ``interpret``.
+    torch ``key_padding_mask`` convention). ``q_block_size=None`` (default)
+    resolves per shape after KV-block sizing (see LONG_KV_Q_BLOCK). Off-TPU
+    backends run the kernel in interpreter mode (slow — for tests),
+    overridable via ``interpret``.
     """
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         raise ValueError(f"expected (B, T/S, H, D) tensors, got {q.shape=} {k.shape=}")
@@ -506,7 +530,7 @@ def seq_parallel_fused_attention(
     batch_axis: Optional[str] = None,
     head_axis: Optional[str] = None,
     kv_block_size: int = DEFAULT_KV_BLOCK,
-    q_block_size: int = DEFAULT_Q_BLOCK,
+    q_block_size: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> Array:
     """:func:`fused_attention` with the KV axis SHARDED over a mesh axis.
@@ -557,6 +581,9 @@ def seq_parallel_fused_attention(
             f"head count {h} must be divisible by the '{head_axis}' mesh "
             f"axis size ({mesh.shape[head_axis]})"
         )
+    # q_block_size=None resolves inside _prepare_blocks, which runs on the
+    # shard_map-LOCAL arrays — the auto choice sees each device's actual
+    # S/n slice and resolved s_blk
 
     if pad_mask is None:
         bias = jnp.zeros((b, s), jnp.float32)

@@ -79,6 +79,44 @@ def test_block_size_selection():
     assert _kv_block_size(17, 16, 1) == 0
 
 
+def test_auto_q_block_resolution():
+    """The q_block auto-default (None) resolves AFTER s_blk, inside its
+    measured-safe regime ONLY: resolved s_blk·d within the 256x512 compile
+    boundary AND T dividing the big block exactly (PERF.md r3 sweep — both
+    guards are load-bearing; the (t_blk 1024, s_blk 512, d 512) combo is a
+    measured scoped-VMEM OOM)."""
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.ops import pallas_attention as pa
+
+    def resolve(t, s, d, kv_block=pa.DEFAULT_KV_BLOCK, q_block=None):
+        q = jnp.zeros((1, t, 1, d), jnp.bfloat16)
+        k = jnp.zeros((1, s, 1, d), jnp.bfloat16)
+        bias = jnp.zeros((1, s), jnp.float32)
+        _, _, _, _, t_blk, s_blk, _ = pa._prepare_blocks(
+            q, k, k, bias, kv_block, q_block, interpret=False
+        )
+        return t_blk, s_blk
+
+    # flow encoder-cross-like (S has a 256 divisor): safe → big query block
+    t_blk, s_blk = resolve(2048, 182528, 512)
+    assert (t_blk, s_blk) == (1024, 256)
+    # same T/S but s_blk resolves to 512 (S divisible by 512): s_blk·d over
+    # the measured boundary at d=512 → stays at the 512 default
+    t_blk, s_blk = resolve(2048, 8192, 512)
+    assert (s_blk, t_blk) == (512, 512)
+    # shallow heads keep the bump at s_blk 512 (s_blk·d = 512·128 is safe)
+    t_blk, s_blk = resolve(2048, 8192, 128)
+    assert (s_blk, t_blk) == (512, 1024)
+    # T not divisible by the big block (would pad / widen the full-residency
+    # fallback — unmeasured) → 512 default
+    t_blk, _ = resolve(1152, 182528, 128)
+    assert t_blk != 1024
+    # explicit q_block_size is always honored
+    t_blk, _ = resolve(2048, 182528, 512, q_block=512)
+    assert t_blk == 512
+
+
 def test_fully_masked_row_uniform(rng):
     """A fully padded sequence softmaxes to uniform — XLA-path parity, no NaN."""
     b, t, s, h, d = 2, 4, 8, 1, 4
