@@ -92,6 +92,10 @@ def add_mesh_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--tp", type=int, default=1, help="tensor-parallel size")
     g.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel size (shards the input axis M)")
+    g.add_argument("--dcn_dp", type=int, default=1,
+                   help="outer data-parallel factor placed across slice/host "
+                        "(DCN) boundaries; must divide dp. The inner data "
+                        "factor and tp/sp stay on each slice's ICI")
     g.add_argument("--shard_seq", action="store_true",
                    help="shard batches over the seq mesh axis: token axis for "
                         "text, first spatial axis for image/frames (must be "
@@ -245,7 +249,8 @@ def optimizer_from_args(args):
 
 
 def mesh_from_args(args):
-    mesh = make_mesh(dp=args.dp, tp=args.tp, sp=args.sp)
+    mesh = make_mesh(dp=args.dp, tp=args.tp, sp=args.sp,
+                     dcn_dp=getattr(args, "dcn_dp", 1))
     dp = mesh.shape["data"]
     if args.batch_size % dp != 0:
         raise SystemExit(

@@ -65,12 +65,15 @@ DEFAULT_Q_BLOCK = 512
 # (t_blk 1024, s_blk 256) OK vs (t_blk 1024, s_blk 512) an 18 MB > 16 MB
 # scoped-VMEM OOM in the dkv backward. The auto bump (``q_block_size=None``,
 # applied inside ``_prepare_blocks`` AFTER s_blk resolution) therefore
-# requires BOTH: the resolved s_blk·d product within the measured-safe
-# 256×512 bound, and T dividing the big block exactly (no query padding and
-# no widening of the full-residency ``t <= 2·q_block`` fallback — shapes the
-# sweep never measured).
+# requires ALL of: the resolved s_blk·d product within the measured-safe
+# 256×512 bound, d within the sweep's measured range (≤ 512 — a deeper head
+# would grow the 1024-row query block + f32 accumulator past anything
+# measured even when s_blk·d stays small), and T dividing the big block
+# exactly (no query padding and no widening of the full-residency
+# ``t <= 2·q_block`` fallback — shapes the sweep never measured).
 LONG_KV_Q_BLOCK = 1024
 LONG_KV_SAFE_SBLK_D = 256 * 512
+LONG_KV_MAX_D = 512
 
 
 def _dot(a, b, contract):
@@ -384,7 +387,8 @@ def _prepare_blocks(q, k, v, bias, kv_block_size, q_block_size, interpret):
     if q_block_size is None:
         # auto: the big query block only in its measured-safe regime (see
         # the LONG_KV_Q_BLOCK note — both guards are load-bearing)
-        if t % LONG_KV_Q_BLOCK == 0 and s_blk * d <= LONG_KV_SAFE_SBLK_D:
+        if (t % LONG_KV_Q_BLOCK == 0 and d <= LONG_KV_MAX_D
+                and s_blk * d <= LONG_KV_SAFE_SBLK_D):
             q_block_size = LONG_KV_Q_BLOCK
         else:
             q_block_size = DEFAULT_Q_BLOCK

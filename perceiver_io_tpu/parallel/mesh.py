@@ -108,22 +108,92 @@ def initialize_distributed(
     )
 
 
+def _inner_device_grid(
+    devices: Sequence[jax.Device], dp: int, tp: int, sp: int
+) -> np.ndarray:
+    """(dp, tp, sp) grid over devices that share one fast (ICI) network."""
+    if all(d.platform == "cpu" for d in devices):
+        # host-platform (virtual-device) meshes have no physical topology —
+        # row-major assignment is exact, and create_device_mesh can reject
+        # shapes it cannot factor against fake topologies
+        try:
+            return mesh_utils.create_device_mesh((dp, tp, sp), devices=devices)
+        except Exception:
+            return np.asarray(devices).reshape(dp, tp, sp)
+    # on real accelerators a failure here is a genuine topology error:
+    # surface it rather than silently degrading ICI locality
+    return mesh_utils.create_device_mesh((dp, tp, sp), devices=devices)
+
+
+def _hybrid_device_grid(
+    devices: Sequence[jax.Device], dcn_dp: int, inner_dp: int, tp: int, sp: int
+) -> np.ndarray:
+    """(dcn_dp·inner_dp, tp, sp) grid, DCN-major on the first axis.
+
+    Delegates granule discovery, evenness validation and topology-aware
+    placement to ``mesh_utils.create_hybrid_device_mesh`` — slice granules
+    first (multi-slice pods), then process granules (multi-host CPU /
+    hosts-as-granules deployments). When neither yields ``dcn_dp`` granules,
+    a SINGLE-process CPU device set falls back to contiguous chunking (so the
+    layout is testable on virtual devices); real accelerators — and CPU
+    devices spanning processes, where chunks could straddle host boundaries —
+    surface the topology error.
+    """
+    errors = []
+    for kwargs in ({}, {"process_is_granule": True}):
+        try:
+            return mesh_utils.create_hybrid_device_mesh(
+                (inner_dp, tp, sp), (dcn_dp, 1, 1), devices=devices, **kwargs
+            )
+        except (ValueError, AssertionError) as e:
+            errors.append(str(e))
+    if (all(d.platform == "cpu" for d in devices)
+            and len({d.process_index for d in devices}) == 1):
+        per = len(devices) // dcn_dp
+        return np.concatenate(
+            [
+                _inner_device_grid(devices[i * per:(i + 1) * per], inner_dp, tp, sp)
+                for i in range(dcn_dp)
+            ],
+            axis=0,
+        )
+    raise ValueError(
+        f"no slice/process granule split of {len(devices)} devices matches "
+        f"dcn_dp={dcn_dp}: {errors}"
+    )
+
+
 def make_mesh(
     dp: Optional[int] = None,
     tp: int = 1,
     sp: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
+    dcn_dp: int = 1,
 ) -> Mesh:
     """A (data, model, seq) mesh over the given (default: all) devices.
 
     ``dp`` defaults to ``n_devices // (tp * sp)``. On TPU,
     ``mesh_utils.create_device_mesh`` lays the axes out so that the
     highest-traffic axis rides ICI neighbours.
+
+    ``dcn_dp`` > 1 builds a hybrid ICI×DCN layout for multi-slice / multi-host
+    deployments: the ``data`` axis is laid out DCN-major, so its outer
+    ``dcn_dp`` factor crosses slice (or host) boundaries while the inner
+    ``dp // dcn_dp`` factor and the whole ``model``/``seq`` axes stay inside
+    one slice's ICI. The logical mesh is unchanged — same three axis names,
+    same shape ``(dp, tp, sp)`` — so every sharding rule, the ZeRO partition
+    and the sequence-parallel kernel route apply as-is; only the device
+    placement (and therefore which hops each collective rides) differs. This
+    is the standard hybrid recipe: gradient psum over ``data`` becomes a
+    hierarchical reduce (ICI within the slice, one DCN exchange across), and
+    the latency-sensitive tensor/sequence collectives never touch DCN.
     """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    if tp < 1 or sp < 1:
-        raise ValueError(f"tp and sp must be >= 1, got tp={tp} sp={sp}")
+    if tp < 1 or sp < 1 or dcn_dp < 1:
+        raise ValueError(
+            f"tp, sp and dcn_dp must be >= 1, got tp={tp} sp={sp} dcn_dp={dcn_dp}"
+        )
     if dp is None:
         if n % (tp * sp) != 0:
             raise ValueError(f"{n} devices not divisible by tp*sp = {tp * sp}")
@@ -131,16 +201,14 @@ def make_mesh(
     if dp * tp * sp != n:
         raise ValueError(f"dp*tp*sp = {dp * tp * sp} != {n} devices")
 
-    if all(d.platform == "cpu" for d in devices):
-        # host-platform (virtual-device) meshes have no physical topology —
-        # row-major assignment is exact, and create_device_mesh can reject
-        # shapes it cannot factor against fake topologies
-        try:
-            device_grid = mesh_utils.create_device_mesh((dp, tp, sp), devices=devices)
-        except Exception:
-            device_grid = np.asarray(devices).reshape(dp, tp, sp)
-    else:
-        # on real accelerators a failure here is a genuine topology error:
-        # surface it rather than silently degrading ICI locality
-        device_grid = mesh_utils.create_device_mesh((dp, tp, sp), devices=devices)
+    if dcn_dp == 1:
+        return Mesh(_inner_device_grid(devices, dp, tp, sp), MESH_AXES)
+
+    if dp % dcn_dp != 0:
+        raise ValueError(
+            f"dcn_dp={dcn_dp} must divide the data-parallel size dp={dp} "
+            f"(the DCN factor is the outer part of the data axis)"
+        )
+    inner_dp = dp // dcn_dp
+    device_grid = _hybrid_device_grid(devices, dcn_dp, inner_dp, tp, sp)
     return Mesh(device_grid, MESH_AXES)

@@ -137,6 +137,13 @@ def main() -> None:
     state = TrainState.create(variables["params"], tx, jax.random.key(1))
     train_step, eval_step = make_classifier_steps(model, sched, input_kind="text")
 
+    # -- hybrid ICI×DCN layout: process granules, tp stays process-local -----
+    hybrid = make_mesh(tp=2, dcn_dp=2)  # dp = 2: one replica per host granule
+    out["hybrid_rows_process"] = [
+        sorted({d.process_index for d in row.flat})
+        for row in np.asarray(hybrid.devices)
+    ]
+
     mesh = make_mesh()  # all 4 global devices on the data axis
     run_dir = os.path.join(args.workdir, "run")
     trainer = Trainer(

@@ -61,6 +61,14 @@ def test_distributed_topology(reports):
     assert r0["global_devices"] == r1["global_devices"] == 4
 
 
+def test_hybrid_dcn_mesh_granules_are_process_local(reports):
+    """make_mesh(dcn_dp=2) on 2 real processes: each data-axis row must hold
+    exactly one process's devices (tp collectives never cross the slow
+    network), DCN-major — row 0 is process 0, row 1 is process 1."""
+    _, (r0, r1) = reports
+    assert r0["hybrid_rows_process"] == r1["hybrid_rows_process"] == [[0], [1]]
+
+
 def test_loader_shards_disjoint_and_complete(reports):
     _, (r0, r1) = reports
     s0, s1 = set(r0["shard_items"]), set(r1["shard_items"])

@@ -112,6 +112,11 @@ def test_auto_q_block_resolution():
     # fallback — unmeasured) → 512 default
     t_blk, _ = resolve(1152, 182528, 128)
     assert t_blk != 1024
+    # head dims past the sweep's measured range (d > 512) stay on the 512
+    # default even when s_blk·d is small — the 1024-row query block + f32
+    # accumulator at d=1024 is an unmeasured VMEM regime
+    t_blk, s_blk = resolve(2048, 182528, 1024, kv_block=128)
+    assert s_blk * 1024 <= pa.LONG_KV_SAFE_SBLK_D and t_blk == 512
     # explicit q_block_size is always honored
     t_blk, _ = resolve(2048, 182528, 512, q_block=512)
     assert t_blk == 512

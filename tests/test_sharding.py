@@ -91,6 +91,40 @@ def test_mesh_validates():
         make_mesh(dp=3, tp=2, sp=2)
 
 
+def test_hybrid_dcn_mesh_layout():
+    """``dcn_dp`` lays the data axis out DCN-major while tp stays inside one
+    granule. On a single-process CPU backend granules fall back to contiguous
+    chunks, so devices 0-3 must fill data rows 0-1 and devices 4-7 rows 2-3."""
+    devices = jax.devices()
+    mesh = make_mesh(tp=2, dcn_dp=2)  # dp = 4 total, 2 inner per granule
+    assert mesh.shape == {"data": 4, "model": 2, "seq": 1}
+    grid = np.asarray(mesh.devices)
+    assert {d.id for d in grid[:2].flat} == {d.id for d in devices[:4]}
+    assert {d.id for d in grid[2:].flat} == {d.id for d in devices[4:]}
+    # every tp pair sits inside one granule (its collectives never cross DCN)
+    for row in grid.reshape(4, 2):
+        ids = sorted(d.id for d in row)
+        assert all(i < 4 for i in ids) or all(i >= 4 for i in ids)
+
+
+def test_hybrid_dcn_mesh_validates():
+    with pytest.raises(ValueError, match="must divide"):
+        make_mesh(tp=2, dcn_dp=3)  # dp = 4; 3 does not divide it
+    with pytest.raises(ValueError, match=">= 1"):
+        make_mesh(dcn_dp=0)
+
+
+def test_hybrid_dcn_mesh_matches_single_device(mlm_setup):
+    """The hybrid layout changes device placement only — the logical mesh and
+    therefore the training numerics must be identical."""
+    model, state, batch, train_step = mlm_setup
+    _, ref = _run(jax.jit(train_step), state, batch)
+    mesh = make_mesh(tp=2, dcn_dp=2)
+    step, sstate, bshard = make_sharded_train_step(train_step, mesh, state, batch)
+    _, sharded = _run(step, sstate, jax.device_put(batch, bshard))
+    np.testing.assert_allclose(sharded, ref, atol=1e-5)
+
+
 def test_dp_tp_sp_matches_single_device(mlm_setup):
     """Full 3D sharding (data × model × seq) must reproduce the single-device
     loss trajectory — collectives inserted by XLA, not by us."""
