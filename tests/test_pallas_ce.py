@@ -49,6 +49,33 @@ class TestPallasLinearCE:
                 err_msg=f"d{name} mismatch",
             )
 
+    def test_awkward_row_count_pads_not_shrinks(self, rng):
+        """A row count with no aligned divisor (B·K = 2·31 = 62, prime-ish)
+        must PAD rows to the block rather than shrink the block to a tiny
+        exact divisor (the seq-131072 regression: R = 32·1229 drove the grid
+        to 12,290 steps). Dead rows carry zero cotangent, so loss and all
+        three grads still match the unfused path exactly."""
+        x, w, b, labels = _setup(rng, B=2, K=31)
+
+        def ref(x, w, b):
+            return softmax_ce_integer(x @ w + b, labels).sum()
+
+        def ker(x, w, b):
+            # r_block_size forces the padded-rows path even in interpret
+            # mode (align=1 would otherwise allow r_blk=62 exactly)
+            return pallas_linear_ce_integer(
+                x, w, b, labels, r_block_size=16
+            ).sum()
+
+        ref_l, ref_g = jax.value_and_grad(ref, argnums=(0, 1, 2))(x, w, b)
+        ker_l, ker_g = jax.value_and_grad(ker, argnums=(0, 1, 2))(x, w, b)
+        np.testing.assert_allclose(float(ker_l), float(ref_l), rtol=1e-5)
+        for name, got, want in zip("x w b".split(), ker_g, ref_g):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=2e-4,
+                err_msg=f"d{name} mismatch",
+            )
+
     def test_single_block_vocab(self, rng):
         """V smaller than the block size → one full-dim block."""
         x, w, b, labels = _setup(rng, V=64)
