@@ -74,6 +74,40 @@ DEFAULT_Q_BLOCK = 512
 LONG_KV_Q_BLOCK = 1024
 LONG_KV_SAFE_SBLK_D = 256 * 512
 LONG_KV_MAX_D = 512
+# The q bump additionally keeps the per-block probs area t_blk·s_blk inside
+# the measured compile region: 1024·1024 and 512·2048 elements compile,
+# 1024·2048 is a remote-compile OOM (long-context kv sweep, PERF.md r3).
+LONG_KV_SAFE_PROBS = 1024 * 1024
+
+# Auto KV-block sizing (``kv_block_size=None``): streaming more keys per
+# sequential grid step amortizes per-step kernel overhead, and how much VMEM
+# that costs scales with d. Measured at long-S shapes (PERF.md r3 kv sweep,
+# fwd+bwd): d=16 S=131k kv 512→2048 is 3.47→2.45 ms (and 2048 + q capped at
+# 512 beats 512 + q 1024 everywhere tried); d=128 S=50k kv 512→1024 is
+# 8.55→6.44 ms (2048 no better); d=512 kv ≥ 1024 is the flow sweep's
+# measured scoped-VMEM OOM, so deep heads stay at 512. Short S keeps the
+# 512 default (the S < 8192 regimes were tuned in the original benches).
+LONG_KV_S = 8192
+
+
+def _auto_kv_block(s: int, d: int, t: int, alignment: int) -> int:
+    if s < LONG_KV_S:
+        return DEFAULT_KV_BLOCK
+    if d <= 32:
+        kv = 2048
+    elif d <= 128:
+        kv = 1024
+    else:
+        return DEFAULT_KV_BLOCK
+    # A query count with no aligned divisor that still fits two default
+    # blocks takes the full-residency fallback (t_blk = t, below) — the
+    # widened KV block must keep that combination inside the measured
+    # probs-area compile boundary too, not just the auto q-bump branch.
+    tb = _kv_block_size(t, DEFAULT_Q_BLOCK, alignment)
+    t_bound = t if (tb == 0 and t <= 2 * DEFAULT_Q_BLOCK) else DEFAULT_Q_BLOCK
+    while kv > DEFAULT_KV_BLOCK and t_bound * kv > LONG_KV_SAFE_PROBS:
+        kv //= 2
+    return kv
 
 
 def _dot(a, b, contract):
@@ -372,6 +406,8 @@ def _prepare_blocks(q, k, v, bias, kv_block_size, q_block_size, interpret):
     # it up to a block multiple with PAD_BIAS keys (excluded from the softmax
     # even on fully-masked rows).
     alignment = 1 if interpret else _LANES
+    if kv_block_size is None:
+        kv_block_size = _auto_kv_block(s, d, t, alignment)
     s_blk = _kv_block_size(s, kv_block_size, alignment)
     if s_blk == 0:
         if s <= 4 * kv_block_size:
@@ -388,7 +424,8 @@ def _prepare_blocks(q, k, v, bias, kv_block_size, q_block_size, interpret):
         # auto: the big query block only in its measured-safe regime (see
         # the LONG_KV_Q_BLOCK note — both guards are load-bearing)
         if (t % LONG_KV_Q_BLOCK == 0 and d <= LONG_KV_MAX_D
-                and s_blk * d <= LONG_KV_SAFE_SBLK_D):
+                and s_blk * d <= LONG_KV_SAFE_SBLK_D
+                and s_blk * LONG_KV_Q_BLOCK <= LONG_KV_SAFE_PROBS):
             q_block_size = LONG_KV_Q_BLOCK
         else:
             q_block_size = DEFAULT_Q_BLOCK
@@ -415,17 +452,19 @@ def fused_attention(
     k: Array,
     v: Array,
     pad_mask: Optional[Array] = None,
-    kv_block_size: int = DEFAULT_KV_BLOCK,
+    kv_block_size: Optional[int] = None,
     q_block_size: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> Array:
     """Fused multi-head attention over (B, T, H, D) q and (B, S, H, D) k/v.
 
     ``pad_mask``: optional (B, S) bool, True = key position masked out (the
-    torch ``key_padding_mask`` convention). ``q_block_size=None`` (default)
-    resolves per shape after KV-block sizing (see LONG_KV_Q_BLOCK). Off-TPU
-    backends run the kernel in interpreter mode (slow — for tests),
-    overridable via ``interpret``.
+    torch ``key_padding_mask`` convention). ``kv_block_size=None`` (default)
+    resolves per shape — wider KV streaming for shallow heads at long S (see
+    ``_auto_kv_block``); ``q_block_size=None`` (default) resolves per shape
+    after KV-block sizing (see LONG_KV_Q_BLOCK). Off-TPU backends run the
+    kernel in interpreter mode (slow — for tests), overridable via
+    ``interpret``.
     """
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         raise ValueError(f"expected (B, T/S, H, D) tensors, got {q.shape=} {k.shape=}")
@@ -533,7 +572,7 @@ def seq_parallel_fused_attention(
     axis: str = "seq",
     batch_axis: Optional[str] = None,
     head_axis: Optional[str] = None,
-    kv_block_size: int = DEFAULT_KV_BLOCK,
+    kv_block_size: Optional[int] = None,
     q_block_size: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> Array:

@@ -122,6 +122,45 @@ def test_auto_q_block_resolution():
     assert t_blk == 512
 
 
+def test_auto_kv_block_resolution():
+    """``kv_block_size=None`` widens KV streaming for shallow heads at long S
+    (PERF.md r3 kv sweep) and caps the q bump by the measured probs-area
+    compile boundary — deep heads and short S keep the 512 default."""
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.ops import pallas_attention as pa
+
+    def resolve(t, s, d):
+        q = jnp.zeros((1, t, 1, d), jnp.bfloat16)
+        k = jnp.zeros((1, s, 1, d), jnp.bfloat16)
+        bias = jnp.zeros((1, s), jnp.float32)
+        _, _, _, _, t_blk, s_blk, _ = pa._prepare_blocks(
+            q, k, k, bias, None, None, interpret=False
+        )
+        return t_blk, s_blk
+
+    # long-context MLM cross shape: d=16 streams 2048-wide KV blocks
+    assert resolve(256, 131072, 16) == (256, 2048)
+    # ... and the auto q bump is CAPPED by the probs-area boundary
+    # (t 1024 × s 2048 is the measured OOM; kv 2048 + q 512 measured fastest)
+    assert resolve(1024, 131072, 16) == (512, 2048)
+    # mid-depth heads (ImageNet 8-head): 1024-wide KV blocks
+    assert resolve(512, 50176, 128) == (512, 1024)
+    # deep heads keep 512 — flow encoder-cross resolution is UNCHANGED
+    # (s_blk 256 from S's divisor structure, q bump still applies)
+    assert resolve(2048, 182528, 512) == (1024, 256)
+    # short S keeps the tuned default
+    assert resolve(256, 512, 16)[1] == 512
+    # seq-parallel shard-local slices resolve on the LOCAL length
+    assert resolve(256, 131072 // 8, 16) == (256, 2048)
+    # a query count with no aligned divisor takes the full-residency
+    # t_blk = t fallback — the kv widening must shrink so t_blk·s_blk stays
+    # inside the measured probs-area boundary (904·2048 would exceed it)
+    assert resolve(904, 131072, 16) == (904, 1024)
+    # divisible T is unaffected by that bound (t_blk 512 resolves normally)
+    assert resolve(1024, 131072, 16) == (512, 2048)
+
+
 def test_fully_masked_row_uniform(rng):
     """A fully padded sequence softmaxes to uniform — XLA-path parity, no NaN."""
     b, t, s, h, d = 2, 4, 8, 1, 4
