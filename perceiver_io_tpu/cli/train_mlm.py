@@ -49,6 +49,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="decode only the masked positions, up to this many per "
                         "row (gradient-equivalent, skips most vocab-projection "
                         "FLOPs). -1 = auto (2·mask_p·seq_len), 0 = full decode")
+    g.add_argument("--fused_head", choices=["auto", "pallas", "xla", "off"],
+                   default="auto",
+                   help="fuse the vocab projection into the CE so the "
+                        "(B, K, V) logits never materialize: 'pallas' = the "
+                        "flash-CE kernel (the measured winner on TPU, "
+                        "PERF.md r3), 'xla' = chunked-scan variant, 'off' = "
+                        "unfused. auto = pallas on TPU (off under --tp "
+                        "vocab sharding and on other backends)")
     # reference per-task defaults (train_mlm.py:93-106)
     parser.set_defaults(experiment="mlm", batch_size=64, num_latents=64,
                         num_latent_channels=64, num_encoder_layers=3)
@@ -130,10 +138,23 @@ def main(argv: Optional[Sequence[str]] = None):
     capacity = args.loss_gather_capacity
     if capacity < 0:
         capacity = mlm_gather_capacity(args.max_seq_len)
-    train_step, eval_step, predict_fn = make_mlm_steps(
-        model, schedule, loss_gather_capacity=capacity or None
-    )
     mesh = common.mesh_from_args(args)
+    fused = args.fused_head
+    if fused == "auto":
+        # the flash-CE kernel is a single-device op: under tensor-parallel
+        # vocab sharding the unfused path (whose collectives GSPMD manages)
+        # stays the default (ops/pallas_ce.py docstring)
+        fused = ("pallas" if jax.default_backend() == "tpu"
+                 and mesh.shape["model"] == 1 else "off")
+    elif fused == "pallas" and mesh.shape["model"] > 1:
+        raise SystemExit(
+            "--fused_head pallas is a single-device head; with --tp > 1 the "
+            "vocab projection shards over the model axis — use auto or off"
+        )
+    train_step, eval_step, predict_fn = make_mlm_steps(
+        model, schedule, loss_gather_capacity=capacity or None,
+        fused_head={"pallas": "pallas", "xla": True, "off": False}[fused],
+    )
 
     trainer = Trainer(
         train_step,

@@ -90,7 +90,9 @@ LONG_KV_SAFE_PROBS = 1024 * 1024
 LONG_KV_S = 8192
 
 
-def _auto_kv_block(s: int, d: int, t: int, alignment: int) -> int:
+def _auto_kv_block(
+    s: int, d: int, t: int, alignment: int, q_block_size: Optional[int]
+) -> int:
     if s < LONG_KV_S:
         return DEFAULT_KV_BLOCK
     if d <= 32:
@@ -99,12 +101,17 @@ def _auto_kv_block(s: int, d: int, t: int, alignment: int) -> int:
         kv = 1024
     else:
         return DEFAULT_KV_BLOCK
-    # A query count with no aligned divisor that still fits two default
-    # blocks takes the full-residency fallback (t_blk = t, below) — the
-    # widened KV block must keep that combination inside the measured
-    # probs-area compile boundary too, not just the auto q-bump branch.
-    tb = _kv_block_size(t, DEFAULT_Q_BLOCK, alignment)
-    t_bound = t if (tb == 0 and t <= 2 * DEFAULT_Q_BLOCK) else DEFAULT_Q_BLOCK
+    # The widened KV block must keep the resolved (t_blk, s_blk) probs area
+    # inside the measured compile boundary for EVERY way t_blk can resolve:
+    # an explicit q_block_size (mirroring _prepare_blocks's resolution), and
+    # the full-residency fallback (t_blk = t when T has no aligned divisor
+    # but fits two blocks). The auto q-bump branch carries its own guard.
+    qb = DEFAULT_Q_BLOCK if q_block_size is None else q_block_size
+    tb = _kv_block_size(t, qb, alignment)
+    if tb == 0:
+        t_bound = t if t <= 2 * qb else max(qb - qb % alignment, alignment)
+    else:
+        t_bound = tb
     while kv > DEFAULT_KV_BLOCK and t_bound * kv > LONG_KV_SAFE_PROBS:
         kv //= 2
     return kv
@@ -407,7 +414,7 @@ def _prepare_blocks(q, k, v, bias, kv_block_size, q_block_size, interpret):
     # even on fully-masked rows).
     alignment = 1 if interpret else _LANES
     if kv_block_size is None:
-        kv_block_size = _auto_kv_block(s, d, t, alignment)
+        kv_block_size = _auto_kv_block(s, d, t, alignment, q_block_size)
     s_blk = _kv_block_size(s, kv_block_size, alignment)
     if s_blk == 0:
         if s <= 4 * kv_block_size:

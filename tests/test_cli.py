@@ -38,6 +38,24 @@ def test_train_img_clf(tmp_path):
     assert os.path.isdir(os.path.join(run_dir, "checkpoints"))
 
 
+def test_train_mlm_fused_head_flag(tmp_path):
+    """--fused_head pallas trains end to end (interpret mode off-TPU) and
+    --fused_head pallas under --tp vocab sharding is rejected with the
+    single-device-head explanation."""
+    args = _common(tmp_path, "mlmfh") + TINY_MODEL + [
+        "--synthetic_size", "64", "--batch_size", "16",
+        "--max_seq_len", "32", "--vocab_size", "90",
+        "--max_steps", "2", "--log_every_n_steps", "1",
+        "--fused_head", "pallas",
+    ]
+    run_dir = train_mlm.main(args)
+    rows = read_metrics(run_dir)
+    assert any("train_loss" in r for r in rows)
+
+    with pytest.raises(SystemExit, match="single-device head"):
+        train_mlm.main(args + ["--tp", "2"])
+
+
 def test_train_mlm_then_transfer(tmp_path):
     mlm_args = _common(tmp_path, "mlm") + TINY_MODEL + [
         "--synthetic_size", "96", "--batch_size", "16",

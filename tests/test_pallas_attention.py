@@ -160,6 +160,20 @@ def test_auto_kv_block_resolution():
     # divisible T is unaffected by that bound (t_blk 512 resolves normally)
     assert resolve(1024, 131072, 16) == (512, 2048)
 
+    def resolve_q(t, s, d, q_block):
+        q = jnp.zeros((1, t, 1, d), jnp.bfloat16)
+        k = jnp.zeros((1, s, 1, d), jnp.bfloat16)
+        bias = jnp.zeros((1, s), jnp.float32)
+        _, _, _, _, t_blk, s_blk, _ = pa._prepare_blocks(
+            q, k, k, bias, None, q_block, interpret=False
+        )
+        return t_blk, s_blk
+
+    # an EXPLICIT big query block bypasses the auto q-bump guard, so the kv
+    # widening itself must shrink to keep t_blk·s_blk inside the boundary
+    # (1024×2048 is the measured OOM; 1024×1024 compiles — measured 8.17 ms)
+    assert resolve_q(1024, 131072, 16, q_block=1024) == (1024, 1024)
+
 
 def test_fully_masked_row_uniform(rng):
     """A fully padded sequence softmaxes to uniform — XLA-path parity, no NaN."""
