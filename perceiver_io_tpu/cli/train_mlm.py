@@ -141,11 +141,15 @@ def main(argv: Optional[Sequence[str]] = None):
     mesh = common.mesh_from_args(args)
     fused = args.fused_head
     if fused == "auto":
-        # the flash-CE kernel is a single-device op: under tensor-parallel
-        # vocab sharding the unfused path (whose collectives GSPMD manages)
-        # stays the default (ops/pallas_ce.py docstring)
+        # the flash-CE kernel is a single-device op (ops/pallas_ce.py):
+        # auto enables it only on a single-device TPU mesh — under ANY
+        # multi-chip sharding GSPMD cannot partition the pallas_call (it
+        # would all-gather the gathered-decode features on every chip),
+        # so sharded meshes keep the unfused head whose collectives GSPMD
+        # manages. Explicit 'pallas' overrides for dp/sp (correct, possibly
+        # slower); tp is rejected below (vocab sharding conflicts).
         fused = ("pallas" if jax.default_backend() == "tpu"
-                 and mesh.shape["model"] == 1 else "off")
+                 and mesh.size == 1 else "off")
     elif fused == "pallas" and mesh.shape["model"] > 1:
         raise SystemExit(
             "--fused_head pallas is a single-device head; with --tp > 1 the "

@@ -81,21 +81,20 @@ LONG_KV_SAFE_PROBS = 1024 * 1024
 
 # Auto KV-block sizing (``kv_block_size=None``): streaming more keys per
 # sequential grid step amortizes per-step kernel overhead, and how much VMEM
-# that costs scales with d. Measured at long-S shapes (PERF.md r3 kv sweep,
-# fwd+bwd): d=16 S=131k kv 512→2048 is 3.47→2.45 ms (and 2048 + q capped at
-# 512 beats 512 + q 1024 everywhere tried); d=128 S=50k kv 512→1024 is
-# 8.55→6.44 ms (2048 no better); d=512 kv ≥ 1024 is the flow sweep's
-# measured scoped-VMEM OOM, so deep heads stay at 512. Short S keeps the
-# 512 default (the S < 8192 regimes were tuned in the original benches).
-LONG_KV_S = 8192
+# that costs scales with d. Measured (PERF.md r3 kv sweep, fwd+bwd): d=16
+# S=131k kv 512→2048 is 3.47→2.45 ms (and 2048 + q capped at 512 beats
+# 512 + q 1024 everywhere tried); d=64 S=2048 (flow-self) 1.34→0.98 ms;
+# d=128 S=50k kv 512→1024 is 8.55→6.44 ms (2048 no better); d=512 kv ≥ 1024
+# is the flow sweep's measured scoped-VMEM OOM, so deep heads stay at 512.
+# Every tier keeps the KV-side footprint s_blk·d ≤ the 2048·64 = 131072
+# envelope all the measurements share; S shorter than the block resolves to
+# full-dim/divisor blocks exactly as an explicit request would.
 
 
 def _auto_kv_block(
     s: int, d: int, t: int, alignment: int, q_block_size: Optional[int]
 ) -> int:
-    if s < LONG_KV_S:
-        return DEFAULT_KV_BLOCK
-    if d <= 32:
+    if d <= 64:
         kv = 2048
     elif d <= 128:
         kv = 1024
@@ -112,6 +111,14 @@ def _auto_kv_block(
         t_bound = t if t <= 2 * qb else max(qb - qb % alignment, alignment)
     else:
         t_bound = tb
+    if (_kv_block_size(s, kv, alignment) == 0
+            and 4 * DEFAULT_KV_BLOCK < s <= 4 * kv):
+        # S has no lane-aligned divisor AND sits inside the widened block's
+        # full-residency fallback window (s <= 4·kv ⇒ s_blk = s, unmeasured
+        # probs/VMEM territory) but outside the default's — keep the tuned
+        # 512 path there; larger awkward S takes the pad-to-block path and
+        # keeps the widened block
+        return DEFAULT_KV_BLOCK
     while kv > DEFAULT_KV_BLOCK and t_bound * kv > LONG_KV_SAFE_PROBS:
         kv //= 2
     return kv

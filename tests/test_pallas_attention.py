@@ -149,8 +149,20 @@ def test_auto_kv_block_resolution():
     # deep heads keep 512 — flow encoder-cross resolution is UNCHANGED
     # (s_blk 256 from S's divisor structure, q bump still applies)
     assert resolve(2048, 182528, 512) == (1024, 256)
-    # short S keeps the tuned default
+    # short S resolves to its full dim / divisor exactly as an explicit
+    # request would (no widening possible at S = 512)
     assert resolve(256, 512, 16)[1] == 512
+    # mid-S shallow shapes widen too: flow-self (d=64, S=2048) streams the
+    # whole KV in one block per grid step (measured 1.34 → 0.98 ms)
+    assert resolve(2048, 2048, 64) == (512, 2048)
+    # S with no lane-aligned divisor INSIDE the widened full-residency
+    # window keeps the tuned 512 padding path (a widened block would pull
+    # s_blk = s = 7000 full residency into unmeasured probs territory) ...
+    t_blk, s_blk = resolve(256, 7000, 16)
+    assert s_blk <= 512
+    # ... but beyond that window (s > 4·kv) the pad-to-block path is safe
+    # and keeps the widened block
+    assert resolve(256, 12000, 16)[1] == 2048
     # seq-parallel shard-local slices resolve on the LOCAL length
     assert resolve(256, 131072 // 8, 16) == (256, 2048)
     # a query count with no aligned divisor takes the full-residency
