@@ -111,16 +111,18 @@ def _auto_kv_block(
         t_bound = t if t <= 2 * qb else max(qb - qb % alignment, alignment)
     else:
         t_bound = tb
-    if (_kv_block_size(s, kv, alignment) == 0
-            and 4 * DEFAULT_KV_BLOCK < s <= 4 * kv):
-        # S has no lane-aligned divisor AND sits inside the widened block's
-        # full-residency fallback window (s <= 4·kv ⇒ s_blk = s, unmeasured
-        # probs/VMEM territory) but outside the default's — keep the tuned
-        # 512 path there; larger awkward S takes the pad-to-block path and
-        # keeps the widened block
-        return DEFAULT_KV_BLOCK
     while kv > DEFAULT_KV_BLOCK and t_bound * kv > LONG_KV_SAFE_PROBS:
         kv //= 2
+    if (kv > DEFAULT_KV_BLOCK
+            and _kv_block_size(s, kv, alignment) == 0
+            and 4 * DEFAULT_KV_BLOCK < s <= 4 * kv):
+        # Checked against the POST-shrink kv (the probs loop above can halve
+        # it, changing which divisors exist): S with no block-aligned divisor
+        # that sits inside the widened block's full-residency fallback window
+        # (s <= 4·kv ⇒ s_blk = s, unmeasured probs/VMEM territory) but
+        # outside the default's keeps the tuned 512 path; larger awkward S
+        # takes the pad-to-block path and keeps the widened block.
+        return DEFAULT_KV_BLOCK
     return kv
 
 

@@ -163,6 +163,13 @@ def test_auto_kv_block_resolution():
     # ... but beyond that window (s > 4·kv) the pad-to-block path is safe
     # and keeps the widened block
     assert resolve(256, 12000, 16)[1] == 2048
+    # the guard evaluates against the POST-shrink kv: t=904 forces the probs
+    # loop to halve 2048 -> 1024, and 2816 has a divisor for 2048 (1408) but
+    # none for 1024 — the shrunk block's full-residency window would pull
+    # s_blk = 2816 (2.43M-element probs, past the measured OOM) without it
+    t_blk, s_blk = resolve(904, 2816, 16)
+    assert t_blk * s_blk <= pa.LONG_KV_SAFE_PROBS * 2  # old default path
+    assert s_blk <= 512
     # seq-parallel shard-local slices resolve on the LOCAL length
     assert resolve(256, 131072 // 8, 16) == (256, 2048)
     # a query count with no aligned divisor takes the full-residency

@@ -31,6 +31,9 @@ SHAPES = [
     # (many queries, few keys) and ImageNet self-attn at batch >= 16
     ("flow-dec-cross", 2, 182528, 2048, 1, 512),
     ("in-self-b16", 16, 512, 512, 8, 128),
+    # long-context MLM encoder cross (auto-kv streams 2048-wide blocks)
+    ("mlm-32k", 2, 256, 32768, 4, 16),
+    ("mlm-131k", 1, 256, 131072, 4, 16),
 ]
 
 
