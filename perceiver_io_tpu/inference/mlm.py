@@ -12,7 +12,7 @@ import jax
 import numpy as np
 
 from perceiver_io_tpu.data.tokenizer import MASK_TOKEN, PAD_TOKEN, WordPieceTokenizer
-from perceiver_io_tpu.inference.predictor import Predictor
+from perceiver_io_tpu.inference.predictor import Predictor, bucket_size
 
 Array = jax.Array
 
@@ -59,6 +59,18 @@ class MLMPredictor:
         self._predictor = Predictor.for_model(
             model, params, max_batch=max_batch, masking=False
         )
+
+        # gathered decode: logits at explicit positions only — (B, K, vocab)
+        # instead of (B, L, vocab), which at long L is a GB-scale tensor for
+        # a handful of [MASK] slots. K is bucketed to powers of two by the
+        # caller, so each (batch-bucket, K-bucket) pair compiles once.
+        def gathered_apply(p, token_ids, pad_mask, positions):
+            return model.apply(
+                {"params": p}, token_ids, pad_mask, masking=False,
+                deterministic=True, positions=positions,
+            )
+
+        self._gathered = Predictor(gathered_apply, params, max_batch=max_batch)
 
     @classmethod
     def from_checkpoint(
@@ -110,14 +122,33 @@ class MLMPredictor:
 
     def fill_masks(self, texts: Sequence[str], k: int = 5) -> List[List[List[str]]]:
         """Per text, per ``[MASK]`` occurrence (in order), the top-k predicted
-        tokens (reference ``train_mlm.py:24-35`` semantics, all positions)."""
-        logits, token_ids = self.logits(texts)
+        tokens (reference ``train_mlm.py:24-35`` semantics).
+
+        Decodes ONLY the mask positions (the decoder's gathered decode —
+        each output query attends to the latents independently, so these are
+        exactly the corresponding rows of the full decode): the device never
+        builds the (B, L, vocab) logits tensor, which at long L dwarfs the
+        handful of positions actually needed. The position count is bucketed
+        to powers of two so compiles stay bounded."""
+        token_ids, pad_mask = encode_masked_texts(
+            self.tokenizer, texts, self.max_seq_len
+        )
+        mask_pos = [np.nonzero(row == self.mask_id)[0] for row in token_ids]
+        n_max = max((len(p) for p in mask_pos), default=0)
+        if n_max == 0:
+            return [[] for _ in texts]
+        cap = bucket_size(n_max, self.max_seq_len)  # cap >= n_max always
+        # filler slots repeat position 0; their logits are never read
+        positions = np.zeros((len(texts), cap), np.int32)
+        for row, pos in enumerate(mask_pos):
+            positions[row, : len(pos)] = pos
+        logits, _ = self._gathered(token_ids, pad_mask, positions)
+        logits = np.asarray(logits, np.float32)
         out: List[List[List[str]]] = []
-        for row in range(len(texts)):
-            positions = np.nonzero(token_ids[row] == self.mask_id)[0]
+        for row, pos in enumerate(mask_pos):
             row_preds = []
-            for pos in positions:
-                top = np.argsort(-logits[row, pos])[:k]
+            for slot in range(len(pos)):
+                top = np.argsort(-logits[row, slot])[:k]
                 row_preds.append([self.tokenizer.id_to_token(int(t)) for t in top])
             out.append(row_preds)
         return out

@@ -242,9 +242,18 @@ class PerceiverMLM(nn.Module):
         deterministic: bool = True,
         loss_gather_capacity: Optional[int] = None,
         return_features: bool = False,
+        positions: Optional[Array] = None,
     ) -> Tuple[Array, Optional[Array]]:
         """``loss_gather_capacity``: when set (and ``masking=True``), decode
         only the masked positions — up to that many per row — instead of all L.
+
+        ``positions`` (B, K) int, ``masking=False`` only: decode ONLY these
+        positions and return (B, K, vocab) logits (labels None) — the
+        inference-side counterpart of the gather decode (each output query
+        attends to the latents independently, so this is exactly the
+        corresponding rows of the full decode). Long-context fill-mask needs
+        this: a full (B, L, vocab) decode at L = 32k+ is a GB-scale tensor
+        for a handful of [MASK] positions.
 
         CE ignores label-(-100) positions entirely, and un-decoded output
         queries receive zero gradient in the full computation too (their logits
@@ -257,6 +266,12 @@ class PerceiverMLM(nn.Module):
         """
         _, l = x_input.shape
 
+        if positions is not None and masking:
+            raise ValueError(
+                "positions= is an inference-path argument (masking=False); "
+                "training's masked-position gather is loss_gather_capacity="
+            )
+
         if masking:
             key = self.make_rng("masking")
             x_masked, x_labels = self.masking(key, x_input, pad_mask)
@@ -265,6 +280,13 @@ class PerceiverMLM(nn.Module):
             x_labels = None
 
         x_latent = self.encoder(x_masked, pad_mask=pad_mask, deterministic=deterministic)
+
+        if positions is not None:
+            x_out = self.decoder(
+                x_latent, deterministic=deterministic, positions=positions,
+                return_features=return_features,
+            )
+            return x_out, None
 
         if masking and loss_gather_capacity is not None:
             # First-K masked indices per row (lax.top_k is index-stable), then
@@ -276,12 +298,13 @@ class PerceiverMLM(nn.Module):
             # query count the unclamped full-decode branch would cost.
             capacity = min(loss_gather_capacity, l)
             valid = (x_labels != IGNORE_LABEL).astype(jnp.float32)
-            _, positions = jax.lax.top_k(valid, capacity)
+            _, gather_positions = jax.lax.top_k(valid, capacity)
             x_out = self.decoder(
-                x_latent, deterministic=deterministic, positions=positions,
+                x_latent, deterministic=deterministic,
+                positions=gather_positions,
                 return_features=return_features,
             )
-            return x_out, jnp.take_along_axis(x_labels, positions, axis=1)
+            return x_out, jnp.take_along_axis(x_labels, gather_positions, axis=1)
 
         x_out = self.decoder(
             x_latent, deterministic=deterministic,

@@ -222,3 +222,37 @@ def test_export_roundtrip(rng, tmp_path):
     restored = load_exported(path)
     out = np.asarray(restored(x))
     np.testing.assert_allclose(out, direct, atol=1e-5)
+
+
+def test_fill_masks_gathered_matches_full_decode():
+    """The gathered fill-mask path (positions= decode) must produce exactly
+    the predictions the full (B, L, vocab) decode implies — across rows with
+    different mask counts (capacity bucketing + filler slots) and an
+    unmasked row."""
+    tok = _word_tokenizer()
+    vocab = tok.get_vocab_size()
+    model = _tiny_mlm(vocab)
+    texts = [
+        "the [MASK] was [MASK]",     # 2 masks
+        "[MASK] movie great the a",  # 1 mask
+        "no mask here",              # 0 masks
+    ]
+    ids, pad = encode_masked_texts(tok, texts, 8)
+    params = model.init(
+        {"params": jax.random.key(3), "masking": jax.random.key(4)},
+        jnp.asarray(ids), jnp.asarray(pad),
+    )["params"]
+    pred = MLMPredictor(model, params, tok, max_seq_len=8, max_batch=4)
+
+    got = pred.fill_masks(texts, k=3)
+
+    # reference: full decode via .logits(), argsorted at the mask positions
+    logits, token_ids = pred.logits(texts)
+    mask_id = tok.token_to_id(MASK_TOKEN)
+    for row, text in enumerate(texts):
+        positions = np.nonzero(token_ids[row] == mask_id)[0]
+        assert len(got[row]) == len(positions)
+        for slot, pos in enumerate(positions):
+            top = np.argsort(-logits[row, pos])[:3]
+            want = [tok.id_to_token(int(t)) for t in top]
+            assert got[row][slot] == want, (row, slot)
