@@ -80,16 +80,26 @@ def make_predict_hook(predict_fn, collator, samples: Sequence[str], k: int):
     mask_id = tokenizer.token_to_id(MASK_TOKEN)
     token_ids, pad_mask = encode_masked_samples(collator, samples)
     jit_predict = jax.jit(predict_fn)
+    # The hook logs top-k at the FIRST mask position per sample (reference
+    # semantics), and the sample token ids are fixed for the whole run — so
+    # decode exactly those positions instead of all max_seq_len: at long
+    # context the full (B, L, vocab) logits would be a GB-scale fetch per
+    # evaluation. Rows without a mask decode position 0 and are skipped.
+    has_mask = (token_ids == mask_id).any(axis=1)
+    first_mask = np.where(
+        has_mask, (token_ids == mask_id).argmax(axis=1), 0
+    ).astype(np.int32)[:, None]
 
     def hook(state, logger, step):
-        logits = np.asarray(jax.device_get(jit_predict(state.params, token_ids, pad_mask)))
+        logits = np.asarray(jax.device_get(
+            jit_predict(state.params, token_ids, pad_mask, first_mask)
+        ))
         lines = []
         for row in range(len(samples)):
-            mask_pos = np.nonzero(token_ids[row] == mask_id)[0]
-            if len(mask_pos) == 0:
+            if not has_mask[row]:
                 continue
             # top-k over the first mask position, as the reference logs
-            top = np.argsort(-logits[row, mask_pos[0]])[:k]
+            top = np.argsort(-logits[row, 0])[:k]
             filled = [
                 samples[row].replace(MASK_TOKEN, f"**{tokenizer.id_to_token(int(t))}**", 1)
                 for t in top

@@ -115,7 +115,8 @@ def make_mlm_steps(
     ``loss_gather_capacity``: decode only the masked positions (up to this many
     per row) in train/eval — gradient-equivalent to the full decode but skips
     most of the dominant vocab-projection FLOPs (see ``PerceiverMLM``). The
-    predict path always decodes every position.
+    predict path decodes every position unless the caller passes explicit
+    ``positions`` (see ``predict_fn``).
 
     ``fused_head``: fuse the vocab projection into the CE so the (B, K, V)
     logits never materialize in train/eval.
@@ -174,9 +175,14 @@ def make_mlm_steps(
         loss = loss_fn(state.params, batch, {"masking": key}, True)
         return {"loss": loss}
 
-    def predict_fn(params, token_ids, pad_mask):
+    def predict_fn(params, token_ids, pad_mask, positions=None):
+        # positions (B, K): decode only those rows of the output-query array
+        # — (B, K, vocab) logits instead of (B, L, vocab). The prediction
+        # hook passes its (static) [MASK] positions so sample prediction at
+        # long context never builds or fetches the full logits tensor.
         logits, _ = model.apply(
-            {"params": params}, token_ids, pad_mask, masking=False
+            {"params": params}, token_ids, pad_mask, masking=False,
+            positions=positions,
         )
         return logits
 
