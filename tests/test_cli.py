@@ -38,6 +38,23 @@ def test_train_img_clf(tmp_path):
     assert os.path.isdir(os.path.join(run_dir, "checkpoints"))
 
 
+def test_train_mlm_hybrid_dcn_mesh(tmp_path):
+    """--dcn_dp 2 --tp 2 trains end to end on the 8-device CPU mesh (the
+    hybrid ICI×DCN layout is placement-only — the run must behave exactly
+    like the flat mesh)."""
+    run_dir = train_mlm.main(
+        _common(tmp_path, "mlmdcn") + TINY_MODEL + [
+            "--synthetic_size", "64", "--batch_size", "16",
+            "--max_seq_len", "32", "--vocab_size", "90",
+            "--max_steps", "3", "--log_every_n_steps", "1",
+            "--tp", "2", "--dcn_dp", "2",
+        ]
+    )
+    rows = read_metrics(run_dir)
+    losses = [r["train_loss"] for r in rows if "train_loss" in r]
+    assert losses and np.isfinite(losses).all()
+
+
 def test_train_mlm_fused_head_flag(tmp_path):
     """--fused_head pallas trains end to end (interpret mode off-TPU) and
     --fused_head pallas under --tp vocab sharding is rejected with the
