@@ -38,7 +38,12 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--dataset_name", default="imagenet",
                    help="subdirectory of --root holding the train/val tree")
     g.add_argument("--image_size", type=int, default=224)
-    g.add_argument("--batch_size", type=int, default=64)
+    g.add_argument("--batch_size", type=int, default=64,
+                   help="GLOBAL batch; the default is sized for a v5e-8 "
+                        "(8/chip under dp). One v5e chip fits batch 8 at "
+                        "224² (batch 64 OOMs its 16 GB HBM); batch scaling "
+                        "is flat b8-b32 anyway — the step is compute-bound "
+                        "(PERF.md)")
     g.add_argument("--num_workers", type=int, default=8,
                    help="JPEG-decode threads per host")
     g.add_argument("--synthetic", action="store_true")
