@@ -55,8 +55,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(B, K, V) logits never materialize: 'pallas' = the "
                         "flash-CE kernel (the measured winner on TPU, "
                         "PERF.md r3), 'xla' = chunked-scan variant, 'off' = "
-                        "unfused. auto = pallas on TPU (off under --tp "
-                        "vocab sharding and on other backends)")
+                        "unfused. auto = pallas only on a single-device TPU "
+                        "mesh (off under ANY multi-chip sharding — dp/sp/tp "
+                        "— and on other backends)")
     # reference per-task defaults (train_mlm.py:93-106)
     parser.set_defaults(experiment="mlm", batch_size=64, num_latents=64,
                         num_latent_channels=64, num_encoder_layers=3)
