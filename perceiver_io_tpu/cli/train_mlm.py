@@ -32,6 +32,28 @@ DEFAULT_PREDICT_SAMPLES = (
 )
 
 
+# Width/compute DEFAULTS per --preset, applied post-parse by apply_preset:
+# the parser defaults the affected args to None (a sentinel), so explicit
+# flags, resume's hparams-as-defaults layering, and the preset compose
+# without any dependence on global sys.argv. attn_impl 'xla' under
+# flagship_tpu is the measured-best at TPU widths (models/presets.py
+# flagship_tpu_mlm).
+PRESET_DEFAULTS = {
+    "reference": {"num_latents": 64, "num_latent_channels": 64,
+                  "attn_impl": "auto"},
+    "flagship_tpu": {"num_latents": 256, "num_latent_channels": 512,
+                     "attn_impl": "xla"},
+}
+
+
+def apply_preset(args: argparse.Namespace) -> argparse.Namespace:
+    """Fill any still-None width/compute args from the chosen preset."""
+    for key, value in PRESET_DEFAULTS[args.preset].items():
+        if getattr(args, key) is None:
+            setattr(args, key, value)
+    return args
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     common.add_trainer_args(parser)
@@ -41,6 +63,15 @@ def build_parser() -> argparse.ArgumentParser:
     common.add_optimizer_args(parser)
     common.add_imdb_args(parser)
     g = parser.add_argument_group("task (MLM)")
+    g.add_argument("--preset", choices=["reference", "flagship_tpu"],
+                   default="reference",
+                   help="model-width preset: 'reference' = the GPU-sized "
+                        "train_mlm defaults (64 latents x 64 channels, head "
+                        "depth 16); 'flagship_tpu' = the same recipe at "
+                        "TPU-native widths (256 latents x 512 channels, head "
+                        "depth 128 — models/presets.py flagship_tpu_mlm). "
+                        "Explicit --num_latents/--num_latent_channels still "
+                        "override the preset")
     g.add_argument("--num_predictions", "--predict_k", type=int, default=5,
                    help="top-k predictions logged per [MASK] position "
                         "(--predict_k is the reference's spelling)")
@@ -58,9 +89,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "unfused. auto = pallas only on a single-device TPU "
                         "mesh (off under ANY multi-chip sharding — dp/sp/tp "
                         "— and on other backends)")
-    # reference per-task defaults (train_mlm.py:93-106)
-    parser.set_defaults(experiment="mlm", batch_size=64, num_latents=64,
-                        num_latent_channels=64, num_encoder_layers=3)
+    # reference per-task defaults (train_mlm.py:93-106); the preset-affected
+    # args default to the None sentinel apply_preset resolves
+    parser.set_defaults(experiment="mlm", batch_size=64, num_latents=None,
+                        num_latent_channels=None, attn_impl=None,
+                        num_encoder_layers=3)
     return parser
 
 
@@ -113,7 +146,7 @@ def make_predict_hook(predict_fn, collator, samples: Sequence[str], k: int):
 
 
 def main(argv: Optional[Sequence[str]] = None):
-    args = common.parse_with_resume(build_parser(), argv)
+    args = apply_preset(common.parse_with_resume(build_parser(), argv))
     common.maybe_initialize_distributed(args)
     # after distributed init: the multi-host guard reads jax.process_count()
     common.validate_bucket_args(args)
@@ -158,9 +191,13 @@ def main(argv: Optional[Sequence[str]] = None):
         # would all-gather the gathered-decode features on every chip),
         # so sharded meshes keep the unfused head whose collectives GSPMD
         # manages. Explicit 'pallas' overrides for dp/sp (correct, possibly
-        # slower); tp is rejected below (vocab sharding conflicts).
+        # slower); tp is rejected below (vocab sharding conflicts). The
+        # width gate is measured: at C=64 the kernel is +6.1% (PERF.md r3),
+        # at C=512 it's -2% (the K=512-deep head matmuls are MXU-efficient,
+        # so skipping the logits traffic no longer pays — r4 roofline A/B).
         fused = ("pallas" if jax.default_backend() == "tpu"
-                 and mesh.size == 1 else "off")
+                 and mesh.size == 1
+                 and args.num_latent_channels <= 128 else "off")
     elif fused == "pallas" and mesh.shape["model"] > 1:
         raise SystemExit(
             "--fused_head pallas is a single-device head; with --tp > 1 the "

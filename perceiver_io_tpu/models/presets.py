@@ -18,6 +18,48 @@ from perceiver_io_tpu.models.perceiver import (
 from perceiver_io_tpu.ops.masking import TextMasking
 
 
+def flagship_tpu_mlm(
+    vocab_size: int = 10003,
+    max_seq_len: int = 512,
+    num_latents: int = 256,
+    num_channels: int = 512,
+    num_layers: int = 3,
+    num_self_attention_layers_per_block: int = 6,
+    dtype: jnp.dtype = jnp.bfloat16,
+    attn_impl: str = "xla",
+    remat: bool = False,
+) -> PerceiverMLM:
+    """The MLM recipe at TPU-native widths (BASELINE.md north-star, closed
+    from the other end).
+
+    ``attn_impl`` defaults to 'xla' rather than 'auto': the area rule would
+    route the (64, 4, 256, 512, d128) encoder cross to the fused kernel,
+    which wins 1.85x at KERNEL level but measures 43.16 vs 42.08 ms END TO
+    END (roofline device trace, r4) — XLA overlaps the logits traffic it
+    materializes, the same dilution as PERF.md negative (10b).
+
+    Identical recipe *shape* to the reference ``train_mlm.py:93-106`` — same
+    tokenizer, masking, 512-token sequences, 256 latents, 3 encoder layers x
+    (cross-attention + 6-layer self-attention block), text in/out adapters —
+    but with the channel width raised from the reference's GPU-sized C=64
+    (head depth 16, which caps MXU efficiency at ~12.5%; PERF.md's d=16
+    structural bound) to C=512 with the default 4 heads, i.e. head depth 128:
+    the full MXU contraction depth, the same head geometry that measures
+    65.5% MFU on the ImageNet paper config. This is what the MLM task looks
+    like when sized for the hardware instead of for 8 GB GPUs."""
+    return flagship_mlm(
+        vocab_size=vocab_size,
+        max_seq_len=max_seq_len,
+        num_latents=num_latents,
+        num_channels=num_channels,
+        num_layers=num_layers,
+        num_self_attention_layers_per_block=num_self_attention_layers_per_block,
+        dtype=dtype,
+        attn_impl=attn_impl,
+        remat=remat,
+    )
+
+
 def flagship_mlm(
     vocab_size: int = 10003,
     max_seq_len: int = 512,

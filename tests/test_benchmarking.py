@@ -72,3 +72,24 @@ def test_flagship_preset_matches_graft_entry():
     assert jax.tree_util.tree_structure(p1) == jax.tree_util.tree_structure(p2)
     for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_scanned_step_cost_analysis_is_per_step():
+    """XLA cost analysis counts a lax.scan body ONCE regardless of trip
+    count, so the K-step scanned executable's flops are PER-STEP flops —
+    the contract Trainer._maybe_compute_flops relies on (it must NOT divide
+    by K; dividing made the in-loop MFU metric K x too low, r4)."""
+    from perceiver_io_tpu.training.steps import make_scanned_step
+    from perceiver_io_tpu.utils.profiling import compiled_flops
+
+    train_step, state, batch = _tiny_setup()
+    single = compiled_flops(jax.jit(train_step), state, batch)
+
+    scanned = make_scanned_step(train_step)
+    for k in (2, 4):
+        stacked = {key: jnp.stack([v] * k) for key, v in batch.items()}
+        k_flops = compiled_flops(jax.jit(scanned), state, stacked)
+        assert single is not None and k_flops is not None
+        # identical body => identical per-step count (ratio 1, not K); allow
+        # a few % for scan plumbing
+        assert abs(k_flops / single - 1.0) < 0.05, (k, k_flops, single)

@@ -213,3 +213,33 @@ def test_all_parsers_build_and_render_help():
         for flag in ("--dp", "--tp", "--sp", "--zero", "--multihost",
                      "--resume", "--attn_impl", "--dtype"):
             assert flag in help_text, f"{mod.__name__} missing {flag}"
+
+
+def test_mlm_preset_flagship_tpu_defaults():
+    """--preset flagship_tpu moves the width/compute DEFAULTS (256 latents x
+    512 channels, attn_impl xla — models/presets.py flagship_tpu_mlm) while
+    explicit flags still override the preset. Resolution is post-parse
+    (apply_preset over None sentinels), so it composes with resume's
+    hparams-as-defaults layering and never reads global sys.argv."""
+    from perceiver_io_tpu.cli import train_mlm
+
+    def parse(argv):
+        return train_mlm.apply_preset(
+            train_mlm.build_parser().parse_args(argv))
+
+    ref = parse([])
+    assert (ref.num_latents, ref.num_latent_channels) == (64, 64)
+    assert ref.attn_impl == "auto"
+
+    args = parse(["--preset", "flagship_tpu"])
+    assert (args.num_latents, args.num_latent_channels) == (256, 512)
+    assert args.attn_impl == "xla"
+    # the recipe shape is untouched: reference batch/seq/layer defaults
+    assert (args.batch_size, args.max_seq_len) == (64, 512)
+    assert (args.num_encoder_layers,
+            args.num_self_attention_layers_per_block) == (3, 6)
+
+    args = parse(["--preset", "flagship_tpu", "--num_latent_channels", "128",
+                  "--attn_impl", "auto"])
+    assert (args.num_latents, args.num_latent_channels) == (256, 128)
+    assert args.attn_impl == "auto"

@@ -272,3 +272,28 @@ def test_mlm_gather_capacity_helper():
     assert mlm_gather_capacity(512) % 32 == 0
     assert mlm_gather_capacity(24) == 24  # capped at seq_len... still ≥ 32 rule
     assert mlm_gather_capacity(4096, 0.15) >= int(2 * 0.15 * 4096)
+
+
+def test_flagship_tpu_preset_shapes():
+    """The TPU-widths preset keeps the reference recipe SHAPE (3 encoder
+    layers x 6 self-attention layers, shared layer_n, text in/out adapters)
+    and only widens: 256 latents x 512 channels, 4 heads => head depth 128
+    (models/presets.py flagship_tpu_mlm; the BASELINE.md north-star closed
+    at TPU-native widths)."""
+    from perceiver_io_tpu.models.presets import flagship_tpu_mlm
+
+    model = flagship_tpu_mlm(vocab_size=97, max_seq_len=32, dtype=jnp.float32)
+    tok = jnp.zeros((1, 32), jnp.int32)
+    variables = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        tok, jnp.zeros((1, 32), bool),
+    )
+    params = variables["params"]
+    assert params["encoder"]["latent"].shape == (256, 512)
+    sa = params["encoder"]["layer_n"]["self_attention_block"]
+    assert sorted(sa) == [f"layer_{i}" for i in range(6)]
+    q = sa["layer_0"]["self_attention"]["attention"]["q_proj"]["kernel"]
+    assert q.shape == (512, 512)  # 4 heads x depth 128 (full MXU contraction)
+    assert model.encoder.num_cross_attention_heads == 4
+    # 3 encoder layers = layer_1 + shared layer_n applied twice
+    assert model.encoder.num_layers == 3

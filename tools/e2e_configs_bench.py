@@ -69,16 +69,12 @@ def _image_classifier(image_shape, num_classes, latents, channels, blocks,
     )
 
 
-def config_mlm():
-    """Flagship IMDB MLM (512 seq, 256x64 latents, 3x6 layers, batch 64).
-    Matches bench.py's defaults (attn_impl='xla', gather decode, fused
-    flash-CE head on TPU). PIT_E2E_HEAD overrides the head
-    ('pallas'|'xla'|'none' — 'none' also feeds hbm_roofline's MFU-numerator
-    build, where cost analysis must see the head's flops)."""
-    from perceiver_io_tpu.models.presets import flagship_mlm
-
-    vocab, seq, b = 10003, 512, 64
-    model = flagship_mlm(dtype=DTYPE, attn_impl=ATTN_IMPL or "xla")
+def _mlm_config(model_factory, batch_size: int, default_head: str):
+    """Shared MLM bench recipe (synthetic batch, gather decode, PIT_E2E_HEAD
+    override: 'pallas'|'xla'|'none' — 'none' also feeds hbm_roofline's
+    MFU-numerator build, where cost analysis must see the head's flops)."""
+    vocab, seq, b = 10003, 512, batch_size
+    model = model_factory(dtype=DTYPE, attn_impl=ATTN_IMPL or "xla")
     batch = {
         "token_ids": jnp.asarray(rng.integers(3, vocab, (b, seq)).astype(np.int32)),
         "pad_mask": jnp.zeros((b, seq), bool),
@@ -87,15 +83,38 @@ def config_mlm():
         {"params": jax.random.key(0), "masking": jax.random.key(1)},
         batch["token_ids"], batch["pad_mask"],
     )
-    head = os.environ.get(
-        "PIT_E2E_HEAD", "pallas" if jax.default_backend() == "tpu" else "none"
-    )
+    head = os.environ.get("PIT_E2E_HEAD", default_head)
     fused_head = {"pallas": "pallas", "xla": True, "none": False}[head]
     train_step, _, _ = make_mlm_steps(
         model, loss_gather_capacity=mlm_gather_capacity(seq),
         fused_head=fused_head,
     )
     return variables, train_step, batch, b
+
+
+def config_mlm():
+    """Flagship IMDB MLM (512 seq, 256x64 latents, 3x6 layers, batch 64).
+    Matches bench.py's defaults (attn_impl='xla', gather decode, fused
+    flash-CE head on TPU)."""
+    from perceiver_io_tpu.models.presets import flagship_mlm
+
+    default_head = "pallas" if jax.default_backend() == "tpu" else "none"
+    return _mlm_config(flagship_mlm, 64, default_head)
+
+
+def config_mlm_tpu():
+    """The MLM recipe at TPU-native widths (C=512, head depth 128 — the
+    ``flagship_tpu_mlm`` preset; everything else identical to config_mlm).
+    PIT_MLM_TPU_BATCH overrides the batch (default 64, the reference's —
+    b128 measured WORSE: 130.0 ms = 34.0% MFU vs b64's 53.6%). The UNFUSED
+    head is the default here (roofline A/B, r4: unfused 41.26 ms / 53.6%
+    MFU vs flash-CE 42.08 / 52.6% — the K=512-deep head matmuls are
+    MXU-efficient, so saving the logits traffic no longer pays, unlike the
+    d=16 flagship where the kernel is +6.1%)."""
+    from perceiver_io_tpu.models.presets import flagship_tpu_mlm
+
+    b = int(os.environ.get("PIT_MLM_TPU_BATCH", "64"))
+    return _mlm_config(flagship_tpu_mlm, b, "none")
 
 
 def config_seqclf():
@@ -214,6 +233,7 @@ def config_multimodal():
 
 CONFIGS = {
     "mlm": config_mlm,
+    "mlm_tpu": config_mlm_tpu,
     "seqclf": config_seqclf,
     "mnist": config_mnist,
     "imagenet": config_imagenet,
