@@ -42,7 +42,8 @@ def add_model_args(parser: argparse.ArgumentParser) -> None:
 def add_optimizer_args(parser: argparse.ArgumentParser) -> None:
     g = parser.add_argument_group("optimizer")
     g.add_argument("--optimizer",
-                   choices=("Adam", "AdamW", "SGD", "RMSprop", "Adagrad"),
+                   choices=("Adam", "AdamW", "SGD", "RMSprop", "Adagrad",
+                            "Adamax", "NAdam", "RAdam"),
                    default="Adam",
                    help="torch.optim name (the reference resolves any name "
                         "via getattr; these are mapped to optax with torch's "

@@ -3,9 +3,10 @@
 Mirrors the reference optimizer surface (``lightning.py:50-79``): the
 reference resolves ``--optimizer`` with ``getattr(torch.optim, name)``
 (``lightning.py:60``), so any torch optimizer name works from its CLI. Here
-the common names — Adam, AdamW, SGD, RMSprop, Adagrad — map to optax with
-torch's exact update semantics; unknown names raise the same clear error as
-before (a silent near-miss optimizer is worse than a loud gap).
+the common names — Adam, AdamW, SGD, RMSprop, Adagrad, Adamax, NAdam,
+RAdam — map to optax with torch's exact update semantics; unknown names
+raise a loud error listing the supported set (a silent near-miss optimizer
+is worse than a loud gap).
 
 Semantic parity notes:
 
@@ -78,7 +79,8 @@ def torch_one_cycle_schedule(
 class OptimizerConfig:
     """Reference optimizer argparse group (``lightning.py:50-57``)."""
 
-    optimizer: str = "Adam"  # 'Adam' | 'AdamW' | 'SGD' | 'RMSprop' | 'Adagrad'
+    optimizer: str = "Adam"  # any name make_optimizer maps (Adam, AdamW, SGD,
+    # RMSprop, Adagrad, Adamax, NAdam, RAdam — torch-exact semantics each)
     learning_rate: float = 1e-3
     weight_decay: float = 0.0
     one_cycle_lr: bool = False
@@ -123,6 +125,145 @@ def _scale_by_adagrad_torch(
             lambda g, s: g / (jnp.sqrt(s) + eps), updates, sums
         )
         return updates, _AdagradState(sum_of_squares=sums)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class _MomentState(NamedTuple):
+    count: object
+    mu: object
+    nu: object
+
+
+def _scale_by_adamax_torch(
+    b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8
+) -> optax.GradientTransformation:
+    """torch ``Adamax``'s exact scaling (``torch/optim/adamax.py``):
+    ``mu = b1*mu + (1-b1)*g``; ``nu = max(b2*nu, |g| + eps)`` (eps inside the
+    max, so nu is never zero); step ``mu / ((1 - b1^t) * nu)``."""
+
+    def init_fn(params):
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        return _MomentState(count=jnp.zeros([], jnp.int32), mu=zeros,
+                            nu=jax.tree.map(jnp.zeros_like, params))
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = state.count + 1
+        mu = jax.tree.map(lambda g, m: b1 * m + (1 - b1) * g,
+                          updates, state.mu)
+        nu = jax.tree.map(
+            lambda g, n: jnp.maximum(b2 * n, jnp.abs(g) + eps),
+            updates, state.nu,
+        )
+        bc = 1 - b1 ** count.astype(jnp.float32)
+        updates = jax.tree.map(lambda m, n: m / (bc * n), mu, nu)
+        return updates, _MomentState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class _NAdamState(NamedTuple):
+    count: object
+    mu_product: object
+    mu: object
+    nu: object
+
+
+def _scale_by_nadam_torch(
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    momentum_decay: float = 4e-3,
+) -> optax.GradientTransformation:
+    """torch ``NAdam``'s exact scaling (``torch/optim/nadam.py``) — Nesterov
+    momentum with the 0.96^(t·ψ) momentum-decay schedule torch adds on top of
+    Dozat's formulation (optax's ``nesterov=True`` Adam lacks it):
+    ``µ_t = b1·(1 − ½·0.96^(t·ψ))``, running ``µ_product``, and the step
+    mixes the raw gradient and the first moment, each with its own
+    bias-correction, over ``sqrt(nu/(1−b2^t)) + eps``."""
+
+    def init_fn(params):
+        return _NAdamState(
+            count=jnp.zeros([], jnp.int32),
+            mu_product=jnp.ones([], jnp.float32),
+            mu=jax.tree.map(jnp.zeros_like, params),
+            nu=jax.tree.map(jnp.zeros_like, params),
+        )
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = state.count + 1
+        t = count.astype(jnp.float32)
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (t * momentum_decay))
+        mu_next = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * momentum_decay))
+        mu_product = state.mu_product * mu_t
+        mu = jax.tree.map(lambda g, m: b1 * m + (1 - b1) * g,
+                          updates, state.mu)
+        nu = jax.tree.map(lambda g, n: b2 * n + (1 - b2) * jnp.square(g),
+                          updates, state.nu)
+        bc2 = 1 - b2 ** t
+        g_scale = (1 - mu_t) / (1 - mu_product)
+        m_scale = mu_next / (1 - mu_product * mu_next)
+        updates = jax.tree.map(
+            lambda g, m, n: (g_scale * g + m_scale * m)
+            / (jnp.sqrt(n / bc2) + eps),
+            updates, mu, nu,
+        )
+        return updates, _NAdamState(count=count, mu_product=mu_product,
+                                    mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def _scale_by_radam_torch(
+    b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8
+) -> optax.GradientTransformation:
+    """torch ``RAdam``'s exact scaling (``torch/optim/radam.py``): Adam
+    moments, and while the variance-rectification term ``rho_t <= 5`` the
+    step is the bias-corrected first moment ALONE (no second-moment
+    denominator); afterwards the rectified adaptive step divides by
+    ``sqrt(nu) + eps`` scaled by ``sqrt(1 - b2^t)`` (eps OUTSIDE the
+    bias-corrected sqrt — a visible difference from optax's radam)."""
+    rho_inf = 2.0 / (1.0 - b2) - 1.0
+
+    def init_fn(params):
+        return _MomentState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree.map(jnp.zeros_like, params),
+            nu=jax.tree.map(jnp.zeros_like, params),
+        )
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = state.count + 1
+        t = count.astype(jnp.float32)
+        mu = jax.tree.map(lambda g, m: b1 * m + (1 - b1) * g,
+                          updates, state.mu)
+        nu = jax.tree.map(lambda g, n: b2 * n + (1 - b2) * jnp.square(g),
+                          updates, state.nu)
+        # -expm1(t·log b2) keeps 1 - b2^t fully precise in f32 at small t
+        # (the naive form loses ~half the mantissa exactly where the
+        # rectification boundary sits; torch does this math in python f64)
+        bc1 = -jnp.expm1(t * jnp.log(jnp.float32(b1)))
+        bc2 = -jnp.expm1(t * jnp.log(jnp.float32(b2)))
+        rho_t = rho_inf - 2 * t * (b2 ** t) / bc2
+        rect = jnp.sqrt(
+            jnp.clip(
+                (rho_t - 4) * (rho_t - 2) * rho_inf
+                / ((rho_inf - 4) * (rho_inf - 2) * rho_t),
+                0.0,
+            )
+        )
+        rectified = rho_t > 5.0
+
+        def leaf(m, n):
+            m_hat = m / bc1
+            adaptive = m_hat * rect * jnp.sqrt(bc2) / (jnp.sqrt(n) + eps)
+            return jnp.where(rectified, adaptive, m_hat)
+
+        updates = jax.tree.map(leaf, mu, nu)
+        return updates, _MomentState(count=count, mu=mu, nu=nu)
 
     return optax.GradientTransformation(init_fn, update_fn)
 
@@ -188,11 +329,35 @@ def make_optimizer(
             _scale_by_adagrad_torch(),
             optax.scale_by_learning_rate(schedule),
         )
+    elif name == "Adamax":
+        # torch default weight_decay semantics: coupled L2
+        tx = optax.chain(
+            *coupled_wd,
+            _scale_by_adamax_torch(),
+            optax.scale_by_learning_rate(schedule),
+        )
+    elif name == "NAdam":
+        # torch NAdam(decoupled_weight_decay=False) default: coupled L2
+        tx = optax.chain(
+            *coupled_wd,
+            _scale_by_nadam_torch(),
+            optax.scale_by_learning_rate(schedule),
+        )
+    elif name == "RAdam":
+        # torch RAdam(decoupled_weight_decay=False) default: coupled L2
+        tx = optax.chain(
+            *coupled_wd,
+            _scale_by_radam_torch(),
+            optax.scale_by_learning_rate(schedule),
+        )
     else:
         raise ValueError(
-            f"unknown optimizer {name!r} (expected one of 'Adam', 'AdamW', "
-            f"'SGD', 'RMSprop', 'Adagrad' — the torch.optim names the "
-            f"reference CLI accepts)"
+            f"unknown optimizer {name!r}: this maps torch.optim names to "
+            f"optax with torch-exact update semantics, and supports 'Adam', "
+            f"'AdamW', 'SGD', 'RMSprop', 'Adagrad', 'Adamax', 'NAdam', "
+            f"'RAdam' (the reference resolves ANY torch.optim name via "
+            f"getattr, lightning.py:60 — for another name, add a mapping in "
+            f"training/optim.py; see docs/MIGRATION.md)"
         )
 
     if config.grad_clip_norm is not None:
