@@ -1,0 +1,205 @@
+"""Per-round hardware kernel smoke: compile + parity-check EVERY Pallas path
+at guard-boundary block geometries on the real chip (VERDICT r3 item 5).
+
+The block-size tiers in ``ops/pallas_attention.py`` (``_auto_kv_block``, the
+q-block bump) and the flash-CE row-block rule encode hardware sweeps with
+measured scoped-VMEM OOM boundaries. CI exercises the kernels in interpret
+mode on CPU, which can NOT catch a Mosaic/compiler upgrade moving the ~16 MB
+scoped-VMEM boundary — that failure mode is a remote-compile error only the
+real chip produces. This tool compiles and parity-checks each path at the
+geometries sitting on those guard boundaries, so the measured tiers are
+re-validated every round instead of only when the sweep tools are re-run by
+hand.
+
+Run directly (``timeout 900 python tools/kernel_smoke.py [--out FILE]``) —
+prints ONE JSON line and exits non-zero on any failure — or let ``bench.py``
+invoke it as a subprocess (it writes ``KERNELSMOKE.json`` at the repo root
+each bench run; ``PIT_SKIP_KERNEL_SMOKE=1`` skips).
+
+Covered paths and what each geometry pins:
+
+- attention fwd + BOTH backward kernels (dq and dkv) at: the d<=64
+  wide-stream tier (kv 2048) at long S; the d<=128 tier (kv 1024); the
+  full-2048-KV flow-self shape; a deep-head d=512 shape sitting exactly ON
+  the q-bump s_blk*d guard (must resolve to the safe 512 default); a
+  lane-unaligned awkward-S shape (the pad-to-block path).
+- flash-CE fwd + both backward kernels (dx and dw/db) at the flagship
+  exact-divisor row count and at the 131k-context gathered row count
+  39328 = 32*1229 (no aligned divisor above 32 — the row-PADDING rule that
+  fixed the r3 regression).
+- the sequence-parallel shard_map path compiled on the real chip (1-device
+  seq axis — the collective merge compiles and matches; multi-device
+  equivalence is CI's job on the 8-device CPU mesh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _attention_case(b, t, s, h, d, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.ops.pallas_attention import fused_attention
+
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(0, 1, (b, t, h, d)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(0, 1, (b, s, h, d)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(0, 1, (b, s, h, d)), jnp.bfloat16)
+
+    def ref_loss(q, k, v):
+        logits = jnp.einsum(
+            "bthd,bshd->bhts", q * (d ** -0.5), k,
+            preferred_element_type=jnp.float32,
+        )
+        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        out = jnp.einsum("bhts,bshd->bthd", probs, v)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def ker_loss(q, k, v):
+        out = fused_attention(q, k, v)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    ref = jax.jit(jax.value_and_grad(ref_loss, argnums=(0, 1, 2)))(q, k, v)
+    got = jax.jit(jax.value_and_grad(ker_loss, argnums=(0, 1, 2)))(q, k, v)
+    _assert_close("loss", got[0], ref[0])
+    for name, g, r in zip(("dq", "dk", "dv"), got[1], ref[1]):
+        _assert_close(name, g, r)
+
+
+def _assert_close(name, got, ref, rtol=0.05):
+    got = np.asarray(got, np.float32)
+    ref = np.asarray(ref, np.float32)
+    scale = float(np.max(np.abs(ref))) or 1.0
+    err = float(np.max(np.abs(got - ref))) / scale
+    if not np.isfinite(got).all():
+        raise AssertionError(f"{name}: non-finite values")
+    if err > rtol:
+        raise AssertionError(f"{name}: max rel-to-peak error {err:.3g} > {rtol}")
+
+
+def _ce_case(rows, c, vocab, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.ops.pallas_ce import pallas_linear_ce_integer
+
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(0, 1, (rows, c)), jnp.bfloat16)
+    w = jnp.asarray(rng.normal(0, 0.02, (c, vocab)), jnp.bfloat16)
+    bias = jnp.asarray(rng.normal(0, 0.02, (vocab,)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, vocab, (rows,)).astype(np.int32))
+
+    def ref_loss(x, w, bias):
+        logits = (x.astype(jnp.float32) @ w.astype(jnp.float32)) + bias
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+        return jnp.sum(lse - picked)
+
+    def ker_loss(x, w, bias):
+        return jnp.sum(pallas_linear_ce_integer(x, w, bias, labels))
+
+    ref = jax.jit(jax.value_and_grad(ref_loss, argnums=(0, 1, 2)))(x, w, bias)
+    got = jax.jit(jax.value_and_grad(ker_loss, argnums=(0, 1, 2)))(x, w, bias)
+    _assert_close("loss", got[0], ref[0])
+    for name, g, r in zip(("dx", "dw", "db"), got[1], ref[1]):
+        _assert_close(name, g, r)
+
+
+def _sp_case():
+    import jax
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.ops.pallas_attention import (
+        fused_attention,
+        seq_parallel_fused_attention,
+    )
+    from perceiver_io_tpu.parallel import make_mesh
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(0, 1, (2, 256, 4, 16)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(0, 1, (2, 4096, 4, 16)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(0, 1, (2, 4096, 4, 16)), jnp.bfloat16)
+    mesh = make_mesh(dp=1, tp=1, sp=jax.device_count())
+
+    def sp_loss(q, k, v):
+        out = seq_parallel_fused_attention(q, k, v, mesh=mesh, axis="seq")
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def ref_loss(q, k, v):
+        out = fused_attention(q, k, v)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    ref = jax.jit(jax.value_and_grad(ref_loss, argnums=(0, 1, 2)))(q, k, v)
+    got = jax.jit(jax.value_and_grad(sp_loss, argnums=(0, 1, 2)))(q, k, v)
+    _assert_close("loss", got[0], ref[0])
+    for name, g, r in zip(("dq", "dk", "dv"), got[1], ref[1]):
+        _assert_close(name, g, r)
+
+
+CASES = {
+    # _auto_kv_block d<=64 tier at long S: kv resolves to 2048
+    "attn-32k-d16": lambda: _attention_case(1, 256, 32768, 4, 16),
+    # d<=128 tier: kv resolves to 1024 (the in-8h family, shrunk for runtime)
+    "attn-8k-d128": lambda: _attention_case(2, 512, 8192, 8, 128),
+    # full-2048 KV stream at d=64 (the flow-self win) + q-bump interplay
+    "attn-flowself-d64": lambda: _attention_case(2, 2048, 2048, 8, 64),
+    # deep head exactly ON the q-bump s_blk*d guard: must resolve to the
+    # safe 512 default, NOT the measured-OOM (1024, 512, 512) combo
+    "attn-deep-d512": lambda: _attention_case(1, 2048, 2048, 1, 512),
+    # lane-unaligned S: the pad-to-block streaming path
+    "attn-awkward-s": lambda: _attention_case(1, 256, 2944, 4, 16),
+    # flash-CE at the flagship gathered shape (10240 = 512*20, exact blocks)
+    "ce-flagship": lambda: _ce_case(10240, 64, 10003),
+    # flash-CE at the 131k-context gathered rows: 39328 = 32*1229 forces the
+    # row-padding rule (the r3 +48% fix) — dead rows must stay exact
+    "ce-padded-rows": lambda: _ce_case(39328, 64, 10003),
+    # the shard_map'd sequence-parallel kernel compiled on real hardware
+    "sp-shard": _sp_case,
+}
+
+
+def run(out_path: str | None) -> int:
+    import jax
+
+    results, failures = [], {}
+    for name, fn in CASES.items():
+        try:
+            fn()
+            results.append(name)
+        except Exception as e:  # noqa: BLE001 — every failure belongs in the artifact
+            failures[name] = f"{type(e).__name__}: {str(e)[:300]}"
+    report = {
+        "metric": "kernel_smoke",
+        "backend": jax.default_backend(),
+        "device": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "passed": len(results),
+        "total": len(CASES),
+        "cases": results,
+        "failures": failures,
+    }
+    line = json.dumps(report)
+    print(line)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(line + "\n")
+    return 1 if failures else 0
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--out", default=None, help="also write the JSON here")
+    args = p.parse_args()
+    raise SystemExit(run(args.out))
+
+
+if __name__ == "__main__":
+    main()
