@@ -54,17 +54,24 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--video_weight", type=float, default=1.0)
     t.add_argument("--audio_weight", type=float, default=1.0)
     t.add_argument("--label_weight", type=float, default=1.0)
-    # paper-scale defaults, scaled down by CLI flags for smoke runs
+    # paper-scale defaults, scaled down by CLI flags for smoke runs.
+    # attn_impl 'xla' is the measured-best for the paper AV config (r4
+    # roofline A/B: the area rule routes the 52k-query decoder cross to the
+    # fused kernel, which loses 30.8 vs 27.7 ms end-to-end at b2 — the same
+    # overlap dilution as PERF.md negative (11)); explicit --attn_impl wins
     parser.set_defaults(experiment="multimodal", num_latents=784,
                         num_latent_channels=512, num_encoder_layers=1,
                         num_self_attention_layers_per_block=8,
                         num_cross_attention_heads=1,
-                        num_self_attention_heads=8)
+                        num_self_attention_heads=8,
+                        attn_impl="xla")
     return parser
 
 
 def main(argv: Optional[Sequence[str]] = None):
     args = common.parse_with_resume(build_parser(), argv)
+    if common.maybe_spawn_hosts(args, argv):
+        return None  # training ran in the spawned processes
     common.maybe_initialize_distributed(args)
     video_shape = (
         args.video_frames, args.video_size, args.video_size, args.video_channels

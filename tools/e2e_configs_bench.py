@@ -209,14 +209,22 @@ def config_flow():
 
 
 def config_multimodal():
-    """Kinetics-style AV autoencoding (16x224^2 video + audio, 784x512)."""
+    """Kinetics-style AV autoencoding (16x224^2 video + audio, 784x512).
+
+    Defaults are the r4 measured-best (roofline sweep, device trace):
+    batch 8 (b2 79.2 → b4 86.4 → b8 88.8 ex/s; b16 regresses to 85.7),
+    remat OFF (recompute cost > saved traffic at this depth: 28.5 vs
+    30.8 ms at b2/auto), attn 'xla' (the area-rule kernel routing LOSES,
+    30.8 ms vs xla's 27.7 at b2 — overlap dilution, PERF.md negative (11)).
+    PIT_MM_BATCH / PIT_MM_REMAT=1 override."""
     from perceiver_io_tpu.models.multimodal import build_multimodal_autoencoder
 
-    b = 2
+    b = int(os.environ.get("PIT_MM_BATCH", "8"))
     video_shape = (16, 224, 224, 3)
     model = build_multimodal_autoencoder(
         video_shape=video_shape, num_audio_samples=30720, dtype=DTYPE,
-        remat=True, attn_impl=ATTN_IMPL or "auto",
+        remat=os.environ.get("PIT_MM_REMAT", "0") != "0",
+        attn_impl=ATTN_IMPL or "xla",
     )
     batch = {
         "video": jnp.asarray(rng.normal(0, 1, (b, *video_shape)), jnp.float32),
