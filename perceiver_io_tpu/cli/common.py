@@ -79,6 +79,13 @@ def add_trainer_args(parser: argparse.ArgumentParser) -> None:
                    help="lax.scan N optimizer steps per device dispatch — "
                         "amortizes per-call latency on remote/tunneled "
                         "accelerators (PERF.md)")
+    g.add_argument("--debug_nans", action="store_true",
+                   help="NaN localization (sanitizer): enable jax_debug_nans "
+                        "so the first dispatch producing NaN/Inf re-runs "
+                        "de-optimized and raises at the originating op. "
+                        "Slow (per-dispatch host sync, no state donation) — "
+                        "for post-mortems; halt_on_nonfinite already detects "
+                        "divergence in production")
     g.add_argument("--resume", default=None, metavar="RUN_DIR",
                    help="continue a previous run in place: restore the newest "
                         "checkpoint (the preemption last/ slot if present), "
@@ -104,6 +111,18 @@ def add_mesh_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--zero", dest="zero_opt", action="store_true",
                    help="ZeRO-style optimizer-state sharding over the data "
                         "axis (per-chip Adam mu/nu footprint / dp)")
+    g.add_argument("--zero3", dest="zero_opt", action="store_const",
+                   const="params",
+                   help="ZeRO-3/FSDP flavor: PARAMS shard over the data axis "
+                        "too (all-gather-on-use + reduce-scatter inserted by "
+                        "GSPMD); implies --zero")
+    g.add_argument("--spawn_hosts", type=int, default=None, metavar="N",
+                   help="one-command multi-process launch (the reference's "
+                        "'--accelerator=ddp --gpus=-1' UX): fork N copies of "
+                        "this exact command with the coordinator flags set "
+                        "(localhost coordinator, CPU backend per child — a "
+                        "dev/simulation helper; real TPU pods auto-detect "
+                        "via --multihost with one launch per host)")
     g.add_argument("--multihost", action="store_true",
                    help="call jax.distributed.initialize() before touching "
                         "devices (TPU pods auto-detect the coordinator); "
@@ -173,24 +192,11 @@ def validate_bucket_args(args) -> None:
     widths = getattr(args, "bucket_widths", None)
     if not widths:
         return
-    import jax
-
-    if jax.process_count() > 1:
-        # each host collates only its shard, and the length-sorted slices
-        # give hosts DIFFERENT max lengths for the same global batch — they
-        # would pick different widths and deadlock global-array assembly.
-        # A globally-consistent width needs the collator to see the global
-        # batch's lengths; until then, fail loudly instead.
-        raise SystemExit(
-            "--bucket_widths is not supported in multi-host runs: per-host "
-            "collation would pick inconsistent widths for the same global "
-            "batch"
-        )
-    if getattr(args, "steps_per_dispatch", 1) > 1:
-        raise SystemExit(
-            "--bucket_widths is incompatible with --steps_per_dispatch > 1: "
-            "a stacked dispatch window cannot mix sequence widths"
-        )
+    # Multi-host and steps_per_dispatch now COMPOSE with buckets (r4,
+    # VERDICT r3 item 2): the loader decides each global batch's width from
+    # the shared token-length table (host-consistent by construction) and
+    # arranges same-width batches in K-runs so stacked dispatch windows
+    # never mix widths (data/pipeline.py group_widths/group_size).
     if getattr(args, "shard_seq", False):
         sp = getattr(args, "sp", 1)
         bad = [w for w in widths if w % sp]
@@ -230,6 +236,7 @@ def trainer_config(args) -> TrainerConfig:
         use_tensorboard=not args.no_tensorboard,
         profile_steps=args.profile_steps,
         steps_per_dispatch=getattr(args, "steps_per_dispatch", 1),
+        debug_nans=getattr(args, "debug_nans", False),
     )
 
 
@@ -388,6 +395,142 @@ def override_model_args(args, hparams: dict) -> None:
             setattr(args, key, hparams[key])
 
 
+def maybe_spawn_hosts(args, argv=None) -> bool:
+    """Reference-style one-command multi-process launch (``--spawn_hosts N``).
+
+    Lightning's ``--accelerator=ddp --gpus=-1`` spawns per-device processes
+    from a single invocation (reference ``train_mlm.py:102-103``); the JAX
+    equivalent normally needs one launch per process with coordinator flags
+    (CLAUDE.md multi-host recipe). This dev helper closes the UX gap: it
+    re-executes this exact command N times with
+    ``--coordinator_address localhost:PORT --num_processes N --process_id R``
+    appended and ``JAX_PLATFORMS=cpu`` in each child's env (a simulation
+    harness — real TPU pods auto-detect the coordinator via ``--multihost``,
+    one launch per host). Returns True when this process acted as the
+    launcher (training ran in the children; the caller should return), False
+    when training should proceed in-process. Child failure raises
+    ``SystemExit`` with the first non-zero return code.
+    """
+    import socket
+    import subprocess
+    import sys
+
+    n = getattr(args, "spawn_hosts", None)
+    if not n or n <= 1 or getattr(args, "process_id", None) is not None:
+        return False
+    base = list(sys.argv[1:] if argv is None else argv)
+    child_argv, skip = [], False
+    for a in base:
+        if skip:
+            skip = False
+            continue
+        if a == "--spawn_hosts":
+            skip = True  # drop the flag and its value
+        elif a.startswith("--spawn_hosts="):
+            pass
+        else:
+            child_argv.append(a)
+    import tempfile
+    import time
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs, logs = [], []
+    for rank in range(n):
+        cmd = [sys.executable, sys.argv[0], *child_argv,
+               "--coordinator_address", f"localhost:{port}",
+               "--num_processes", str(n), "--process_id", str(rank)]
+        # rank 0 inherits stdout/stderr (it owns logging/checkpoints); the
+        # others write to temp files — NEVER undrained pipes, which fill the
+        # OS buffer once a child emits ~64KB and deadlock the whole cluster —
+        # replayed only on failure
+        if rank == 0:
+            out, log = None, None
+        else:
+            log = tempfile.NamedTemporaryFile(
+                mode="w+", prefix=f"spawn_hosts_rank{rank}_", suffix=".log",
+                delete=False,
+            )
+            out = log
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            cmd, env=env, stdout=out,
+            stderr=subprocess.STDOUT if rank else None, text=True,
+        ))
+    print(f"--spawn_hosts: launched {n} processes "
+          f"(coordinator localhost:{port})", file=sys.stderr)
+    import signal
+
+    def _reap(live):
+        for r in live:
+            procs[r].terminate()
+        for r in live:
+            try:
+                procs[r].wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                procs[r].kill()
+
+    # the launcher must never outlive-orphan its children: SIGTERM/SIGINT
+    # (Ctrl-C, `timeout`, a scheduler preemption) reaps them before exiting
+    prev_handlers = {}
+
+    def _on_signal(signum, frame):
+        _reap([r for r in range(n) if procs[r].poll() is None])
+        raise SystemExit(128 + signum)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev_handlers[sig] = signal.signal(sig, _on_signal)
+        except ValueError:
+            pass  # non-main thread (programmatic use) — skip the handlers
+    # poll rather than wait in rank order: a crashed child leaves the
+    # survivors blocked in collectives, so the first non-zero exit
+    # terminates the rest instead of hanging the launcher forever
+    failed = None
+    live = list(range(n))
+    try:
+        while live and failed is None:
+            for r in list(live):
+                rc = procs[r].poll()
+                if rc is not None:
+                    live.remove(r)
+                    if rc != 0:
+                        failed = (r, rc)
+                        break
+            time.sleep(0.2)
+        if failed is not None:
+            rank, rc = failed
+            _reap(live)
+            if logs[rank] is not None:
+                logs[rank].flush()
+                logs[rank].seek(0)
+                print(
+                    f"--- rank {rank} output ---\n{logs[rank].read()[-4000:]}",
+                    file=sys.stderr,
+                )
+                print(f"(full rank-{rank} log kept at {logs[rank].name})",
+                      file=sys.stderr)
+            raise SystemExit(rc)
+    finally:
+        for sig, h in prev_handlers.items():
+            signal.signal(sig, h)
+        # close every log handle; delete all but a failed rank's (kept for
+        # replay) so repeated dev runs don't litter /tmp
+        for rank, log in enumerate(logs):
+            if log is None:
+                continue
+            log.close()
+            if failed is None or rank != failed[0]:
+                try:
+                    os.unlink(log.name)
+                except OSError:
+                    pass
+    return True
+
+
 def maybe_initialize_distributed(args) -> None:
     """Multi-host bring-up, gated on ``--multihost``. MUST run before any
     device access (first use initializes the local-only backend)."""
@@ -421,6 +564,15 @@ def maybe_initialize_distributed(args) -> None:
                 "--process_id I on every process, or drop the flag for "
                 "single-host runs."
             ) from e
+        import sys
+
+        import jax
+
+        print(
+            f"[distributed] process {jax.process_index()}/"
+            f"{jax.process_count()}, {jax.local_device_count()} local "
+            f"device(s)", file=sys.stderr,
+        )
 
 
 def parse_with_resume(parser: argparse.ArgumentParser, argv):

@@ -51,6 +51,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[Sequence[str]] = None):
     args = common.parse_with_resume(build_parser(), argv)
+    if common.maybe_spawn_hosts(args, argv):
+        return None  # training ran in the spawned processes
     common.maybe_initialize_distributed(args)
     image_shape = (args.image_height, args.image_width, args.image_channels)
 

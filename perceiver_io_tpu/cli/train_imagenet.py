@@ -66,6 +66,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[Sequence[str]] = None):
     args = common.parse_with_resume(build_parser(), argv)
+    if common.maybe_spawn_hosts(args, argv):
+        return None  # training ran in the spawned processes
     common.maybe_initialize_distributed(args)
     # remat is the sane default at M = image_size² (opt out via --no_remat)
     if args.image_size >= 64 and not args.no_remat:

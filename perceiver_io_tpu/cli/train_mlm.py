@@ -147,6 +147,8 @@ def make_predict_hook(predict_fn, collator, samples: Sequence[str], k: int):
 
 def main(argv: Optional[Sequence[str]] = None):
     args = apply_preset(common.parse_with_resume(build_parser(), argv))
+    if common.maybe_spawn_hosts(args, argv):
+        return None  # training ran in the spawned processes
     common.maybe_initialize_distributed(args)
     # after distributed init: the multi-host guard reads jax.process_count()
     common.validate_bucket_args(args)
@@ -164,6 +166,7 @@ def main(argv: Optional[Sequence[str]] = None):
         download=not args.no_download,
         bucket_widths=args.bucket_widths,
         length_sort_window=args.length_sort_window,
+        dispatch_group=args.steps_per_dispatch,
     )
     data.prepare_data()
     data.setup()

@@ -127,6 +127,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[Sequence[str]] = None):
     args = common.parse_with_resume(build_parser(), argv)
+    if common.maybe_spawn_hosts(args, argv):
+        return None  # training ran in the spawned processes
     common.maybe_initialize_distributed(args)
     if args.mlm_checkpoint and args.clf_checkpoint:
         raise SystemExit("--mlm_checkpoint and --clf_checkpoint are exclusive")
@@ -173,6 +175,7 @@ def main(argv: Optional[Sequence[str]] = None):
         download=not args.no_download,
         bucket_widths=args.bucket_widths,
         length_sort_window=args.length_sort_window,
+        dispatch_group=args.steps_per_dispatch,
     )
     data.prepare_data()
     data.setup()

@@ -148,14 +148,23 @@ class Collator:
         # so tokenizer-level padding would be duplicated work on the hot path
         tokenizer.enable_truncation(max_seq_len)
 
-    def collate(self, batch: Sequence[Tuple[int, str]]) -> Dict[str, np.ndarray]:
+    def collate(
+        self,
+        batch: Sequence[Tuple[int, str]],
+        width: Optional[int] = None,
+    ) -> Dict[str, np.ndarray]:
+        """``width``: externally-decided bucket width (the DataLoader passes
+        the GLOBAL batch's width so multi-host shards collate identical
+        shapes); None = decide locally from this batch's encoded lengths
+        (single-host behavior, and the predict-path ``encode``)."""
         labels = np.asarray([y for y, _ in batch], dtype=np.int32)
         encoded = self.tokenizer.encode_batch([x for _, x in batch])
-        width = self.max_seq_len  # static width: SPMD-friendly, no recompiles
-        if self.bucket_widths is not None:
-            longest = max((len(e) for e in encoded), default=1)
-            longest = min(max(longest, 1), self.max_seq_len)
-            width = next(w for w in self.bucket_widths if w >= longest)
+        if width is None:
+            width = self.max_seq_len  # static: SPMD-friendly, no recompiles
+            if self.bucket_widths is not None:
+                longest = max((len(e) for e in encoded), default=1)
+                longest = min(max(longest, 1), self.max_seq_len)
+                width = next(w for w in self.bucket_widths if w >= longest)
         ids = np.full((len(batch), width), self.pad_id, dtype=np.int32)
         for i, e in enumerate(encoded):
             ids[i, : min(len(e), width)] = e[:width]
@@ -186,6 +195,7 @@ class IMDBDataModule:
         download: bool = True,
         bucket_widths: Optional[Sequence[int]] = None,
         length_sort_window: int = 8,
+        dispatch_group: int = 1,
     ):
         self.root = root
         self.download = download
@@ -199,17 +209,17 @@ class IMDBDataModule:
         self.num_shards = num_shards
         # width buckets (see Collator) + the loader-side length grouping that
         # makes them effective; the sort window only applies when buckets are
-        # on, so the default path is byte-identical to previous rounds
-        if bucket_widths and num_shards > 1:
-            # each host collates only its shard of the (length-sorted)
-            # global batch — hosts would pick different widths for the same
-            # step and deadlock global-array assembly
-            raise ValueError(
-                "bucket_widths is not supported with num_shards > 1: "
-                "per-host collation picks inconsistent widths"
-            )
+        # on, so the default path is byte-identical to previous rounds.
+        # Multi-host: the LOADER decides each global batch's width from the
+        # shared token-length table (DataLoader.group_widths), so per-host
+        # collation shapes always agree — the r3 incompatibility guard is
+        # gone. dispatch_group (= the trainer's steps_per_dispatch) arranges
+        # same-width batches in K-runs so stacked dispatch windows never mix
+        # widths.
         self.bucket_widths = bucket_widths
         self.length_sort_window = length_sort_window
+        self.dispatch_group = max(1, int(dispatch_group))
+        self._train_token_lengths: Optional[np.ndarray] = None
 
         suffix = "synthetic-" if synthetic else ""
         self.tokenizer_path = os.path.join(root, f"imdb-{suffix}tokenizer-{vocab_size}.json")
@@ -228,6 +238,7 @@ class IMDBDataModule:
             synthetic=getattr(args, "synthetic", False),
             bucket_widths=getattr(args, "bucket_widths", None),
             length_sort_window=getattr(args, "length_sort_window", 8),
+            dispatch_group=getattr(args, "steps_per_dispatch", 1),
         )
 
     def _train_texts(self) -> Tuple[List[str], List[int]]:
@@ -272,15 +283,26 @@ class IMDBDataModule:
         )
         self.ds_train = IMDBDataset(*self._train_texts())
         self.ds_valid = IMDBDataset(*self._valid_texts())
+        if self.bucket_widths:
+            # One-time TOKEN-length table over the full train split (every
+            # host computes the identical table — the dataset is replicated;
+            # ~seconds at tokenizer encode rates, PERF.md). This is both the
+            # length-sort key (tighter grouping than the r3 char-count proxy)
+            # and the loader's width oracle: widths derive from GLOBAL
+            # lengths, so multi-host shards agree by construction.
+            self._train_token_lengths = np.asarray(
+                [len(e) for e in self.tokenizer.encode_batch(self.ds_train.texts)],
+                dtype=np.int64,
+            )
 
     def train_dataloader(self) -> DataLoader:
         sort_key = None
         sort_window = 0
+        group_widths = None
         if self.bucket_widths:
-            # character count ~ token count: good enough to group lengths
-            # without tokenizing the corpus up front
-            sort_key = np.asarray([len(t) for t in self.ds_train.texts])
+            sort_key = self._train_token_lengths
             sort_window = self.length_sort_window
+            group_widths = self.collator.bucket_widths  # incl. appended cap
         return DataLoader(
             self.ds_train,
             batch_size=self.batch_size,
@@ -291,13 +313,26 @@ class IMDBDataModule:
             num_shards=self.num_shards,
             sort_key=sort_key,
             sort_window=sort_window,
+            group_widths=group_widths,
+            group_size=self.dispatch_group,
         )
 
     def val_dataloader(self) -> DataLoader:
+        collate = self.collator.collate
+        if self.bucket_widths and self.num_shards > 1:
+            # eval has no loader-side width oracle (no sort_key), so the
+            # collator would bucket from each host's LOCAL slice — divergent
+            # shapes deadlock global-array assembly. Pin eval to the static
+            # cap; train keeps the bucketed widths via group_widths.
+            import functools
+
+            collate = functools.partial(
+                self.collator.collate, width=self.max_seq_len
+            )
         return DataLoader(
             self.ds_valid,
             batch_size=self.batch_size,
-            collate=self.collator.collate,
+            collate=collate,
             shuffle=False,
             # evaluate the full set when single-host (multi-host must drop for
             # lockstep collectives)

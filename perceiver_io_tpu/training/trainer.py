@@ -79,6 +79,14 @@ class TrainerConfig:
     # of the schedule. Checked only at log boundaries, where the loss scalar
     # is fetched anyway (no extra device sync on the hot path).
     halt_on_nonfinite: bool = True
+    # NaN LOCALIZATION (the sanitizer tier above halt_on_nonfinite, which
+    # only says THAT the run diverged): enables jax_debug_nans, so the first
+    # dispatch producing a NaN/Inf re-runs de-optimized and raises
+    # FloatingPointError pointing at the originating op. Debug mode: every
+    # dispatch syncs to host, and the single-device path stops donating the
+    # train state (the de-optimized re-run replays the same arguments, which
+    # donation would have invalidated). Use for post-mortems, not production.
+    debug_nans: bool = False
 
     def __post_init__(self):
         if self.max_epochs is None and self.max_steps is None:
@@ -143,6 +151,12 @@ class Trainer:
 
         self._raw_train_step = train_step
         self._k = max(1, int(config.steps_per_dispatch))
+        self._prev_debug_nans = None
+        if config.debug_nans:
+            # restored in __exit__ — a post-mortem Trainer must not leak
+            # process-global debug mode into later work
+            self._prev_debug_nans = jax.config.jax_debug_nans
+            jax.config.update("jax_debug_nans", True)
         step_fn = train_step
         step_example = self._example_batch
         if self._k > 1:
@@ -158,6 +172,9 @@ class Trainer:
                     step_fn, mesh, state, step_example,
                     rules=rules, shard_seq=shard_seq, zero_opt=zero_opt,
                     stacked=self._k > 1,
+                    # jax_debug_nans re-runs the dispatch with the ORIGINAL
+                    # arguments — donation would have deleted them
+                    donate_state=not config.debug_nans,
                 )
             )
             # Eval batches are never stacked (no scan axis) — with
@@ -168,7 +185,8 @@ class Trainer:
                 self._example_batch, mesh, shard_seq
             )
         else:
-            jitted = jax.jit(step_fn, donate_argnums=(0,))
+            donate = () if config.debug_nans else (0,)
+            jitted = jax.jit(step_fn, donate_argnums=donate)
             self._train_step = lambda s, b: jitted(s, {k: b[k] for k in self._keys})
             self._train_step.jitted = jitted
             self.state = state
@@ -253,16 +271,25 @@ class Trainer:
 
     def _dispatch_batches(self, loader):
         """Yield ``(batch, n_steps)`` dispatch units: single loader batches
-        (K=1), or K of them stacked on a new leading scan axis. A partial
-        tail window is yielded at its own length (one extra compile, cached
-        across epochs)."""
+        (K=1), or up to K of them stacked on a new leading scan axis. A
+        window is flushed early when the next batch's SHAPES differ (width-
+        bucketed text loaders emit same-width runs of K — data/pipeline.py
+        ``group_size`` — so early flushes only happen at run boundaries);
+        partial windows compile once per (length, shape) and are cached
+        across epochs. Batches are always consumed in loader order, which is
+        what keeps the mid-epoch resume arithmetic (``skip_next``) exact."""
         if self._k <= 1:
             for batch in loader:
                 yield batch, 1
             return
-        buf = []
+        buf, sig = [], None
         for batch in loader:
+            shapes = {k: np.asarray(batch[k]).shape for k in self._keys}
+            if buf and shapes != sig:
+                yield self._stack(buf), len(buf)
+                buf = []
             buf.append(batch)
+            sig = shapes
             if len(buf) == self._k:
                 yield self._stack(buf), self._k
                 buf = []
@@ -563,6 +590,9 @@ class Trainer:
     def close(self) -> None:
         self.checkpoints.close()
         self.logger.close()
+        if self._prev_debug_nans is not None:
+            jax.config.update("jax_debug_nans", self._prev_debug_nans)
+            self._prev_debug_nans = None
 
     def __enter__(self) -> "Trainer":
         return self

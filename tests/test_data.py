@@ -198,12 +198,84 @@ def test_loader_length_grouped_windows():
                    sort_key=np.arange(5), sort_window=2)
 
 
-def test_bucketed_module_rejects_multihost(tmp_path):
-    """Per-host collation picks widths from local shards only — inconsistent
-    across hosts — so the module fails loudly instead of deadlocking."""
-    with pytest.raises(ValueError, match="num_shards"):
-        IMDBDataModule(root=str(tmp_path), synthetic=True,
-                       bucket_widths=[16], num_shards=2)
+def test_bucketed_module_multihost_width_agreement(tmp_path):
+    """Bucketed widths now COMPOSE with sharded loading (r4): the loader
+    decides each GLOBAL batch's width from the shared token-length table, so
+    two shard instances of the same module collate identical shapes step for
+    step (the r3 guard this replaces existed because per-SHARD width choice
+    diverged)."""
+    mods = []
+    for shard in (0, 1):
+        dm = IMDBDataModule(root=str(tmp_path), max_seq_len=256, vocab_size=200,
+                            batch_size=8, synthetic=True, synthetic_size=128,
+                            bucket_widths=[128], length_sort_window=4,
+                            shard_id=shard, num_shards=2)
+        dm.prepare_data()
+        dm.setup()
+        mods.append(dm)
+    # controlled corpus: half short, half long reviews, so both buckets are
+    # guaranteed to fire (the synthetic generator's reviews are all long)
+    from perceiver_io_tpu.data.imdb import IMDBDataset
+
+    texts = ["a good movie"] * 64 + [" ".join(["word"] * 200)] * 64
+    labels = [0, 1] * 64
+    for dm in mods:
+        dm.ds_train = IMDBDataset(texts, labels)
+        dm._train_token_lengths = np.asarray(
+            [len(e) for e in dm.tokenizer.encode_batch(texts)], dtype=np.int64
+        )
+    steps = [list(dm.train_dataloader()) for dm in mods]
+    assert len(steps[0]) == len(steps[1]) > 0
+    widths = []
+    for b0, b1 in zip(*steps):
+        assert b0["token_ids"].shape == b1["token_ids"].shape  # agree
+        assert b0["token_ids"].shape[0] == 4  # half the global batch each
+        widths.append(b0["token_ids"].shape[1])
+    assert set(widths) == {128, 256}  # both buckets actually exercised
+
+
+def test_loader_width_groups_of_k():
+    """group_widths + group_size=K: every batch window of K consecutive
+    batches that the trainer would stack has ONE width (same-width runs are
+    emitted in chunks of K), and every example still appears exactly once."""
+    rng = np.random.default_rng(0)
+    n = 512
+    lengths = rng.integers(1, 33, n)
+
+    def collate(idx, width=None):
+        return {"i": np.asarray(idx), "w": np.asarray(width)}
+
+    loader = DataLoader(
+        RangeDataset(n), batch_size=4, collate=collate, shuffle=True,
+        sort_key=lengths, sort_window=8, group_widths=[16, 32], group_size=2,
+    )
+    batches = list(loader)
+    seen = np.sort(np.concatenate([b["i"] for b in batches]))
+    np.testing.assert_array_equal(seen, np.arange(n))
+    for b in batches:
+        # the width the loader passes is the bucket of the batch's longest
+        assert int(b["w"]) == (16 if lengths[b["i"]].max() <= 16 else 32)
+    # simulate the trainer's stacker (greedy, flush on width change): K-group
+    # emission must yield MORE full dispatch windows than permuting single
+    # batches does — that is the whole point of grouping
+    def full_window_count(batch_widths, k=2):
+        windows, run = [], 1
+        for i in range(1, len(batch_widths)):
+            if batch_widths[i] == batch_widths[i - 1] and run < k:
+                run += 1
+            else:
+                windows.append(run)
+                run = 1
+        windows.append(run)
+        return sum(w == k for w in windows)
+
+    ungrouped = DataLoader(
+        RangeDataset(n), batch_size=4, collate=collate, shuffle=True,
+        sort_key=lengths, sort_window=8, group_widths=[16, 32], group_size=1,
+    )
+    grouped_full = full_window_count([int(b["w"]) for b in batches])
+    ungrouped_full = full_window_count([int(b["w"]) for b in ungrouped])
+    assert grouped_full > ungrouped_full, (grouped_full, ungrouped_full)
 
 
 def test_imdb_bucketed_module_and_predict_parity(tmp_path):

@@ -367,6 +367,36 @@ def test_zero_opt_state_sharding(mlm_setup):
     np.testing.assert_allclose(sharded, ref, atol=1e-5)
 
 
+def test_zero3_param_sharding(mlm_setup):
+    """ZeRO-3/FSDP flavor (``zero_opt='params'`` / CLI ``--zero3``): params
+    AND opt-state shard over the data axis, GSPMD inserts the
+    all-gather-on-use, and the training math is unchanged vs the
+    fully-replicated run."""
+    from perceiver_io_tpu.parallel import zero_state_shardings
+
+    model, state, batch, train_step = mlm_setup
+    fresh = lambda: jax.tree.map(jnp.copy, state)
+
+    _, ref = _run(jax.jit(train_step), fresh(), batch)
+
+    mesh = make_mesh(dp=4, tp=2, sp=1)
+    step, sstate, bshard = make_sharded_train_step(
+        train_step, mesh, fresh(), batch, zero_opt="params"
+    )
+    # the PLAN shards params over data (on top of any model-axis rule)...
+    shardings = zero_state_shardings(state, mesh, params_too=True)
+    flat = jax.tree_util.tree_flatten_with_path(shardings.params)[0]
+    p_specs = [s.spec for _, s in flat if len(s.spec) > 0]
+    assert p_specs and any(AXIS_DATA in spec for spec in p_specs)
+    # ...and the LIVE placed params actually carry it
+    live = jax.tree_util.tree_flatten_with_path(sstate.params)[0]
+    live_specs = [l.sharding.spec for _, l in live if getattr(l, "ndim", 0) > 0]
+    assert any(AXIS_DATA in spec for spec in live_specs)
+
+    _, sharded = _run(step, sstate, jax.device_put(batch, bshard))
+    np.testing.assert_allclose(sharded, ref, atol=1e-5)
+
+
 # -- Pallas kernel × SPMD composition ----------------------------------------
 # The long-context design sells blockwise-KV Pallas attention together with
 # seq/model sharding (SURVEY.md §5); these tests run the kernel (interpret
@@ -621,3 +651,59 @@ def test_pallas_sp_without_mesh_degrades_to_pallas(mlm_parts):
     sp_step, _, _ = make_mlm_steps(model, sched)
     _, got = _run(jax.jit(sp_step), fresh(), batch)
     np.testing.assert_allclose(got, ref, atol=2e-5)
+
+
+class TestSpGradientCanary:
+    """The shard_seq setup-time probe that turns a silent shard_map
+    transpose-convention change (a JAX-upgrade hazard _sp_bwd documents)
+    into a loud startup failure."""
+
+    def test_passes_on_healthy_mesh(self):
+        import perceiver_io_tpu.parallel.sharding as sh
+        from perceiver_io_tpu.parallel import make_mesh
+
+        sh._SP_CANARY_OK.clear()  # force real probes despite earlier tests
+        sh.sp_gradient_canary(make_mesh(dp=2, tp=1, sp=4))  # must not raise
+        sh.sp_gradient_canary(make_mesh(dp=1, tp=1, sp=8))
+
+    def test_detects_a_rescaled_backward(self, monkeypatch):
+        """Simulate the failure mode the canary exists for: gradients off by
+        an integer factor with the forward exact (what a changed check_rep
+        transpose convention would produce)."""
+        import perceiver_io_tpu.ops.pallas_attention as pa
+        from perceiver_io_tpu.parallel import make_mesh
+        from perceiver_io_tpu.parallel.sharding import sp_gradient_canary
+
+        orig = pa.seq_parallel_fused_attention
+
+        @jax.custom_vjp
+        def rescaled(q, k, v):
+            return orig(q, k, v, mesh=mesh, axis="seq")
+
+        def fwd(q, k, v):
+            out, vjp = jax.vjp(
+                lambda q, k, v: orig(q, k, v, mesh=mesh, axis="seq"),
+                q, k, v,
+            )
+            return out, vjp
+
+        def bwd(vjp, g):
+            dq, dk, dv = vjp(g)
+            return 4.0 * dq, 4.0 * dk, 4.0 * dv  # the silent 4x rescale
+
+        rescaled.defvjp(fwd, bwd)
+        mesh = make_mesh(dp=2, tp=1, sp=4)
+        monkeypatch.setattr(
+            pa, "seq_parallel_fused_attention",
+            lambda q, k, v, **kw: rescaled(q, k, v),
+        )
+        import perceiver_io_tpu.parallel.sharding as sh
+
+        sh._SP_CANARY_OK.clear()  # the per-topology pass cache would skip us
+        try:
+            with pytest.raises(RuntimeError, match="canary FAILED"):
+                sp_gradient_canary(mesh)
+        finally:
+            # a FAILED probe must not have been cached as ok, and later
+            # tests should re-probe the healthy implementation themselves
+            sh._SP_CANARY_OK.clear()

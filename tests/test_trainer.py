@@ -439,3 +439,46 @@ def test_eval_shardings_unstacked_with_multistep_dispatch(tmp_path):
         t.fit(train_loader, val_loader)
         rows = read_metrics(t.run_dir)
     assert any("val_loss" in r for r in rows)
+
+
+def test_debug_nans_localizes_at_dispatch(tmp_path):
+    """debug_nans=True (CLI --debug_nans) raises FloatingPointError at the
+    FIRST dispatch that produces a NaN — inside jit, at the originating op —
+    not at the next log boundary the way halt_on_nonfinite does (the log
+    cadence here is far beyond max_steps, so only the sanitizer can fire)."""
+    import dataclasses
+
+    import optax
+
+    from perceiver_io_tpu.training import TrainState
+
+    params = {"w": jnp.ones((2,))}
+    state = TrainState.create(params, optax.sgd(1e-3), jax.random.key(0))
+
+    def nan_step(state, batch):
+        # sqrt of a large negative: a NaN born inside the jitted body
+        loss = jnp.sqrt(jnp.sum(batch["x"]) - 1e9)
+        return state, {"loss": loss}
+
+    batch = {"x": np.ones((2, 1), np.float32)}
+    cfg = TrainerConfig(
+        max_steps=3, log_every_n_steps=1000, logdir=str(tmp_path / "logs"),
+        experiment="nan", use_tensorboard=False, compute_mfu=False,
+        debug_nans=True,
+    )
+    try:
+        trainer = Trainer(nan_step, None, state, cfg, example_batch=batch)
+        with trainer:
+            with pytest.raises(FloatingPointError):
+                trainer.fit([batch, batch, batch])
+    finally:
+        jax.config.update("jax_debug_nans", False)
+
+    # same step without the flag: the NaN flows through silently (log
+    # boundary never reached), proving the raise above came from the
+    # sanitizer and not the halt guard
+    cfg2 = dataclasses.replace(cfg, debug_nans=False,
+                               logdir=str(tmp_path / "logs2"))
+    trainer2 = Trainer(nan_step, None, state, cfg2, example_batch=batch)
+    with trainer2:
+        trainer2.fit([batch, batch, batch])

@@ -1,0 +1,160 @@
+"""A/B: bucketed widths x steps_per_dispatch compose (VERDICT r3 item 2).
+
+Round 3 measured +11.3% from width buckets and separately showed
+``steps_per_dispatch=K`` sustaining 86-95% of the device rate through the
+tunnel — but the two excluded each other. Round 4 composes them (loader-
+decided global widths + K-grouped same-width runs + the trainer's
+flush-on-width-change stacker); this tool shows the wins STACK on hardware:
+
+1. full-window fraction: over one epoch of the real bucketed module at
+   ``group_size=K``, how many K-batch dispatch windows are full (the
+   grouping's job — without it, width changes would flush nearly every
+   window early and forfeit the dispatch amortization);
+2. interleaved trainer A/B on the chip: ``Trainer.fit`` tokens/s with
+   buckets x K=16 vs static-512 x K=16, run A/B/A/B in ONE process
+   (CLAUDE.md tunnel discipline), steady-state windows only (every shape
+   compiled in a warmup epoch first).
+
+Corpus: the same IMDB-length-realistic generator as
+``bucketed_width_bench.py`` (log-normal fit to the published profile; the
+real aclImdb tree is used instead when present).
+
+Usage: ``timeout 1800 python tools/bucket_k_compose_bench.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from bucketed_width_bench import BATCH, BUCKETS, SEQ_CAP, VOCAB, realistic_corpus
+
+K = int(os.environ.get("PIT_COMPOSE_K", "16"))
+STEPS = int(os.environ.get("PIT_COMPOSE_STEPS", "640"))
+
+
+CORPUS = int(os.environ.get("PIT_COMPOSE_CORPUS", "16384"))
+
+
+def make_module(root: str, buckets):
+    from perceiver_io_tpu.data.imdb import IMDBDataModule
+
+    have_real = os.path.isdir(os.path.join(root, "IMDB", "aclImdb", "train"))
+    dm = IMDBDataModule(
+        root=root, max_seq_len=SEQ_CAP, vocab_size=VOCAB, batch_size=BATCH,
+        synthetic=not have_real, synthetic_size=CORPUS,
+        bucket_widths=buckets, length_sort_window=8, dispatch_group=K,
+    )
+    if not have_real:
+        dm._train_texts = lambda: realistic_corpus(CORPUS)  # type: ignore
+        dm._valid_texts = lambda: realistic_corpus(256, seed=1)  # type: ignore
+    dm.prepare_data()
+    dm.setup()
+    return dm
+
+
+def window_stats(dm):
+    """(full-window fraction, fraction of STEPS inside full windows) under
+    the trainer's greedy flush-on-width-change stacker
+    (Trainer._dispatch_batches)."""
+    windows, run, prev = [], 0, None
+    for b in dm.train_dataloader():
+        w = b["token_ids"].shape[1]
+        if run and (w != prev or run == K):
+            windows.append(run)
+            run = 0
+        run += 1
+        prev = w
+    if run:
+        windows.append(run)
+    total = sum(windows) or 1
+    return (
+        sum(1 for w in windows if w == K) / max(len(windows), 1),
+        sum(w for w in windows if w == K) / total,
+    )
+
+
+def trainer_rate(dm, label: str) -> float:
+    """Median steady-state tokens/s over a fixed-step Trainer.fit run."""
+    import jax
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.models.presets import flagship_mlm
+    from perceiver_io_tpu.training import (
+        OptimizerConfig,
+        TrainState,
+        make_mlm_steps,
+        make_optimizer,
+        mlm_gather_capacity,
+        read_metrics,
+    )
+    from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+    model = flagship_mlm(
+        vocab_size=dm.tokenizer.get_vocab_size(), max_seq_len=SEQ_CAP,
+        dtype=jnp.bfloat16, attn_impl="xla",
+    )
+    example = next(iter(dm.val_dataloader()))
+    variables = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        example["token_ids"][:1], example["pad_mask"][:1],
+    )
+    tx, sched = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    state = TrainState.create(variables["params"], tx, jax.random.key(2))
+    head = "pallas" if jax.default_backend() == "tpu" else False
+    train_step, eval_step, _ = make_mlm_steps(
+        model, sched, loss_gather_capacity=mlm_gather_capacity(SEQ_CAP),
+        fused_head=head,
+    )
+    import tempfile
+
+    logdir = tempfile.mkdtemp(prefix=f"compose_{label}_")
+    cfg = TrainerConfig(
+        max_steps=STEPS, log_every_n_steps=32, steps_per_dispatch=K,
+        logdir=logdir, experiment=label, use_tensorboard=False,
+        compute_mfu=False, async_checkpoint=False, max_to_keep=1,
+    )
+    trainer = Trainer(
+        train_step, lambda s, b, k: eval_step(s, b, k), state, cfg,
+        example_batch={k: example[k] for k in ("token_ids", "pad_mask")},
+        tokens_per_example=SEQ_CAP,
+    )
+    with trainer:
+        trainer.fit(dm.train_dataloader(), dm.val_dataloader())
+    rows = read_metrics(trainer.run_dir)
+    rates = [r["tokens_per_sec"] for r in rows if "tokens_per_sec" in r]
+    # steady state: drop the first half (covers every per-shape compile)
+    steady = rates[len(rates) // 2:] or rates
+    return statistics.median(steady)
+
+
+def main() -> None:
+    root = os.environ.get("PIT_ROOT", ".cache")
+    dm_b = make_module(root, BUCKETS)
+    frac, steps_frac = window_stats(dm_b)
+    print(f"full {K}-batch windows with buckets {BUCKETS}+cap: {frac:.1%} "
+          f"of windows, {steps_frac:.1%} of steps")
+
+    dm_s = make_module(root, None)
+    order = ["buckets", "static", "buckets", "static"]
+    rates = {"buckets": [], "static": []}
+    for which in order:
+        dm = dm_b if which == "buckets" else dm_s
+        r = trainer_rate(dm, which)
+        rates[which].append(r)
+        print(f"  {which:8s} K={K}: {r / 1e6:.3f}M tokens/s (trainer loop)")
+    b = statistics.median(rates["buckets"])
+    s = statistics.median(rates["static"])
+    print(
+        f"composed win: bucketed {b / 1e6:.3f}M vs static {s / 1e6:.3f}M "
+        f"tokens/s at K={K} -> {b / s:.3f}x ({(b / s - 1) * 100:+.1f}%)"
+    )
+
+
+if __name__ == "__main__":
+    main()
