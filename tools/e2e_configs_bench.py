@@ -69,12 +69,14 @@ def _image_classifier(image_shape, num_classes, latents, channels, blocks,
     )
 
 
-def _mlm_config(model_factory, batch_size: int, default_head: str):
+def _mlm_config(model_factory, batch_size: int, default_head: str,
+                seq: int = 512):
     """Shared MLM bench recipe (synthetic batch, gather decode, PIT_E2E_HEAD
     override: 'pallas'|'xla'|'none' — 'none' also feeds hbm_roofline's
     MFU-numerator build, where cost analysis must see the head's flops)."""
-    vocab, seq, b = 10003, 512, batch_size
-    model = model_factory(dtype=DTYPE, attn_impl=ATTN_IMPL or "xla")
+    vocab, b = 10003, batch_size
+    model = model_factory(dtype=DTYPE, attn_impl=ATTN_IMPL or "xla",
+                          max_seq_len=seq)
     batch = {
         "token_ids": jnp.asarray(rng.integers(3, vocab, (b, seq)).astype(np.int32)),
         "pad_mask": jnp.zeros((b, seq), bool),
@@ -114,7 +116,8 @@ def config_mlm_tpu():
     from perceiver_io_tpu.models.presets import flagship_tpu_mlm
 
     b = int(os.environ.get("PIT_MLM_TPU_BATCH", "64"))
-    return _mlm_config(flagship_tpu_mlm, b, "none")
+    seq = int(os.environ.get("PIT_MLM_TPU_SEQ", "512"))
+    return _mlm_config(flagship_tpu_mlm, b, "none", seq=seq)
 
 
 def config_seqclf():
