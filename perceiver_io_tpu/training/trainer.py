@@ -66,7 +66,12 @@ class TrainerConfig:
     max_to_keep: int = 1
     async_checkpoint: bool = True
     use_tensorboard: bool = True
-    compute_mfu: bool = True  # XLA cost-analysis FLOPs → MFU metric
+    # XLA cost-analysis FLOPs → in-loop MFU metric. Two caveats vs the
+    # authoritative tools/hbm_roofline.py number: cost analysis counts ZERO
+    # flops for Pallas custom-calls (configs whose hot ops run in the
+    # kernels — e.g. flow — under-report here), and the denominator is WALL
+    # time (tunnel/dispatch stalls deflate it relative to device time).
+    compute_mfu: bool = True
     profile_steps: int = 0  # capture a trace of this many steps after warmup
     profile_start_step: int = 10
     # preemption safety (SURVEY.md §5, restart-on-failure): on SIGTERM, save
