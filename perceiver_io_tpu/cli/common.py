@@ -74,7 +74,11 @@ def add_trainer_args(parser: argparse.ArgumentParser) -> None:
     g.add_argument("--max_to_keep", type=int, default=1)
     g.add_argument("--no_tensorboard", action="store_true")
     g.add_argument("--profile_steps", type=int, default=0,
-                   help="capture a profiler trace of N steps after warmup")
+                   help="capture a profiler trace of N OPTIMIZER steps after "
+                        "warmup (a K-step dispatch advances it by K; keep "
+                        "the window under a few seconds of device time — "
+                        "longer windows can overflow the xplane export, "
+                        "which the trainer now warns about)")
     g.add_argument("--steps_per_dispatch", type=int, default=1,
                    help="lax.scan N optimizer steps per device dispatch — "
                         "amortizes per-call latency on remote/tunneled "

@@ -26,6 +26,7 @@ import os
 import signal
 import threading
 import time
+import warnings
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -274,6 +275,32 @@ class Trainer:
         )
         self._flops_per_step = flops
 
+    def _warn_if_trace_empty(self) -> None:
+        """Post-capture sanity: very long profile windows (tens of device-
+        seconds — e.g. profile_steps counting optimizer steps under a large
+        steps_per_dispatch) can silently overflow the xplane export, leaving
+        a 0-byte ``*.xplane.pb`` next to a populated json trace (observed
+        r4: a 320-step K=16 window). Warn instead of letting the user
+        discover it at analysis time."""
+        import glob as _glob
+
+        dirs = sorted(_glob.glob(os.path.join(
+            self.run_dir, "plugins", "profile", "*")))
+        if not dirs:
+            return
+        # newest capture dir only (timestamp-named), ANY empty per-host file
+        # counts — one overflowed host must not hide behind another's
+        # populated export
+        paths = _glob.glob(os.path.join(dirs[-1], "*.xplane.pb"))
+        if paths and any(os.path.getsize(p) == 0 for p in paths):
+            warnings.warn(
+                "profiler capture produced an EMPTY xplane.pb — the profile "
+                "window was likely too long for the xplane export (note "
+                "profile_steps counts OPTIMIZER steps: a K-step dispatch "
+                "advances it by K). Use a window of at most a few seconds "
+                "of device time.", stacklevel=2,
+            )
+
     def _dispatch_batches(self, loader):
         """Yield ``(batch, n_steps)`` dispatch units: single loader batches
         (K=1), or up to K of them stacked on a new leading scan axis. A
@@ -499,6 +526,7 @@ class Trainer:
                         jax.profiler.stop_trace()
                         profiling_active = False
                         profile_captured = True
+                        self._warn_if_trace_empty()
 
                     n = cfg.log_every_n_steps
                     if step_i // n > prev_step // n:

@@ -482,3 +482,25 @@ def test_debug_nans_localizes_at_dispatch(tmp_path):
     trainer2 = Trainer(nan_step, None, state, cfg2, example_batch=batch)
     with trainer2:
         trainer2.fit([batch, batch, batch])
+
+
+def test_empty_profile_trace_warns(tmp_path, monkeypatch):
+    """A profiler capture whose xplane export came back EMPTY (the silent
+    overflow mode of very long windows, r4) must warn at capture time, not
+    fail silently until analysis."""
+    trainer, loaders = _make_parts(tmp_path)
+    trainer.config = dataclasses.replace(
+        trainer.config, profile_steps=1, profile_start_step=1, max_epochs=1,
+    )
+
+    # stand in for the overflow: stop_trace leaves a 0-byte xplane.pb
+    def fake_start(logdir):
+        d = os.path.join(logdir, "plugins", "profile", "x")
+        os.makedirs(d, exist_ok=True)
+        open(os.path.join(d, "host.xplane.pb"), "wb").close()
+
+    monkeypatch.setattr(jax.profiler, "start_trace", fake_start)
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    with trainer:
+        with pytest.warns(UserWarning, match="EMPTY xplane"):
+            trainer.fit(loaders[0], loaders[1])
