@@ -106,37 +106,50 @@ def test_checkpoint_written_once_and_loadable(reports):
         assert os.path.exists(ckpt_dir / d / "_CHECKPOINT_METADATA")
 
 
-def test_spawn_hosts_single_command_launch(tmp_path):
-    """--spawn_hosts 2: ONE command forks both ranks with coordinator flags
-    (the reference's one-command DDP UX, train_mlm.py:102-103). The launcher
-    must exit 0, both ranks must join a process_count=2 cluster, and rank 0
-    must produce a normal run dir with finite losses."""
+def _run_spawn_hosts(tmp_path, extra_args, max_steps=3,
+                     synthetic_size=32, seq=32):
+    """Launch train_mlm via --spawn_hosts 2 on the shared tiny model and
+    return (completed process, combined output tail, parsed train losses)."""
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     logdir = tmp_path / "logs"
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "train", "train_mlm.py"),
-         "--spawn_hosts", "2", "--synthetic", "--synthetic_size", "32",
-         "--batch_size", "16", "--max_seq_len", "32", "--vocab_size", "90",
+         "--spawn_hosts", "2", "--synthetic",
+         "--synthetic_size", str(synthetic_size),
+         "--batch_size", "16", "--max_seq_len", str(seq),
+         "--vocab_size", "90",
          "--num_latents", "8", "--num_latent_channels", "16",
          "--num_encoder_layers", "2",
          "--num_self_attention_layers_per_block", "1",
          "--num_cross_attention_heads", "2", "--num_self_attention_heads", "2",
-         "--dtype", "float32", "--max_steps", "3", "--log_every_n_steps", "1",
-         "--logdir", str(logdir), "--root", str(tmp_path / "cache")],
+         "--dtype", "float32", "--max_steps", str(max_steps),
+         "--log_every_n_steps", "1",
+         "--logdir", str(logdir), "--root", str(tmp_path / "cache"),
+         *extra_args],
         env=env, capture_output=True, text=True, timeout=600,
     )
     tail = (proc.stdout + proc.stderr)[-4000:]
+    losses = []
+    metrics = list(logdir.glob("mlm/version_*/metrics.jsonl"))
+    if metrics:
+        rows = [json.loads(l) for l in open(metrics[0])]
+        losses = [r["train_loss"] for r in rows if "train_loss" in r]
+    return proc, tail, losses
+
+
+def test_spawn_hosts_single_command_launch(tmp_path):
+    """--spawn_hosts 2: ONE command forks both ranks with coordinator flags
+    (the reference's one-command DDP UX, train_mlm.py:102-103). The launcher
+    must exit 0, both ranks must join a process_count=2 cluster, and rank 0
+    must produce a normal run dir with finite losses."""
+    import numpy as np
+
+    proc, tail, losses = _run_spawn_hosts(tmp_path, [])
     assert proc.returncode == 0, tail
     assert "launched 2 processes" in proc.stderr, tail
     assert "[distributed] process 0/2" in proc.stderr, tail
-
-    metrics = list(logdir.glob("mlm/version_*/metrics.jsonl"))
-    assert metrics, tail
-    rows = [json.loads(l) for l in open(metrics[0])]
-    losses = [r["train_loss"] for r in rows if "train_loss" in r]
-    import numpy as np
-    assert losses and np.isfinite(losses).all()
+    assert losses and np.isfinite(losses).all(), tail
 
 
 def test_spawn_hosts_buckets_and_multi_step_dispatch(tmp_path):
@@ -144,28 +157,30 @@ def test_spawn_hosts_buckets_and_multi_step_dispatch(tmp_path):
     2 real processes trains end to end (loader-decided global widths keep
     hosts in shape lockstep; K-grouped same-width runs keep dispatch windows
     homogeneous)."""
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    logdir = tmp_path / "logs"
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "train", "train_mlm.py"),
-         "--spawn_hosts", "2", "--synthetic", "--synthetic_size", "64",
-         "--batch_size", "16", "--max_seq_len", "256", "--vocab_size", "120",
-         "--bucket_widths", "128", "--length_sort_window", "2",
-         "--steps_per_dispatch", "2",
-         "--num_latents", "8", "--num_latent_channels", "16",
-         "--num_encoder_layers", "2",
-         "--num_self_attention_layers_per_block", "1",
-         "--num_cross_attention_heads", "2", "--num_self_attention_heads", "2",
-         "--dtype", "float32", "--max_steps", "4", "--log_every_n_steps", "1",
-         "--logdir", str(logdir), "--root", str(tmp_path / "cache")],
-        env=env, capture_output=True, text=True, timeout=600,
-    )
-    tail = (proc.stdout + proc.stderr)[-4000:]
-    assert proc.returncode == 0, tail
-    metrics = list(logdir.glob("mlm/version_*/metrics.jsonl"))
-    assert metrics, tail
-    rows = [json.loads(l) for l in open(metrics[0])]
-    losses = [r["train_loss"] for r in rows if "train_loss" in r]
     import numpy as np
-    assert losses and np.isfinite(losses).all()
+
+    proc, tail, losses = _run_spawn_hosts(
+        tmp_path,
+        ["--bucket_widths", "128", "--length_sort_window", "2",
+         "--steps_per_dispatch", "2"],
+        max_steps=4, synthetic_size=64, seq=256,
+    )
+    assert proc.returncode == 0, tail
+    assert losses and np.isfinite(losses).all(), tail
+
+
+def test_spawn_hosts_sequence_parallel_kernel_path(tmp_path):
+    """2 real processes x --sp 2 --shard_seq --attn_impl pallas_sp: the
+    distributed-flash route (shard_map'd kernel, S/n KV per device) trains
+    across a multi-host mesh — the long-context deployment shape. The sp
+    gradient canary must skip itself on multi-host (it probes eagerly with
+    host-local arrays) without blocking the run."""
+    import numpy as np
+
+    proc, tail, losses = _run_spawn_hosts(
+        tmp_path,
+        ["--sp", "2", "--shard_seq", "--attn_impl", "pallas_sp"],
+        max_steps=2, synthetic_size=64,
+    )
+    assert proc.returncode == 0, tail
+    assert losses and np.isfinite(losses).all(), tail
