@@ -180,10 +180,13 @@ def add_imdb_args(parser: argparse.ArgumentParser) -> None:
                         "widths that fits it (SPMD-safe bucketed padding — "
                         "the reference's pad-to-longest without dynamic "
                         "shapes; one cached compile per width). Combine with "
-                        "--length_sort_window. Incompatible with "
-                        "--steps_per_dispatch > 1 (stacked dispatch windows "
-                        "need one width) and with multi-host runs (per-host "
-                        "collation would pick inconsistent widths)")
+                        "--length_sort_window. Composes with "
+                        "--steps_per_dispatch (same-width batches are "
+                        "grouped into K-runs so stacked windows never mix "
+                        "widths) and with multi-host runs (the loader "
+                        "decides each global batch's width from shared "
+                        "token lengths, so hosts always agree); under "
+                        "--shard_seq every width must divide --sp")
     g.add_argument("--length_sort_window", type=int, default=8,
                    help="with --bucket_widths: sort examples by length within "
                         "windows of this many batches so batches are "
