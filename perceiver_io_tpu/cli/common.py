@@ -596,7 +596,10 @@ def parse_with_resume(parser: argparse.ArgumentParser, argv):
         return args
     from perceiver_io_tpu.training.checkpoint import load_hparams
 
-    hparams = load_hparams(os.path.join(args.resume, "checkpoints"))
+    try:
+        hparams = load_hparams(os.path.join(args.resume, "checkpoints"))
+    except (FileNotFoundError, NotADirectoryError):
+        raise SystemExit(_nothing_to_resume(args.resume)) from None
     known = vars(args)
     # environment/bring-up flags describe where THIS invocation runs, not the
     # training recipe — never inherit them from the original run (store_true
@@ -612,6 +615,16 @@ def parse_with_resume(parser: argparse.ArgumentParser, argv):
     return args
 
 
+def _nothing_to_resume(path: str) -> str:
+    return (
+        f"--resume {path}: no usable checkpoint under {path}/checkpoints — "
+        f"the run was probably interrupted before its first checkpoint "
+        f"(nothing to resume from; start fresh without --resume), or the "
+        f"path is not a run directory (expected the version_N dir "
+        f"containing checkpoints/)."
+    )
+
+
 def resume_state(args, state):
     """After building the fresh TrainState: restore the newest checkpoint of
     the ``--resume`` run (preferring the preemption ``last/`` slot). Returns
@@ -621,7 +634,13 @@ def resume_state(args, state):
         return state, None
     from perceiver_io_tpu.training.checkpoint import restore_train_state
 
-    state = restore_train_state(
-        os.path.join(args.resume, "checkpoints"), state, prefer_latest=True
-    )
+    try:
+        state = restore_train_state(
+            os.path.join(args.resume, "checkpoints"), state, prefer_latest=True
+        )
+    except (FileNotFoundError, NotADirectoryError):
+        # hparams.json is written at Trainer CONSTRUCTION, so a run killed
+        # between construction and its first checkpoint save passes the
+        # parse_with_resume guard but has no checkpoint steps to restore
+        raise SystemExit(_nothing_to_resume(args.resume)) from None
     return state, args.resume

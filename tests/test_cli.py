@@ -299,3 +299,32 @@ def test_bucketed_stacked_resume_is_bit_for_bit(tmp_path):
     assert tail_full and tail_full.keys() == tail_res.keys()
     for k in tail_full:
         assert tail_full[k] == tail_res[k], (k, tail_full[k], tail_res[k])
+
+
+def test_resume_nothing_to_resume_fails_clearly(tmp_path):
+    """--resume on a dir with no usable checkpoint must fail with the clear
+    nothing-to-resume message (not a raw traceback) in all three shapes: no
+    checkpoints/ at all, a regular file as the path, and the
+    killed-after-construction window where hparams.json exists but zero
+    checkpoint steps were saved."""
+    tiny = _common(tmp_path, "rz") + TINY_MODEL + [
+        "--synthetic_size", "32", "--max_seq_len", "32", "--vocab_size", "90",
+        "--batch_size", "8", "--max_steps", "1", "--log_every_n_steps", "1",
+    ]
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(SystemExit, match="no usable checkpoint"):
+        train_mlm.main(tiny + ["--resume", str(empty)])
+
+    not_a_dir = tmp_path / "file.txt"
+    not_a_dir.write_text("x")
+    with pytest.raises(SystemExit, match="no usable checkpoint"):
+        train_mlm.main(tiny + ["--resume", str(not_a_dir)])
+
+    constructed = tmp_path / "constructed"
+    (constructed / "checkpoints").mkdir(parents=True)
+    (constructed / "checkpoints" / "hparams.json").write_text(
+        json.dumps({"num_latents": 8}))
+    with pytest.raises(SystemExit, match="no usable checkpoint"):
+        train_mlm.main(tiny + ["--resume", str(constructed)])
