@@ -48,6 +48,8 @@ __all__ = [
     "import_lightning_checkpoint",
     "convert_hparams",
     "export_orbax_checkpoint",
+    "export_state_dict",
+    "export_lightning_checkpoint",
 ]
 
 
@@ -368,6 +370,193 @@ def import_lightning_checkpoint(
     if encoder_only:
         params = {"encoder": params["encoder"]}
     return params, convert_hparams(hparams)
+
+
+# -- reverse interop: flax params → reference torch checkpoint ---------------
+
+
+def _emit_linear(out: Dict[str, np.ndarray], dense: Mapping[str, Any],
+                 prefix: str) -> None:
+    out[f"{prefix}.weight"] = _np(dense["kernel"]).T.copy()
+    out[f"{prefix}.bias"] = _np(dense["bias"]).copy()
+
+
+def _emit_ln(out: Dict[str, np.ndarray], ln: Mapping[str, Any],
+             prefix: str) -> None:
+    out[f"{prefix}.weight"] = _np(ln["scale"]).copy()
+    out[f"{prefix}.bias"] = _np(ln["bias"]).copy()
+
+
+def _emit_mlp(out: Dict[str, np.ndarray], mlp: Mapping[str, Any],
+              prefix: str) -> None:
+    # Sequential(LN, Linear, GELU, Linear) → positional 0/1/3 (model.py:20-26)
+    _emit_ln(out, mlp["norm"], f"{prefix}.0")
+    _emit_linear(out, mlp["dense_1"], f"{prefix}.1")
+    _emit_linear(out, mlp["dense_2"], f"{prefix}.3")
+
+
+def _emit_mha(out: Dict[str, np.ndarray], attn: Mapping[str, Any],
+              prefix: str) -> None:
+    """Split q/k/v/out params → torch ``nn.MultiheadAttention`` tensors.
+
+    torch stores the MERGED ``in_proj_weight`` iff kdim == vdim == embed_dim
+    (the layout ``_finalize_mha`` splits on import) and the separate
+    ``{q,k,v}_proj_weight`` otherwise; the bias is always the stacked
+    ``in_proj_bias``. flax kernels are (in, out) → torch weights (out, in).
+    """
+    qw = _np(attn["q_proj"]["kernel"]).T
+    kw = _np(attn["k_proj"]["kernel"]).T
+    vw = _np(attn["v_proj"]["kernel"]).T
+    if qw.shape == kw.shape == vw.shape:
+        out[f"{prefix}.in_proj_weight"] = np.concatenate([qw, kw, vw], axis=0).copy()
+    else:
+        out[f"{prefix}.q_proj_weight"] = qw.copy()
+        out[f"{prefix}.k_proj_weight"] = kw.copy()
+        out[f"{prefix}.v_proj_weight"] = vw.copy()
+    out[f"{prefix}.in_proj_bias"] = np.concatenate([
+        _np(attn["q_proj"]["bias"]),
+        _np(attn["k_proj"]["bias"]),
+        _np(attn["v_proj"]["bias"]),
+    ]).copy()
+    _emit_linear(out, attn["out_proj"], f"{prefix}.out_proj")
+
+
+def _emit_attn_layer(out: Dict[str, np.ndarray], layer: Mapping[str, Any],
+                     prefix: str, kind: str) -> None:
+    """cross/self_attention_layer → Sequential(Residual(attn), Residual(mlp))
+    = ``{prefix}.0.module`` / ``{prefix}.1.module`` (model.py:29-44)."""
+    name = "cross_attention" if kind == "cross" else "self_attention"
+    mod = layer[name]
+    body = f"{prefix}.0.module"
+    for norm in ("q_norm", "kv_norm", "norm"):
+        if norm in mod:
+            _emit_ln(out, mod[norm], f"{body}.{norm}")
+    _emit_mha(out, mod["attention"], f"{body}.attention.attention")
+    _emit_mlp(out, layer["mlp"], f"{prefix}.1.module")
+
+
+def _emit_encoder(out: Dict[str, np.ndarray], enc: Mapping[str, Any],
+                  root: str) -> None:
+    adapter = enc.get("input_adapter", {})
+    known = {"text_embedding", "pos_encoding"}
+    if set(adapter) != known:
+        # image models land here too: the flax ImageInputAdapter holds NO
+        # params (its Fourier encoding is a deterministic buffer), so their
+        # encoder tree has no input_adapter subtree at all
+        raise ValueError(
+            f"export supports the reference's TEXT models (input_adapter "
+            f"with text_embedding + pos_encoding params); this encoder's "
+            f"input_adapter params are {sorted(adapter) or '{}'} — image "
+            f"adapters carry only a deterministic Fourier buffer in the "
+            f"reference, so there is nothing to export for them"
+        )
+    out[f"{root}.input_adapter.text_embedding.weight"] = _np(
+        adapter["text_embedding"]["embedding"]).copy()
+    out[f"{root}.input_adapter.pos_encoding"] = _np(
+        adapter["pos_encoding"]).copy()
+    out[f"{root}.latent"] = _np(enc["latent"]).copy()
+    for head in ("layer_1", "layer_n"):
+        if head not in enc:
+            continue  # num_layers == 1 has no shared layer_n
+        layer = enc[head]
+        _emit_attn_layer(out, layer["cross_attention_layer"],
+                         f"{root}.{head}.0", "cross")
+        block = layer["self_attention_block"]
+        for i in range(len(block)):
+            _emit_attn_layer(out, block[f"layer_{i}"],
+                             f"{root}.{head}.1.{i}", "self")
+
+
+def _emit_decoder(out: Dict[str, np.ndarray], dec: Mapping[str, Any],
+                  root: str) -> None:
+    out[f"{root}.output"] = _np(dec["output"]).copy()
+    _emit_attn_layer(out, dec["cross_attention_layer"],
+                     f"{root}.cross_attention", "cross")
+    _emit_linear(out, dec["output_adapter"]["linear"],
+                 f"{root}.output_adapter.linear")
+
+
+def export_state_dict(
+    params: Mapping[str, Any],
+    layout: str = "mlm",
+    lightning_prefix: bool = True,
+) -> Dict[str, np.ndarray]:
+    """flax params pytree → reference torch ``state_dict`` (the inverse of
+    :func:`convert_state_dict`, for moving checkpoints BACK to the reference).
+
+    ``layout``: ``'mlm'`` emits the ``PerceiverMLM`` named-child keys
+    (``encoder.…``/``decoder.…``, reference ``model.py:296-303``);
+    ``'classifier'`` emits the ``PerceiverIO`` Sequential's positional keys
+    (``0.…``/``1.…``, ``model.py:321-325``). ``lightning_prefix`` adds the
+    ``model.`` prefix Lightning modules carry (``lightning.py:87,183``).
+    Round-trip exactness (``convert_state_dict(export_state_dict(p)) == p``)
+    and strict ``load_state_dict`` into reference-shaped torch modules are
+    pinned by ``tests/test_interop.py``.
+    """
+    if layout not in ("mlm", "classifier"):
+        raise ValueError(f"layout must be 'mlm' or 'classifier', got {layout!r}")
+    enc_root, dec_root = (
+        ("encoder", "decoder") if layout == "mlm" else ("0", "1")
+    )
+    out: Dict[str, np.ndarray] = {}
+    _emit_encoder(out, params["encoder"], enc_root)
+    _emit_decoder(out, params["decoder"], dec_root)
+    if lightning_prefix:
+        out = {f"model.{k}": v for k, v in out.items()}
+    return out
+
+
+_HPARAM_RENAMES_BACK = {v: k for k, v in _HPARAM_RENAMES.items()}
+
+
+def export_lightning_checkpoint(
+    params: Mapping[str, Any],
+    path: str,
+    hparams: Optional[Mapping[str, Any]] = None,
+    layout: str = "mlm",
+    epoch: int = 0,
+    global_step: int = 0,
+) -> None:
+    """Write ``params`` as a Lightning-style ``.ckpt`` the REFERENCE can load
+    (``LitMLM.load_from_checkpoint`` / ``--mlm_checkpoint`` over there): a
+    torch pickle with ``state_dict`` (``model.``-prefixed), Lightning's
+    ``hyper_parameters`` (arg names renamed back to the reference's
+    encoder-prefixed spellings), and the epoch/step envelope. The file loads
+    under torch's safe ``weights_only=True`` unpickler — plain tensors and a
+    plain dict, no embedded code.
+    """
+    import torch
+
+    state_dict = {
+        k: torch.from_numpy(np.ascontiguousarray(v))
+        for k, v in export_state_dict(params, layout=layout).items()
+    }
+    hp = {
+        _HPARAM_RENAMES_BACK.get(k, k): v
+        for k, v in (hparams or {}).items()
+        if _is_jsonable(v)
+    }
+    torch.save(
+        {
+            "state_dict": state_dict,
+            "hyper_parameters": hp,
+            "epoch": int(epoch),
+            "global_step": int(global_step),
+            # PL >= 1.8's load_from_checkpoint runs checkpoint migration,
+            # which indexes this key before touching the state_dict — real
+            # Lightning files always carry it
+            "pytorch-lightning_version": "1.5.0",
+        },
+        path,
+    )
+
+
+def _is_jsonable(v) -> bool:
+    try:
+        json.dumps(v)
+        return True
+    except (TypeError, ValueError):
+        return False
 
 
 def export_orbax_checkpoint(

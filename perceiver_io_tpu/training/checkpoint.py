@@ -283,6 +283,37 @@ def restore_params(
     return restored["params"]
 
 
+def restore_raw_params(directory: str, step: Optional[int] = None,
+                       monitor: str = "val_loss", mode: str = "min"):
+    """Restore the params tree WITHOUT a caller template, as ``(params,
+    step)`` with host numpy/jax arrays in the saved structure — for tools
+    that only re-serialize the weights (e.g. the reference-checkpoint
+    export) and have no model to build a ``like`` tree from.
+
+    The template comes from the checkpoint's own metadata, restricted to
+    the ``params`` subtree — a full TrainState checkpoint also stores the
+    optimizer moments (~2x the param bytes), which a templateless restore
+    would read and materialize only to discard."""
+    with _read_manager(directory, monitor, mode) as mngr:
+        step = _resolve_step(mngr, step, directory)
+        # reading metadata (vs restoring) needs the handler declared upfront
+        with ocp.CheckpointManager(
+            os.path.abspath(directory),
+            options=ocp.CheckpointManagerOptions(
+                best_fn=lambda m: m.get(monitor, 0.0), best_mode=mode
+            ),
+            item_handlers={"state": ocp.StandardCheckpointHandler()},
+        ) as meta_mngr:
+            meta = meta_mngr.item_metadata(step)["state"]
+        like = jax.tree.map(
+            lambda m: np.zeros(m.shape, m.dtype), meta["params"]
+        )
+        restored = mngr.restore(
+            step, args=ocp.args.Composite(state=_partial_restore({"params": like}))
+        )["state"]
+    return restored["params"], int(step)
+
+
 def restore_encoder_params(
     directory: str, like_encoder_params, step: Optional[int] = None,
     subtree: str = "encoder", monitor: str = "val_loss", mode: str = "min",

@@ -1,21 +1,28 @@
-"""Import the reference's published artifacts into this framework.
+"""Import/export the reference's checkpoint artifacts (both directions).
 
-Two entry points, matching the two artifacts the reference ships
-(reference ``README.md:46-48``):
+Three entry points:
 
-- a PyTorch-Lightning checkpoint (``.ckpt``) → an Orbax checkpoint directory
-  in this framework's run layout, directly usable as ``--mlm_checkpoint DIR``
-  (transfer: encoder grafted into a fresh classifier, reference
-  ``train_seq_clf.py:18-24``), ``--clf_checkpoint DIR``, or
-  ``restore_params(DIR, …)`` for inference;
-- an HF ``tokenizers`` JSON (e.g. the cached ``imdb-tokenizer-10003.json``)
-  → verified loadable, optionally re-saved in either schema. Token ids index
-  embedding rows, so an imported checkpoint needs this exact vocab.
+- ``ckpt``: a PyTorch-Lightning checkpoint (``.ckpt``, reference
+  ``README.md:46-48``) → an Orbax checkpoint directory in this framework's
+  run layout, directly usable as ``--mlm_checkpoint DIR`` (transfer: encoder
+  grafted into a fresh classifier, reference ``train_seq_clf.py:18-24``),
+  ``--clf_checkpoint DIR``, or ``restore_params(DIR, …)`` for inference;
+- ``export``: the REVERSE — a run directory's checkpoint (this framework's
+  Orbax layout) → a Lightning-style ``.ckpt`` the reference loads
+  (``LitMLM.load_from_checkpoint`` / its ``--mlm_checkpoint``), so users can
+  move trained weights back; round-trip exactness + strict
+  ``load_state_dict`` into reference-shaped modules are pinned by
+  ``tests/test_interop.py``;
+- ``tokenizer``: an HF ``tokenizers`` JSON (e.g. the cached
+  ``imdb-tokenizer-10003.json``) → verified loadable, optionally re-saved in
+  either schema. Token ids index embedding rows, so a checkpoint moving in
+  either direction needs this exact vocab.
 
 Usage::
 
     python tools/import_reference.py ckpt  epoch=198-val_loss=4.619.ckpt -o runs/imported-mlm
     python tools/import_reference.py ckpt  model.ckpt -o out/ --encoder-only
+    python tools/import_reference.py export logs/mlm/version_0/checkpoints -o exported.ckpt
     python tools/import_reference.py tokenizer imdb-tokenizer-10003.json -o .cache/imdb-tokenizer-10003.json
 """
 
@@ -54,6 +61,33 @@ def _import_ckpt(args: argparse.Namespace) -> None:
         print("hparams:", {k: hparams[k] for k in shape_keys})
 
 
+def _export_ckpt(args: argparse.Namespace) -> None:
+    from perceiver_io_tpu.interop import export_lightning_checkpoint
+    from perceiver_io_tpu.training.checkpoint import (
+        load_hparams,
+        restore_raw_params,
+    )
+
+    params, step = restore_raw_params(args.checkpoint_dir)
+    hparams = {}
+    try:
+        hparams = load_hparams(args.checkpoint_dir)
+    except FileNotFoundError:
+        pass
+    export_lightning_checkpoint(
+        params, args.out, hparams=hparams or None, layout=args.layout,
+        global_step=step,
+    )
+    import jax
+
+    n_params = sum(leaf.size for leaf in jax.tree.leaves(params))
+    print(
+        f"exported {args.checkpoint_dir} (step {step}) -> {args.out}: "
+        f"{n_params:,} parameters as a reference-loadable Lightning .ckpt "
+        f"({args.layout} layout)"
+    )
+
+
 def _import_tokenizer(args: argparse.Namespace) -> None:
     from perceiver_io_tpu.data.tokenizer import WordPieceTokenizer
 
@@ -83,6 +117,19 @@ def main(argv=None) -> None:
                              "file (executes code embedded in the artifact — "
                              "only for checkpoints you trust)")
     p_ckpt.set_defaults(fn=_import_ckpt)
+
+    p_exp = sub.add_parser(
+        "export", help="export a checkpoint dir as a reference .ckpt")
+    p_exp.add_argument("checkpoint_dir",
+                       help="this framework's checkpoints/ dir (run layout)")
+    p_exp.add_argument("-o", "--out", required=True,
+                       help="Lightning .ckpt file to write")
+    p_exp.add_argument("--layout", choices=("mlm", "classifier"),
+                       default="mlm",
+                       help="reference model whose key space to emit: "
+                            "PerceiverMLM named children ('mlm') or the "
+                            "PerceiverIO Sequential ('classifier')")
+    p_exp.set_defaults(fn=_export_ckpt)
 
     p_tok = sub.add_parser("tokenizer", help="import/convert an HF tokenizers JSON")
     p_tok.add_argument("tokenizer")
