@@ -82,6 +82,7 @@ def main(argv: Optional[Sequence[str]] = None):
         dtype=common.DTYPES[args.dtype],
         attn_impl=args.attn_impl,
         remat=args.remat,
+        reuse_kv=not getattr(args, "no_reuse_kv", False),
     )
     example = next(iter(data.val_dataloader()))
     variables = model.init(

@@ -153,6 +153,7 @@ def build_optical_flow_model(
     dtype: jnp.dtype = jnp.float32,
     attn_impl: str = "auto",
     remat: bool = False,
+    reuse_kv: bool = True,
 ):
     """PerceiverIO for optical flow (defaults sized after the Perceiver IO
     paper's flow configuration; shrink everything for tests)."""
@@ -180,6 +181,7 @@ def build_optical_flow_model(
             dtype=dtype,
             attn_impl=attn_impl,
             remat=remat,
+            reuse_kv=reuse_kv,
         ),
         decoder=PerceiverDecoder(
             output_adapter=DenseSpatialOutputAdapter(

@@ -348,6 +348,7 @@ def build_multimodal_autoencoder(
     dtype: jnp.dtype = jnp.float32,
     attn_impl: str = "auto",
     remat: bool = False,
+    reuse_kv: bool = True,
 ):
     """PerceiverIO mapping {'video', 'audio'} → {'video', 'audio', 'label'}
     (Kinetics-style multimodal autoencoding + classification; defaults sized
@@ -429,6 +430,7 @@ def build_multimodal_autoencoder(
             dtype=dtype,
             attn_impl=attn_impl,
             remat=remat,
+            reuse_kv=reuse_kv,
         ),
         decoder=PerceiverDecoder(
             output_adapter=output_adapter,

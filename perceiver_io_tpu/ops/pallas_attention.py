@@ -59,6 +59,14 @@ PAD_BIAS = 2.0 * MASK_VALUE
 _LANES = 128
 DEFAULT_KV_BLOCK = 512
 DEFAULT_Q_BLOCK = 512
+# Test hook (tests/test_pallas_attention.py fuzz): force the COMPILED lane
+# alignment while running the kernel in interpret mode, so CPU property
+# tests drive the exact divisor/padding/full-residency resolution branches
+# hardware takes (interpret alone resolves with alignment=1, which skips
+# them all — the two resolution bugs on record, the 131k row-divisor
+# pathology and the awkward-S guard ordering, were only ever reachable at
+# lane alignment). None = derive from ``interpret`` as usual.
+_TEST_ALIGNMENT: Optional[int] = None
 # Larger query blocks measure +3.7-5.1% at streamed-KV shapes (flow
 # encoder-cross sweep, PERF.md r3), but VMEM safety depends on the RESOLVED
 # block triple, not the raw shape: the sweep's compile boundary at d=512 is
@@ -84,20 +92,26 @@ LONG_KV_SAFE_PROBS = 1024 * 1024
 # that costs scales with d. Measured (PERF.md r3 kv sweep, fwd+bwd): d=16
 # S=131k kv 512→2048 is 3.47→2.45 ms (and 2048 + q capped at 512 beats
 # 512 + q 1024 everywhere tried); d=64 S=2048 (flow-self) 1.34→0.98 ms;
-# d=128 S=50k kv 512→1024 is 8.55→6.44 ms (2048 no better); d=512 kv ≥ 1024
-# is the flow sweep's measured scoped-VMEM OOM, so deep heads stay at 512.
-# Every tier keeps the KV-side footprint s_blk·d ≤ the 2048·64 = 131072
-# envelope all the measurements share; S shorter than the block resolves to
+# d=128 was 1024 through r4 (S=50k in-8h 8.55→6.44, with 2048 measuring "no
+# better" that session) — re-swept in r5 at the TPU-width long-context
+# shapes, where the sequential grid is longer and b·h parallelism smaller:
+# kv2048 wins 9-12% at (1,256,131k,4,128)/(8,256,8k,4,128) AND re-measures
+# ahead at in-8h itself (7.44-7.65 vs 7.81-7.85 ms, interleaved ×2), so the
+# d≤128 tier is now 2048. kv4096 measured a further ~3% at t=256 shapes but
+# is a REAL remote-compile OOM at in-8h's t=512 (probs area 512·4096 = 2M >
+# the 1M boundary — the guard below must shrink it, so the tier stays 2048);
+# d=512 kv ≥ 1024 is the flow sweep's measured scoped-VMEM OOM, so deep
+# heads stay at 512. The measured KV-side footprint envelope is now
+# s_blk·d ≤ 2048·128 = 262144 (compile-checked at the r5 sweep shapes and
+# by tools/kernel_smoke.py per round); S shorter than the block resolves to
 # full-dim/divisor blocks exactly as an explicit request would.
 
 
 def _auto_kv_block(
     s: int, d: int, t: int, alignment: int, q_block_size: Optional[int]
 ) -> int:
-    if d <= 64:
+    if d <= 128:
         kv = 2048
-    elif d <= 128:
-        kv = 1024
     else:
         return DEFAULT_KV_BLOCK
     # The widened KV block must keep the resolved (t_blk, s_blk) probs area
@@ -421,7 +435,7 @@ def _prepare_blocks(q, k, v, bias, kv_block_size, q_block_size, interpret):
     # (a multiple of 128, or the full dim); when S has no aligned divisor, pad
     # it up to a block multiple with PAD_BIAS keys (excluded from the softmax
     # even on fully-masked rows).
-    alignment = 1 if interpret else _LANES
+    alignment = _TEST_ALIGNMENT or (1 if interpret else _LANES)
     if kv_block_size is None:
         kv_block_size = _auto_kv_block(s, d, t, alignment, q_block_size)
     s_blk = _kv_block_size(s, kv_block_size, alignment)

@@ -52,6 +52,11 @@ from jax.experimental.pallas import tpu as pltpu
 Array = jax.Array
 
 _LANES = 128
+# Test hook (tests/test_pallas_ce.py fuzz): force the compiled sublane
+# alignment in interpret-mode runs so CPU property tests exercise the same
+# row-block padding rule hardware takes (see the matching hook in
+# pallas_attention.py). None = derive from ``interpret``.
+_TEST_ALIGNMENT = None
 # Finite stand-ins (see pallas_attention): PAD_BIAS marks kernel-added vocab
 # padding; exp(PAD_BIAS - anything_live) underflows to exactly 0.
 MASK_VALUE = -1e30
@@ -297,7 +302,7 @@ def _row_block(r: int, requested: int, interpret: bool) -> int:
     (R = 39328 = 32·1229, 1229 prime): the largest aligned divisor is 32,
     giving a 12,290-step grid and 16.6 ms of a 38 ms step; padding 96 dead
     rows keeps the 512-row block and a 770-step grid instead."""
-    align = 1 if interpret else 8  # f32 sublane tile
+    align = _TEST_ALIGNMENT or (1 if interpret else 8)  # f32 sublane tile
     requested = max(align, requested - requested % align)
     return min(requested, -(-r // align) * align)
 

@@ -297,3 +297,101 @@ def test_flagship_tpu_preset_shapes():
     assert model.encoder.num_cross_attention_heads == 4
     # 3 encoder layers = layer_1 + shared layer_n applied twice
     assert model.encoder.num_layers == 3
+
+
+class TestSharedLayerKVReuse:
+    """reuse_kv=True (the default) caches the shared layer_n cross-attention
+    K/V projections across recurrent applications — identical weights on the
+    identical input make the repeat pure recompute (models/perceiver.py).
+    The cache is the SAME tensor reused, so the forward must be bit-exact
+    against recompute; gradients reassociate one near-cancelling reduction
+    (dk1+dk2 summed before vs after the dW matmul) and agree to fp noise."""
+
+    def _encoder(self, reuse, remat=False):
+        return PerceiverEncoder(
+            input_adapter=TextInputAdapter(
+                vocab_size=VOCAB, max_seq_len=MAX_LEN, num_channels=C,
+                dtype=jnp.float32,
+            ),
+            latent_shape=(8, C),
+            num_layers=3,
+            num_self_attention_layers_per_block=2,
+            reuse_kv=reuse,
+            remat=remat,
+        )
+
+    def test_forward_bit_exact_and_grads_close(self):
+        x = jnp.asarray(
+            np.random.default_rng(3).integers(0, VOCAB, (2, MAX_LEN)), jnp.int32
+        )
+        enc_a, enc_b = self._encoder(True), self._encoder(False)
+        va = enc_a.init({"params": jax.random.key(0)}, x)
+        # param trees identical: the cache changes no module structure
+        vb = enc_b.init({"params": jax.random.key(0)}, x)
+        assert all(
+            bool((a == b).all())
+            for a, b in zip(
+                jax.tree_util.tree_leaves(va), jax.tree_util.tree_leaves(vb)
+            )
+        )
+        out_a = enc_a.apply(va, x)
+        out_b = enc_b.apply(va, x)
+        assert bool((out_a == out_b).all())
+
+        def loss(params, enc):
+            return jnp.sum(enc.apply({"params": params}, x) ** 2)
+
+        ga = jax.grad(loss)(va["params"], enc_a)
+        gb = jax.grad(loss)(va["params"], enc_b)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(ga), jax.tree_util.tree_leaves(gb)
+        ):
+            # atol floor: leaves whose true grad nearly cancels (k_proj/bias)
+            # sit at ~1e-6 magnitude, where the dk1+dk2 reassociation IS the
+            # signal — only relative structure above the noise floor matters
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b),
+                rtol=2e-5, atol=max(1e-5, 1e-4 * float(jnp.abs(b).max())),
+            )
+
+    def test_remat_composes_with_reuse(self):
+        """The kv cache crosses the nn.remat boundary as a pytree argument
+        (no static bool — PerceiverLayer always returns (latent, kv))."""
+        x = jnp.asarray(
+            np.random.default_rng(4).integers(0, VOCAB, (2, MAX_LEN)), jnp.int32
+        )
+        enc, enc_r = self._encoder(True), self._encoder(True, remat=True)
+        v = enc.init({"params": jax.random.key(0)}, x)
+        assert bool((enc_r.apply(v, x) == enc.apply(v, x)).all())
+
+        def loss(params, e):
+            return jnp.sum(e.apply({"params": params}, x) ** 2)
+
+        g, gr = jax.grad(loss)(v["params"], enc), jax.grad(loss)(v["params"], enc_r)
+        for a, b in zip(jax.tree_util.tree_leaves(g), jax.tree_util.tree_leaves(gr)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-5)
+
+
+def test_scaled_embed_matches_post_scale_bitwise():
+    """_ScaledEmbed pre-scales the (vocab, C) table before the gather —
+    bit-identical to gathering then multiplying by sqrt(C) (the reference
+    formula, adapter.py:112-133) in both f32 and bf16 compute, while moving
+    the multiply off the (B, L, C) stream (PERF.md r5)."""
+    from perceiver_io_tpu.models.adapters import _ScaledEmbed
+
+    for dtype in (jnp.float32, jnp.bfloat16):
+        adapter = TextInputAdapter(
+            vocab_size=VOCAB, max_seq_len=MAX_LEN, num_channels=C, dtype=dtype
+        )
+        x = jnp.asarray(
+            np.random.default_rng(5).integers(0, VOCAB, (3, MAX_LEN)), jnp.int32
+        )
+        v = adapter.init({"params": jax.random.key(7)}, x)
+        out = adapter.apply(v, x)
+        table = v["params"]["text_embedding"]["embedding"].astype(dtype)
+        pos = v["params"]["pos_encoding"][:MAX_LEN].astype(dtype)
+        ref = jnp.take(table, x, axis=0) * jnp.asarray(C**0.5, dtype) + pos
+        # same per-element multiply either side of the gather
+        assert bool((out == ref).all()) or np.allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=0
+        )
