@@ -510,3 +510,65 @@ def test_grouped_loader_resume_prefix_property():
         assert len(resumed) == len(full) - skip, (trial, skip)
         for a, b in zip(full[skip:], resumed):
             np.testing.assert_array_equal(a, b)
+
+
+def test_bucketed_module_multihost_EVAL_width_agreement(tmp_path):
+    """Eval rides the same width oracle as train (r5 — the last reference
+    behavior without an equivalent: pad-to-longest eval batches, reference
+    data/imdb.py:55-57). Two shard instances must collate identical eval
+    shapes step for step, order untouched (sort_window=0), with short
+    batches actually landing in the small bucket."""
+    from perceiver_io_tpu.data.imdb import IMDBDataset
+
+    texts = ["a good movie"] * 64 + [" ".join(["word"] * 200)] * 64
+    labels = [0, 1] * 64
+    mods = []
+    for shard in (0, 1):
+        dm = IMDBDataModule(root=str(tmp_path), max_seq_len=256, vocab_size=200,
+                            batch_size=8, synthetic=True, synthetic_size=128,
+                            bucket_widths=[128], length_sort_window=4,
+                            shard_id=shard, num_shards=2)
+        dm.prepare_data()
+        dm.setup()
+        dm.ds_valid = IMDBDataset(texts, labels)
+        dm._valid_token_lengths = np.asarray(
+            [len(e) for e in dm.tokenizer.encode_batch(texts)], dtype=np.int64
+        )
+        mods.append(dm)
+    steps = [list(dm.val_dataloader()) for dm in mods]
+    assert len(steps[0]) == len(steps[1]) > 0
+    widths = []
+    for b0, b1 in zip(*steps):
+        assert b0["token_ids"].shape == b1["token_ids"].shape  # hosts agree
+        assert b0["token_ids"].shape[0] == 4
+        widths.append(b0["token_ids"].shape[1])
+    # order is NOT sorted (sort_window=0): the corpus lays shorts first, longs
+    # second, so the width sequence is a prefix of 128s then 256s — and both
+    # buckets fire
+    assert set(widths) == {128, 256}
+    assert widths == sorted(widths)  # shorts (128) precede longs (256)
+    # eval labels arrive in dataset order (no reordering)
+    flat = np.concatenate([b["label"] for b in steps[0]])
+    assert flat.tolist() == [l for i, l in enumerate(labels) if i % 8 < 4]
+
+
+def test_eval_bucketing_single_host_keeps_full_set(tmp_path):
+    """Single-host bucketed eval: every example present, order preserved,
+    partial tail batch allowed (drop_last=False), widths from the oracle."""
+    from perceiver_io_tpu.data.imdb import IMDBDataset
+
+    dm = IMDBDataModule(root=str(tmp_path), max_seq_len=256, vocab_size=200,
+                        batch_size=8, synthetic=True, synthetic_size=64,
+                        bucket_widths=[128], length_sort_window=4)
+    dm.prepare_data()
+    dm.setup()
+    texts = ["short text"] * 21 + [" ".join(["word"] * 200)] * 14  # 35 = 4*8+3
+    dm.ds_valid = IMDBDataset(texts, [0] * 35)
+    dm._valid_token_lengths = np.asarray(
+        [len(e) for e in dm.tokenizer.encode_batch(texts)], dtype=np.int64
+    )
+    batches = list(dm.val_dataloader())
+    assert sum(b["token_ids"].shape[0] for b in batches) == 35
+    assert batches[-1]["token_ids"].shape[0] == 3  # tail kept
+    assert batches[0]["token_ids"].shape[1] == 128  # shorts in the small bucket
+    assert batches[-1]["token_ids"].shape[1] == 256

@@ -129,7 +129,106 @@ def device_step_ms(width: int) -> float:
     return seconds * 1e3
 
 
+def eval_width_shares(root: str) -> dict:
+    """share(width) over the EVAL split under the r5 eval width oracle
+    (``val_dataloader``: sort_window=0, widths from the val token-length
+    table — the reference's pad-to-longest eval behavior, SPMD-safe)."""
+    from perceiver_io_tpu.data.imdb import IMDBDataModule
+
+    have_real = os.path.isdir(os.path.join(root, "IMDB", "aclImdb", "train"))
+    dm = IMDBDataModule(
+        root=root, max_seq_len=SEQ_CAP, vocab_size=VOCAB, batch_size=BATCH,
+        synthetic=not have_real, synthetic_size=4096,
+        bucket_widths=BUCKETS, length_sort_window=8,
+    )
+    if not have_real:
+        dm._train_texts = lambda: realistic_corpus(4096)  # type: ignore
+        dm._valid_texts = lambda: realistic_corpus(4096, seed=3)  # type: ignore
+    dm.prepare_data()
+    dm.setup()
+    counts: Counter = Counter()
+    for b in dm.val_dataloader():
+        counts[b["token_ids"].shape[1]] += 1
+    total = sum(counts.values())
+    return {w: c / total for w, c in sorted(counts.items())}
+
+
+def device_eval_step_ms(width: int) -> float:
+    """Device-trace eval (forward-only) step time at a width."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.models.presets import flagship_mlm
+    from perceiver_io_tpu.training import (
+        OptimizerConfig,
+        TrainState,
+        make_mlm_steps,
+        make_optimizer,
+        mlm_gather_capacity,
+    )
+    from perceiver_io_tpu.utils import xplane
+
+    model = flagship_mlm(
+        vocab_size=VOCAB, max_seq_len=SEQ_CAP, num_latents=256,
+        num_channels=64, dtype=jnp.bfloat16, attn_impl="xla",
+    )
+    rng = np.random.default_rng(0)
+    batch = {
+        "token_ids": jnp.asarray(
+            rng.integers(3, VOCAB, (BATCH, width)).astype(np.int32)),
+        "pad_mask": jnp.zeros((BATCH, width), bool),
+    }
+    full = {
+        "token_ids": jnp.asarray(
+            rng.integers(3, VOCAB, (BATCH, SEQ_CAP)).astype(np.int32)),
+        "pad_mask": jnp.zeros((BATCH, SEQ_CAP), bool),
+    }
+    variables = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        full["token_ids"], full["pad_mask"],
+    )
+    tx, _ = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    state = TrainState.create(variables["params"], tx, jax.random.key(2))
+    head = "pallas" if jax.default_backend() == "tpu" else False
+    _, eval_step, _ = make_mlm_steps(
+        model, loss_gather_capacity=mlm_gather_capacity(SEQ_CAP),
+        fused_head=head,
+    )
+    jitted = jax.jit(eval_step)
+    key = jax.random.key(9)
+    float(jitted(state, batch, key)["loss"])  # compile
+    td = tempfile.mkdtemp(prefix=f"evalw{width}_")
+    with jax.profiler.trace(td):
+        for i in range(12):
+            with jax.profiler.StepTraceAnnotation("e", step_num=i):
+                m = jitted(state, batch, key)
+        float(m["loss"])
+    sec, _ = xplane.device_step_seconds(td, skip_first=2)
+    return sec * 1e3
+
+
+def eval_main() -> None:
+    shares = eval_width_shares(os.environ.get("PIT_ROOT", ".cache"))
+    print("eval bucket shares (r5 width oracle, order preserved):",
+          {w: f"{s:.1%}" for w, s in shares.items()})
+    times = {w: device_eval_step_ms(w) for w in sorted(set(shares) | {SEQ_CAP})}
+    for w, ms in times.items():
+        print(f"  width {w}: {ms:.3f} ms/eval-step (device)")
+    bucketed = sum(shares[w] * times[w] for w in shares)
+    static = times[SEQ_CAP]
+    print(
+        f"eval cost: bucketed {bucketed:.3f} ms/step avg vs static "
+        f"{static:.3f} -> {static / bucketed:.3f}x "
+        f"({(static / bucketed - 1) * 100:+.1f}% eval throughput)"
+    )
+
+
 def main() -> None:
+    if "--eval" in sys.argv:
+        eval_main()
+        return
     shares = batch_width_shares(os.environ.get("PIT_ROOT", ".cache"))
     print("bucket shares over one epoch:",
           {w: f"{s:.1%}" for w, s in shares.items()})
