@@ -178,15 +178,54 @@ def _maybe_kernel_smoke() -> None:
             or os.environ.get("PIT_SKIP_KERNEL_SMOKE") == "1"):
         return
     root = os.path.dirname(os.path.abspath(__file__))
+    # A wedged/crashed smoke run must be DISTINGUISHABLE from a passing one:
+    # otherwise last round's KERNELSMOKE.json sits there looking fresh. The
+    # artifact is best-effort (the headline already printed, and stdout's
+    # one-JSON-line contract holds), but failures get a stderr note and a
+    # stale artifact gets stamped so its age is self-evident.
+    out_path = os.path.join(root, "KERNELSMOKE.json")
     try:
-        subprocess.run(
+        mtime_before = os.path.getmtime(out_path)
+    except OSError:
+        mtime_before = None
+    try:
+        proc = subprocess.run(
             [sys.executable, os.path.join(root, "tools", "kernel_smoke.py"),
-             "--out", os.path.join(root, "KERNELSMOKE.json")],
+             "--out", out_path],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             timeout=900, check=False,
         )
+        failure = f"exit code {proc.returncode}" if proc.returncode else None
+    except Exception as e:  # timeout, spawn failure
+        failure = repr(e)
+    try:
+        refreshed = os.path.getmtime(out_path) != mtime_before
+    except OSError:
+        refreshed = False
+    if failure is not None and refreshed:
+        # a non-zero exit with a rewritten artifact means the smoke RAN and
+        # recorded regressions in its failures map — that is the signal the
+        # artifact exists to carry, not staleness
+        print(f"bench: kernel smoke reported failures ({failure}) — see the "
+              f"failures map in {out_path}", file=sys.stderr)
+    elif failure is not None:
+        print(f"bench: kernel smoke did NOT refresh {out_path} ({failure}) — "
+              "the artifact on disk is from an earlier run", file=sys.stderr)
+        _stamp_stale_kernel_smoke(out_path, failure)
+
+
+def _stamp_stale_kernel_smoke(out_path: str, failure: str) -> None:
+    """Mark the existing artifact as NOT refreshed by this bench run."""
+    try:
+        with open(out_path) as f:
+            data = json.load(f)
+        data["stale"] = True
+        data["stale_reason"] = f"kernel_smoke failed under bench.py: {failure}"
+        with open(out_path, "w") as f:
+            json.dump(data, f)
+            f.write("\n")
     except Exception:
-        pass  # the artifact is best-effort; the headline already printed
+        pass  # no artifact to stamp, or unwritable — the stderr note stands
 
 
 if __name__ == "__main__":

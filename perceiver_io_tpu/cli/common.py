@@ -112,14 +112,18 @@ def add_mesh_args(parser: argparse.ArgumentParser) -> None:
                    help="shard batches over the seq mesh axis: token axis for "
                         "text, first spatial axis for image/frames (must be "
                         "divisible by sp)")
-    g.add_argument("--zero", dest="zero_opt", action="store_true",
-                   help="ZeRO-style optimizer-state sharding over the data "
-                        "axis (per-chip Adam mu/nu footprint / dp)")
-    g.add_argument("--zero3", dest="zero_opt", action="store_const",
-                   const="params",
-                   help="ZeRO-3/FSDP flavor: PARAMS shard over the data axis "
-                        "too (all-gather-on-use + reduce-scatter inserted by "
-                        "GSPMD); implies --zero")
+    # mutually exclusive: both share dest zero_opt, and letting argparse's
+    # last-flag-wins silently downgrade '--zero3 --zero' to opt-state-only
+    # sharding would be a surprise (--zero3 already implies --zero)
+    zg = g.add_mutually_exclusive_group()
+    zg.add_argument("--zero", dest="zero_opt", action="store_true",
+                    help="ZeRO-style optimizer-state sharding over the data "
+                         "axis (per-chip Adam mu/nu footprint / dp)")
+    zg.add_argument("--zero3", dest="zero_opt", action="store_const",
+                    const="params",
+                    help="ZeRO-3/FSDP flavor: PARAMS shard over the data axis "
+                         "too (all-gather-on-use + reduce-scatter inserted by "
+                         "GSPMD); implies --zero (mutually exclusive with it)")
     g.add_argument("--spawn_hosts", type=int, default=None, metavar="N",
                    help="one-command multi-process launch (the reference's "
                         "'--accelerator=ddp --gpus=-1' UX): fork N copies of "
@@ -153,6 +157,12 @@ def add_compute_args(parser: argparse.ArgumentParser) -> None:
                         "small-latent kernel (PERF.md)")
     g.add_argument("--remat", action="store_true",
                    help="rematerialize encoder layers (HBM for FLOPs)")
+    g.add_argument("--no_reuse_kv", action="store_true",
+                   help="recompute the shared layer_n cross-attention K/V "
+                        "projections per recurrent application instead of "
+                        "caching them (the cache is exact and measured "
+                        "faster — PERF.md r5; this is the off switch for "
+                        "A/Bs and minimal-live-memory remat runs)")
     g.add_argument("--pad_vocab_multiple", type=int, default=None,
                    help="round the vocab/class projection width up to this "
                         "multiple (padded logits pinned to -1e30) so it "
@@ -296,6 +306,7 @@ def build_text_encoder(args, vocab_size: int, max_seq_len: int) -> pit.Perceiver
         dtype=dtype,
         attn_impl=args.attn_impl,
         remat=args.remat,
+        reuse_kv=not getattr(args, "no_reuse_kv", False),
     )
 
 
@@ -369,6 +380,7 @@ def build_image_classifier(
             dtype=dtype,
             attn_impl=args.attn_impl,
             remat=args.remat,
+            reuse_kv=not getattr(args, "no_reuse_kv", False),
         ),
         decoder=pit.PerceiverDecoder(
             output_adapter=pit.ClassificationOutputAdapter(
@@ -409,7 +421,7 @@ def maybe_spawn_hosts(args, argv=None) -> bool:
     from a single invocation (reference ``train_mlm.py:102-103``); the JAX
     equivalent normally needs one launch per process with coordinator flags
     (CLAUDE.md multi-host recipe). This dev helper closes the UX gap: it
-    re-executes this exact command N times with
+    re-executes this command N times with
     ``--coordinator_address localhost:PORT --num_processes N --process_id R``
     appended and ``JAX_PLATFORMS=cpu`` in each child's env (a simulation
     harness — real TPU pods auto-detect the coordinator via ``--multihost``,
@@ -417,6 +429,20 @@ def maybe_spawn_hosts(args, argv=None) -> bool:
     launcher (training ran in the children; the caller should return), False
     when training should proceed in-process. Child failure raises
     ``SystemExit`` with the first non-zero return code.
+
+    The child command: for CLI invocations (``argv is None``) the children
+    re-run ``sys.executable sys.argv[0]``. For PROGRAMMATIC calls —
+    ``main(explicit_argv)`` from a library/REPL/pytest, where ``sys.argv[0]``
+    is whatever binary happens to be running and must NOT be re-executed with
+    training flags — the children run ``python -m <calling cli module>``
+    instead (the module is read from the caller's frame).
+
+    The coordinator port is picked bind-then-close, which leaves a TOCTOU
+    window where another process can grab it before rank 0's
+    ``jax.distributed`` service binds. A stolen port makes the children fail
+    during init, well before training starts — so a launch whose first
+    failure lands within ``_SPAWN_RETRY_WINDOW_S`` is retried (fresh port,
+    same command) up to two more times before the failure is reported.
     """
     import socket
     import subprocess
@@ -437,105 +463,192 @@ def maybe_spawn_hosts(args, argv=None) -> bool:
             pass
         else:
             child_argv.append(a)
+    if argv is None:
+        target = [sys.executable, sys.argv[0]]
+    else:
+        caller_mod = sys._getframe(1).f_globals.get("__name__")
+        if caller_mod and caller_mod != "__main__":
+            target = [sys.executable, "-m", caller_mod]
+        else:
+            # a script's own main(argv) — its file path is still the command
+            target = [sys.executable, sys.argv[0]]
     import tempfile
     import time
 
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        port = s.getsockname()[1]
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    procs, logs = [], []
-    for rank in range(n):
-        cmd = [sys.executable, sys.argv[0], *child_argv,
-               "--coordinator_address", f"localhost:{port}",
-               "--num_processes", str(n), "--process_id", str(rank)]
-        # rank 0 inherits stdout/stderr (it owns logging/checkpoints); the
-        # others write to temp files — NEVER undrained pipes, which fill the
-        # OS buffer once a child emits ~64KB and deadlock the whole cluster —
-        # replayed only on failure
-        if rank == 0:
-            out, log = None, None
-        else:
-            log = tempfile.NamedTemporaryFile(
-                mode="w+", prefix=f"spawn_hosts_rank{rank}_", suffix=".log",
-                delete=False,
-            )
-            out = log
-        logs.append(log)
-        procs.append(subprocess.Popen(
-            cmd, env=env, stdout=out,
-            stderr=subprocess.STDOUT if rank else None, text=True,
-        ))
-    print(f"--spawn_hosts: launched {n} processes "
-          f"(coordinator localhost:{port})", file=sys.stderr)
+    if len(target) == 3:
+        # `-m` children must resolve the package even when the parent
+        # imported it from a path not on the default sys.path
+        import perceiver_io_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(perceiver_io_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+
     import signal
 
-    def _reap(live):
-        for r in live:
-            procs[r].terminate()
-        for r in live:
-            try:
-                procs[r].wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                procs[r].kill()
-
-    # the launcher must never outlive-orphan its children: SIGTERM/SIGINT
-    # (Ctrl-C, `timeout`, a scheduler preemption) reaps them before exiting
-    prev_handlers = {}
-
-    def _on_signal(signum, frame):
-        _reap([r for r in range(n) if procs[r].poll() is None])
-        raise SystemExit(128 + signum)
-
-    for sig in (signal.SIGTERM, signal.SIGINT):
-        try:
-            prev_handlers[sig] = signal.signal(sig, _on_signal)
-        except ValueError:
-            pass  # non-main thread (programmatic use) — skip the handlers
-    # poll rather than wait in rank order: a crashed child leaves the
-    # survivors blocked in collectives, so the first non-zero exit
-    # terminates the rest instead of hanging the launcher forever
-    failed = None
-    live = list(range(n))
-    try:
-        while live and failed is None:
-            for r in list(live):
-                rc = procs[r].poll()
-                if rc is not None:
-                    live.remove(r)
-                    if rc != 0:
-                        failed = (r, rc)
-                        break
-            time.sleep(0.2)
-        if failed is not None:
-            rank, rc = failed
-            _reap(live)
-            if logs[rank] is not None:
-                logs[rank].flush()
-                logs[rank].seek(0)
-                print(
-                    f"--- rank {rank} output ---\n{logs[rank].read()[-4000:]}",
-                    file=sys.stderr,
+    last_failure = None
+    for attempt in range(_SPAWN_PORT_RETRIES + 1):
+        with socket.socket() as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        procs, logs = [], []
+        for rank in range(n):
+            cmd = [*target, *child_argv,
+                   "--coordinator_address", f"localhost:{port}",
+                   "--num_processes", str(n), "--process_id", str(rank)]
+            # rank 0 inherits stdout/stderr (it owns logging/checkpoints); the
+            # others write to temp files — NEVER undrained pipes, which fill
+            # the OS buffer once a child emits ~64KB and deadlock the whole
+            # cluster — replayed only on failure
+            if rank == 0:
+                out, log = None, None
+            else:
+                log = tempfile.NamedTemporaryFile(
+                    mode="w+", prefix=f"spawn_hosts_rank{rank}_", suffix=".log",
+                    delete=False,
                 )
-                print(f"(full rank-{rank} log kept at {logs[rank].name})",
-                      file=sys.stderr)
-            raise SystemExit(rc)
-    finally:
-        for sig, h in prev_handlers.items():
-            signal.signal(sig, h)
-        # close every log handle; delete all but a failed rank's (kept for
-        # replay) so repeated dev runs don't litter /tmp
-        for rank, log in enumerate(logs):
-            if log is None:
-                continue
-            log.close()
-            if failed is None or rank != failed[0]:
+                out = log
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                cmd, env=env, stdout=out,
+                stderr=subprocess.STDOUT if rank else None, text=True,
+            ))
+        print(f"--spawn_hosts: launched {n} processes "
+              f"(coordinator localhost:{port})", file=sys.stderr)
+        started = time.monotonic()
+
+        def _reap(live):
+            for r in live:
+                procs[r].terminate()
+            for r in live:
                 try:
-                    os.unlink(log.name)
-                except OSError:
-                    pass
-    return True
+                    procs[r].wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    procs[r].kill()
+
+        # the launcher must never outlive-orphan its children: SIGTERM/SIGINT
+        # (Ctrl-C, `timeout`, a scheduler preemption) reaps them before exiting
+        prev_handlers = {}
+
+        def _on_signal(signum, frame):
+            _reap([r for r in range(n) if procs[r].poll() is None])
+            raise SystemExit(128 + signum)
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev_handlers[sig] = signal.signal(sig, _on_signal)
+            except ValueError:
+                pass  # non-main thread (programmatic use) — skip the handlers
+        # poll rather than wait in rank order: a crashed child leaves the
+        # survivors blocked in collectives, so the first non-zero exit
+        # terminates the rest instead of hanging the launcher forever
+        failed = None
+        retrying = False
+        live = list(range(n))
+        try:
+            while live and failed is None:
+                for r in list(live):
+                    rc = procs[r].poll()
+                    if rc is not None:
+                        live.remove(r)
+                        if rc != 0:
+                            failed = (r, rc)
+                            break
+                time.sleep(0.2)
+            if failed is not None:
+                rank, rc = failed
+                _reap(live)
+                fast = time.monotonic() - started < _SPAWN_RETRY_WINDOW_S
+                # Retry ONLY with evidence of a coordinator bring-up problem
+                # in some child's log (rank 0 streams to the console, but on
+                # a port race the client ranks fail with connect/coordination
+                # errors too) — a deterministic fast failure (bad flag, import
+                # error) must surface immediately, not be retried twice with a
+                # misleading race diagnostic.
+                retrying = (fast and attempt < _SPAWN_PORT_RETRIES
+                            and _logs_show_coordination_failure(logs))
+                if retrying:
+                    print(
+                        f"--spawn_hosts: rank {rank} failed (rc={rc}) within "
+                        f"{_SPAWN_RETRY_WINDOW_S:.0f}s with coordination/bind "
+                        "errors in the child logs — likely a coordinator-port "
+                        "race; retrying with a fresh port",
+                        file=sys.stderr,
+                    )
+                    last_failure = failed
+                    continue
+                if logs[rank] is not None:
+                    logs[rank].flush()
+                    logs[rank].seek(0)
+                    print(
+                        f"--- rank {rank} output ---\n{logs[rank].read()[-4000:]}",
+                        file=sys.stderr,
+                    )
+                    print(f"(full rank-{rank} log kept at {logs[rank].name})",
+                          file=sys.stderr)
+                raise SystemExit(rc)
+        finally:
+            for sig, h in prev_handlers.items():
+                signal.signal(sig, h)
+            # close every log handle; delete all but a failed rank's (kept for
+            # replay) so repeated dev runs don't litter /tmp
+            keep = failed[0] if failed is not None and not retrying else None
+            for rank, log in enumerate(logs):
+                if log is None:
+                    continue
+                log.close()
+                if rank != keep:
+                    try:
+                        os.unlink(log.name)
+                    except OSError:
+                        pass
+        return True
+    # unreachable: the final attempt either returns or raises above — kept
+    # for clarity if the retry constants change
+    raise SystemExit(last_failure[1] if last_failure else 1)
+
+
+# Children that die this quickly never started training — a candidate for
+# the coordinator bring-up retry (e.g. the picked port got stolen), taken
+# only when the child logs actually show coordination/bind errors.
+_SPAWN_RETRY_WINDOW_S = 20.0
+_SPAWN_PORT_RETRIES = 2
+
+# Signatures of a failed jax.distributed bring-up in a child's output: the
+# coordinator losing the bind race or the clients failing to reach it.
+_COORDINATION_ERROR_MARKERS = (
+    "address already in use",
+    "failed to connect",
+    "connection refused",
+    "coordination service",
+    "coordination_service",
+    "deadline_exceeded",
+    "deadline exceeded",
+    "unavailable:",
+    "jax.distributed.initialize",
+)
+
+
+def _logs_show_coordination_failure(logs) -> bool:
+    """True when any child's captured output tail matches a distributed-
+    bring-up failure signature (case-insensitive)."""
+    for log in logs:
+        if log is None:
+            continue
+        try:
+            log.flush()
+            log.seek(0, os.SEEK_END)
+            size = log.tell()
+            log.seek(max(0, size - 8000))
+            tail = log.read().lower()
+        except (OSError, ValueError):
+            continue
+        if any(m in tail for m in _COORDINATION_ERROR_MARKERS):
+            return True
+    return False
 
 
 def maybe_initialize_distributed(args) -> None:
