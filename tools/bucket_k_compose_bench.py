@@ -133,12 +133,146 @@ def trainer_rate(dm, label: str) -> float:
     return statistics.median(steady)
 
 
+def _stacked_windows(dm):
+    """The trainer's greedy flush-on-width-change stacking
+    (Trainer._dispatch_batches), materialized: [(width, stacked_batch, k)].
+    Collation and widths are exactly the composed loop's — only the dispatch
+    site moves out here so each window can carry a StepTraceAnnotation."""
+    windows, run, prev = [], [], None
+    for b in dm.train_dataloader():
+        w = b["token_ids"].shape[1]
+        if run and (w != prev or len(run) == K):
+            windows.append((prev, run))
+            run = []
+        run.append(b)
+        prev = w
+    if run:
+        windows.append((prev, run))
+    out = []
+    for w, batches in windows:
+        stacked = {
+            key: np.stack([b[key] for b in batches])
+            for key in ("token_ids", "pad_mask")
+        }
+        out.append((w, stacked, len(batches)))
+    return out
+
+
+def trace_ab(root: str) -> None:
+    """Device-trace A/B of the composed bucketed K-loop vs static-512
+    (VERDICT r4 item 4): per-dispatch device windows from the xplane Steps
+    line, per-width LOWER-QUARTILE per-step durations over full windows,
+    share-weighted by each width's true step share (partials included in the
+    shares). Interleaved bucketed/static/bucketed/static in ONE process."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.models.presets import flagship_mlm
+    from perceiver_io_tpu.training import (
+        OptimizerConfig,
+        TrainState,
+        make_mlm_steps,
+        make_optimizer,
+        mlm_gather_capacity,
+    )
+    from perceiver_io_tpu.training.steps import make_scanned_step
+    from perceiver_io_tpu.utils import xplane
+
+    dm_b = make_module(root, BUCKETS)
+    dm_s = make_module(root, None)
+
+    model = flagship_mlm(
+        vocab_size=dm_b.tokenizer.get_vocab_size(), max_seq_len=SEQ_CAP,
+        dtype=jnp.bfloat16, attn_impl="xla",
+    )
+    example = next(iter(dm_b.val_dataloader()))
+    variables = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        example["token_ids"][:1], example["pad_mask"][:1],
+    )
+    tx, sched = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    head = "pallas" if jax.default_backend() == "tpu" else False
+    train_step, _, _ = make_mlm_steps(
+        model, sched, loss_gather_capacity=mlm_gather_capacity(SEQ_CAP),
+        fused_head=head,
+    )
+    scanned = jax.jit(make_scanned_step(train_step), donate_argnums=(0,))
+
+    def run_arm(windows, state, trace_dir):
+        # warmup pass compiles every (width, k) program OUTSIDE the trace
+        seen = set()
+        for w, stacked, k in windows:
+            if (w, k) not in seen:
+                seen.add((w, k))
+                state, _ = scanned(state, stacked)
+        meta = []
+        with jax.profiler.trace(trace_dir):
+            for i, (w, stacked, k) in enumerate(windows):
+                with jax.profiler.StepTraceAnnotation("win", step_num=i):
+                    state, m = scanned(state, stacked)
+                meta.append((w, k))
+            float(m["loss"])  # sync inside the trace window
+        spans = xplane.step_windows(xplane.load_tpu_plane(trace_dir))
+        assert len(spans) == len(meta), (len(spans), len(meta))
+        per_width: dict = {}
+        shares: dict = {}
+        for (w, k), (a, b) in zip(meta, spans):
+            shares[w] = shares.get(w, 0) + k
+            if k == K:  # LQ statistic over FULL windows only
+                per_width.setdefault(w, []).append((b - a) / 1e12 / k)
+        total = sum(shares.values())
+        weighted = 0.0
+        for w, share in shares.items():
+            durs = sorted(per_width.get(w, []))
+            if not durs:  # width with only partial windows — use all of them
+                durs = sorted(
+                    (b - a) / 1e12 / k
+                    for (ww, k), (a, b) in zip(meta, spans) if ww == w
+                )
+            lq = durs[len(durs) // 4]
+            weighted += lq * (share / total)
+        return state, weighted, total, dict(
+            (w, (s, sorted(per_width.get(w, [0]))[len(per_width.get(w, [0])) // 4]))
+            for w, s in shares.items()
+        )
+
+    state = TrainState.create(variables["params"], tx, jax.random.key(2))
+    win_b = _stacked_windows(dm_b)
+    win_s = _stacked_windows(dm_s)
+    results = {"buckets": [], "static": []}
+    for rep in range(2):
+        for which, windows in (("buckets", win_b), ("static", win_s)):
+            td = tempfile.mkdtemp(prefix=f"compose_trace_{which}{rep}_")
+            state, weighted, steps, detail = run_arm(windows, state, td)
+            results[which].append(weighted)
+            wd = ", ".join(
+                f"{w}: {s} steps @ {lq * 1e3:.2f} ms"
+                for w, (s, lq) in sorted(detail.items())
+            )
+            print(f"  rep{rep} {which:8s}: share-weighted LQ "
+                  f"{weighted * 1e3:.3f} ms/step over {steps} steps ({wd})",
+                  flush=True)
+    b = statistics.median(results["buckets"])
+    s = statistics.median(results["static"])
+    print(
+        f"device-trace composed A/B: bucketed {b * 1e3:.3f} vs static "
+        f"{s * 1e3:.3f} ms/step -> {s / b:.3f}x ({(s / b - 1) * 100:+.1f}% "
+        f"examples/s)"
+    )
+
+
 def main() -> None:
     root = os.environ.get("PIT_ROOT", ".cache")
     dm_b = make_module(root, BUCKETS)
     frac, steps_frac = window_stats(dm_b)
     print(f"full {K}-batch windows with buckets {BUCKETS}+cap: {frac:.1%} "
           f"of windows, {steps_frac:.1%} of steps")
+
+    if "--trace-ab" in sys.argv:
+        trace_ab(root)
+        return
 
     dm_s = make_module(root, None)
     order = ["buckets", "static", "buckets", "static"]

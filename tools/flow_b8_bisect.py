@@ -1,0 +1,174 @@
+"""Bisect the flow-b8 remote-compile failure + measure 2×b4 grad accumulation
+(VERDICT r4 item 5; PERF.md negative (12)).
+
+r4 recorded: flow at batch 8 kills the remote compiler (HTTP 500,
+``tpu_compile_helper subprocess exit code 1``, NO scoped-vmem message — b4
+and every other config compile in the same session). This tool narrows the
+trigger by compiling b8 variants that each remove one suspect, then measures
+gradient accumulation (2 microbatches of 4, one optimizer step — the
+MFU-equivalent effective-b8 stand-in) with the device-trace statistic.
+
+Variants (each a compile attempt; OOM/HTTP-500 is an ANSWER, not a flake —
+CLAUDE.md):
+  b8-fwd       forward only (no grad): is the backward the trigger?
+  b8-xla       attn_impl=xla (no Pallas kernels): are the kernels involved?
+  b8-remat     encoder remat on: does shrinking live activations fix it?
+  b8-blocks    kernel blocks halved (kv 256, q 256): VMEM-shaped trigger?
+  b6           batch 6: where between 4 and 8 does it die?
+  b8           the full failing program (control)
+  accum2x4     lax.scan over 2 microbatches of b4, summed grads, one update
+               — compiles at b4's footprint, trains at effective batch 8
+
+Usage: ``timeout 3600 python tools/flow_b8_bisect.py [variant ...]``
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from perceiver_io_tpu.models.flow import build_optical_flow_model, end_point_error
+from perceiver_io_tpu.training import (
+    OptimizerConfig,
+    TrainState,
+    make_optimizer,
+)
+
+DTYPE = jnp.bfloat16
+rng = np.random.default_rng(0)
+
+
+def _batch(b: int):
+    return {
+        "frames": jnp.asarray(rng.normal(0, 1, (b, 2, 368, 496, 3)), jnp.float32),
+        "flow": jnp.asarray(rng.normal(0, 1, (b, 368, 496, 2)), jnp.float32),
+    }
+
+
+def _model(attn="auto", remat=False, kv_block=None, q_block=None):
+    kwargs = {}
+    if kv_block is not None or q_block is not None:
+        # build_optical_flow_model has no block knobs; halved blocks are
+        # injected via the resolution hook below instead
+        pass
+    return build_optical_flow_model(dtype=DTYPE, attn_impl=attn, remat=remat,
+                                    **kwargs)
+
+
+def _try_compile(name, fn, *args) -> str:
+    t0 = time.perf_counter()
+    try:
+        lowered = jax.jit(fn).lower(*args)
+        lowered.compile()
+        dt = time.perf_counter() - t0
+        return f"{name}: COMPILES ({dt:.0f} s)"
+    except Exception as e:
+        msg = str(e).replace("\n", " ")[:180]
+        return f"{name}: FAIL {type(e).__name__}: {msg}"
+
+
+def _loss_fn(model):
+    def loss(params, batch):
+        pred = model.apply({"params": params}, batch["frames"],
+                           deterministic=True)
+        return end_point_error(pred, batch["flow"])
+
+    return loss
+
+
+def main() -> None:
+    only = set(sys.argv[1:])
+
+    def want(name):
+        return not only or name in only
+
+    model = _model()
+    init_b = _batch(1)
+    variables = model.init({"params": jax.random.key(0)}, init_b["frames"])
+    params = variables["params"]
+    loss = _loss_fn(model)
+
+    if want("b8-fwd"):
+        b8 = _batch(8)
+        print(_try_compile(
+            "b8-fwd", lambda p, fr: model.apply({"params": p}, fr,
+                                                deterministic=True),
+            params, b8["frames"]), flush=True)
+    if want("b8-xla"):
+        mx = _model(attn="xla")
+        lx = _loss_fn(mx)
+        print(_try_compile("b8-xla (grad)", jax.grad(lx), params, _batch(8)),
+              flush=True)
+    if want("b8-remat"):
+        mr = _model(remat=True)
+        lr = _loss_fn(mr)
+        print(_try_compile("b8-remat (grad)", jax.grad(lr), params, _batch(8)),
+              flush=True)
+    if want("b8-blocks"):
+        import perceiver_io_tpu.ops.pallas_attention as pa
+
+        orig_kv, orig_q = pa.DEFAULT_KV_BLOCK, pa.DEFAULT_Q_BLOCK
+        pa.DEFAULT_KV_BLOCK, pa.DEFAULT_Q_BLOCK = 256, 256
+        try:
+            print(_try_compile("b8-blocks kv256/q256 (grad)", jax.grad(loss),
+                               params, _batch(8)), flush=True)
+        finally:
+            pa.DEFAULT_KV_BLOCK, pa.DEFAULT_Q_BLOCK = orig_kv, orig_q
+    if want("b6"):
+        print(_try_compile("b6 (grad)", jax.grad(loss), params, _batch(6)),
+              flush=True)
+    if want("b8"):
+        print(_try_compile("b8 control (grad)", jax.grad(loss), params,
+                           _batch(8)), flush=True)
+
+    if want("accum2x4"):
+        # effective batch 8 at b4's compile footprint: scan 2 microbatches,
+        # mean the grads, ONE optimizer update. Device-trace measured.
+        tx, _ = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+        state = TrainState.create(params, tx, jax.random.key(2))
+        big = _batch(8)
+        stacked = jax.tree.map(
+            lambda x: x.reshape(2, 4, *x.shape[1:]), big)
+
+        def accum_step(state, stacked):
+            def body(acc, micro):
+                l, g = jax.value_and_grad(loss)(state.params, micro)
+                return jax.tree.map(jnp.add, acc,
+                                    jax.tree.map(lambda x: x / 2.0, g)), l
+
+            zero = jax.tree.map(jnp.zeros_like, state.params)
+            grads, losses = jax.lax.scan(body, zero, stacked)
+            return state.apply_gradients(grads), losses.mean()
+
+        jitted = jax.jit(accum_step, donate_argnums=(0,))
+        res = _try_compile("accum2x4 (train step)", accum_step, state, stacked)
+        print(res, flush=True)
+        if "COMPILES" in res:
+            import tempfile
+
+            from perceiver_io_tpu.utils import xplane
+
+            state, l = jitted(state, stacked)
+            float(l)
+            td = tempfile.mkdtemp(prefix="flow_accum_")
+            with jax.profiler.trace(td):
+                for i in range(8):
+                    with jax.profiler.StepTraceAnnotation("s", step_num=i):
+                        state, l = jitted(state, stacked)
+                float(l)
+            sec, n = xplane.device_step_seconds(td, skip_first=2)
+            print(f"accum2x4 device step: {sec * 1e3:.2f} ms "
+                  f"(= {sec * 1e3 / 8:.2f} ms/example, {8 / sec:.2f} ex/s, "
+                  f"{n} windows)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,271 @@
+"""Inference/serving-layer hardware bench (VERDICT r4 item 6).
+
+The serving layer (``inference/predictor.py`` bucketed ``Predictor``,
+``inference/mlm.py`` ``fill_masks`` gathered decode, ``inference/export.py``
+StableHLO export) is a beyond-the-reference capability (the reference has no
+serve/export path — SURVEY.md §3.4), so the bar is internal consistency:
+every capability claim carries hardware numbers. This tool measures, on the
+real chip:
+
+1. ``fill_masks`` end-to-end latency at batch 1 / 8 / 64 — the HOST medians
+   (what a caller of this process sees: tokenize, dispatch, the tunnel
+   round-trip, top-k decode) AND the device-trace per-call compute time
+   (lower-quartile per-step device window — the tunnel-insensitive
+   statistic, CLAUDE.md measurement discipline).
+2. Bucket-padding overhead on the gathered-decode forward (the realistic
+   serving path — small outputs): a 5-text request padded to the 8-bucket vs
+   a native 8-text request (same compiled program) vs a dedicated
+   exact-shape jit at 5 (what bucketing trades away to keep steady-state
+   serving recompile-free).
+3. Exported-StableHLO vs live-jit dispatch on the same forward: steady-state
+   per-call latency and device time, plus each path's time-to-first-result
+   (the artifact's ahead-of-time selling point).
+
+Sync discipline: device completion is forced by fetching a SCALAR slice of
+every output leaf (``block_until_ready`` lies on the tunneled backend and
+unconsumed dispatches get DCE'd — PERF.md). ``fill_masks``/``Predictor``
+already fetch their numpy results, which is the same honest sync.
+
+Prints a human table and ONE final JSON summary line on stdout (this is a
+tools/ bench — bench.py's one-line stdout contract is untouched).
+
+Usage::
+
+    timeout 1800 python tools/inference_bench.py [--trace-dir DIR]
+                                                 [--dtype float32|bfloat16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def _consume(out) -> None:
+    """Honest completion: a scalar slice of each output leaf is computed
+    on-device (dependent on the full result) and fetched to the host."""
+    for leaf in jax.tree_util.tree_leaves(out):
+        idx = (0,) * getattr(leaf, "ndim", 0)
+        np.asarray(leaf[idx] if idx else leaf)
+
+
+def _median_latency(fn, reps: int = 20, warmup: int = 3) -> float:
+    """Median host wall-clock seconds per call. Serving latency: the tunnel
+    round-trip is part of what a caller experiences — no subtraction; the
+    device trace carries the compute truth alongside."""
+    for _ in range(warmup):
+        fn()
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times)
+
+
+def _device_per_call(fn, trace_dir: str, calls: int = 12) -> float:
+    """Lower-quartile device seconds per call, each call wrapped in a
+    StepTraceAnnotation so the xplane Steps line carries per-call windows."""
+    from perceiver_io_tpu.utils import xplane
+
+    fn()  # compiled before tracing
+    with jax.profiler.trace(trace_dir):
+        for i in range(calls):
+            with jax.profiler.StepTraceAnnotation("serve", step_num=i):
+                fn()
+    sec, _ = xplane.device_step_seconds(trace_dir, skip_first=2)
+    return sec
+
+
+def _build_predictor(dtype_name: str):
+    """Flagship-shaped MLM + a real first-party tokenizer over a synthetic
+    Zipf corpus (zero-egress environment: no downloads)."""
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.data.tokenizer import (
+        create_tokenizer,
+        train_tokenizer,
+    )
+    from perceiver_io_tpu.inference.mlm import MLMPredictor
+    from perceiver_io_tpu.models.presets import flagship_mlm
+
+    rng = np.random.default_rng(0)
+    words = [f"w{i}" for i in range(4000)]
+    probs = 1.0 / np.arange(1, len(words) + 1)
+    probs /= probs.sum()
+    corpus = [
+        " ".join(rng.choice(words, size=120, p=probs)) for _ in range(800)
+    ]
+    tokenizer = create_tokenizer()
+    train_tokenizer(tokenizer, corpus, vocab_size=10003)
+    vocab = tokenizer.get_vocab_size()
+
+    max_seq_len = 512
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
+    model = flagship_mlm(
+        vocab_size=vocab, max_seq_len=max_seq_len, dtype=dtype,
+        attn_impl="auto",
+    )
+    ids = np.zeros((1, max_seq_len), np.int32)
+    variables = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        ids, ids == 0,
+    )
+    predictor = MLMPredictor(
+        model, variables["params"], tokenizer, max_seq_len, max_batch=64
+    )
+    texts = [
+        f"the {tokenizer.id_to_token(10 + i)} movie was [MASK] and the plot "
+        "felt [MASK] overall" for i in range(64)
+    ]
+    return predictor, texts, model, variables["params"], vocab, max_seq_len
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--trace-dir", default=None,
+                        help="keep traces here instead of a temp dir")
+    parser.add_argument("--dtype", default="float32",
+                        choices=["float32", "bfloat16"],
+                        help="serving dtype (float32 = the from_checkpoint "
+                             "golden-parity default)")
+    args = parser.parse_args()
+
+    backend = jax.default_backend()
+    print(f"backend: {backend}; dtype {args.dtype}")
+    predictor, texts, model, params, vocab, max_seq_len = _build_predictor(
+        args.dtype
+    )
+    results: dict = {"backend": backend, "dtype": args.dtype, "vocab": vocab}
+    trace_root = args.trace_dir or tempfile.mkdtemp(prefix="inference_bench_")
+
+    # 1) fill_masks latency/throughput ------------------------------------
+    print("\nfill_masks (2 [MASK] per text, k=5):")
+    print(f"{'batch':>6} {'host ms/call':>13} {'device ms/call':>15} "
+          f"{'texts/s (host)':>15}")
+    for n in (1, 8, 64):
+        batch = texts[:n]
+        host = _median_latency(lambda: predictor.fill_masks(batch, k=5))
+        dev = _device_per_call(
+            lambda: predictor.fill_masks(batch, k=5),
+            os.path.join(trace_root, f"fill{n}"),
+        )
+        print(f"{n:>6} {host * 1e3:>13.2f} {dev * 1e3:>15.3f} "
+              f"{n / host:>15.1f}")
+        results[f"fill_masks_b{n}_host_ms"] = round(host * 1e3, 3)
+        results[f"fill_masks_b{n}_device_ms"] = round(dev * 1e3, 4)
+
+    # 2) bucket-padding overhead (gathered forward: small outputs) --------
+    from perceiver_io_tpu.inference.mlm import encode_masked_texts
+
+    ids5, pad5 = encode_masked_texts(
+        predictor.tokenizer, texts[:5], max_seq_len)
+    ids8, pad8 = encode_masked_texts(
+        predictor.tokenizer, texts[:8], max_seq_len)
+    pos5 = np.tile(np.arange(8, dtype=np.int32), (5, 1))
+    pos8 = np.tile(np.arange(8, dtype=np.int32), (8, 1))
+
+    gathered = predictor._gathered  # the Predictor fill_masks dispatches
+
+    def exact_apply(p, token_ids, pad_mask, positions):
+        return model.apply(
+            {"params": p}, token_ids, pad_mask, masking=False,
+            deterministic=True, positions=positions,
+        )
+
+    exact5 = jax.jit(exact_apply)
+
+    host_b5 = _median_latency(lambda: gathered(ids5, pad5, pos5))
+    host_b8 = _median_latency(lambda: gathered(ids8, pad8, pos8))
+    host_exact5 = _median_latency(
+        lambda: _consume(exact5(params, ids5, pad5, pos5)))
+    dev_b5 = _device_per_call(
+        lambda: gathered(ids5, pad5, pos5),
+        os.path.join(trace_root, "bucket5"))
+    dev_exact5 = _device_per_call(
+        lambda: _consume(exact5(params, ids5, pad5, pos5)),
+        os.path.join(trace_root, "exact5"))
+    print("\nbucket padding (5 texts -> 8-bucket, gathered decode):")
+    print(f"  bucketed@5   host {host_b5 * 1e3:7.2f} ms   device "
+          f"{dev_b5 * 1e3:7.3f} ms")
+    print(f"  native@8     host {host_b8 * 1e3:7.2f} ms")
+    print(f"  exact-jit@5  host {host_exact5 * 1e3:7.2f} ms   device "
+          f"{dev_exact5 * 1e3:7.3f} ms")
+    results.update(
+        bucket5_host_ms=round(host_b5 * 1e3, 3),
+        native8_host_ms=round(host_b8 * 1e3, 3),
+        exact5_host_ms=round(host_exact5 * 1e3, 3),
+        bucket5_device_ms=round(dev_b5 * 1e3, 4),
+        exact5_device_ms=round(dev_exact5 * 1e3, 4),
+    )
+
+    # 3) exported StableHLO vs live jit (gathered forward, b8) ------------
+    from perceiver_io_tpu.inference.export import export_forward, load_exported
+
+    art = os.path.join(trace_root, "mlm.stablehlo")
+    t0 = time.perf_counter()
+    export_forward(
+        model, params, (ids8, pad8, pos8), path=art, masking=False,
+    )
+    export_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    exported_call = load_exported(art)
+    _consume(exported_call(ids8, pad8, pos8))
+    exported_first_s = time.perf_counter() - t0
+
+    def live_fn(token_ids, pad_mask, positions):
+        return model.apply(
+            {"params": params}, token_ids, pad_mask, masking=False,
+            deterministic=True, positions=positions,
+        )
+
+    live = jax.jit(live_fn)
+    t0 = time.perf_counter()
+    _consume(live(ids8, pad8, pos8))
+    live_first_s = time.perf_counter() - t0
+
+    host_exported = _median_latency(
+        lambda: _consume(exported_call(ids8, pad8, pos8)))
+    host_live = _median_latency(lambda: _consume(live(ids8, pad8, pos8)))
+    dev_exported = _device_per_call(
+        lambda: _consume(exported_call(ids8, pad8, pos8)),
+        os.path.join(trace_root, "exported"))
+    dev_live = _device_per_call(
+        lambda: _consume(live(ids8, pad8, pos8)),
+        os.path.join(trace_root, "livejit"))
+    size_mb = os.path.getsize(art) / 1e6
+    print(f"\nStableHLO export (b8 gathered forward, artifact "
+          f"{size_mb:.1f} MB, export took {export_s:.1f} s):")
+    print(f"  exported  first-result {exported_first_s:6.1f} s   steady "
+          f"host {host_exported * 1e3:7.2f} ms   device "
+          f"{dev_exported * 1e3:7.3f} ms")
+    print(f"  live jit  first-result {live_first_s:6.1f} s   steady "
+          f"host {host_live * 1e3:7.2f} ms   device {dev_live * 1e3:7.3f} ms")
+    results.update(
+        export_artifact_mb=round(size_mb, 2),
+        export_s=round(export_s, 2),
+        exported_first_result_s=round(exported_first_s, 2),
+        live_first_result_s=round(live_first_s, 2),
+        exported_steady_host_ms=round(host_exported * 1e3, 3),
+        live_steady_host_ms=round(host_live * 1e3, 3),
+        exported_device_ms=round(dev_exported * 1e3, 4),
+        live_device_ms=round(dev_live * 1e3, 4),
+    )
+
+    print()
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()
