@@ -51,6 +51,12 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--num_modality_channels", type=int, default=8)
     t.add_argument("--video_frequency_bands", type=int, default=32)
     t.add_argument("--audio_frequency_bands", type=int, default=64)
+    t.add_argument("--video_patch_loss", action="store_true",
+                   help="compute the video reconstruction loss in PATCH "
+                        "space (patchify the target instead of un-patchifying "
+                        "the prediction — same element set, exact up to fp "
+                        "reassociation; skips the (B,T,H,W,C) transpose pair "
+                        "in fwd+bwd). Params/checkpoints are unaffected")
     t.add_argument("--video_weight", type=float, default=1.0)
     t.add_argument("--audio_weight", type=float, default=1.0)
     t.add_argument("--label_weight", type=float, default=1.0)
@@ -113,6 +119,7 @@ def main(argv: Optional[Sequence[str]] = None):
         attn_impl=args.attn_impl,
         remat=args.remat,
         reuse_kv=not getattr(args, "no_reuse_kv", False),
+        video_patch_loss=args.video_patch_loss,
     )
     example = next(iter(data.val_dataloader()))
     variables = model.init(

@@ -259,12 +259,21 @@ class AudioOutputAdapter(OutputAdapter):
 
 class VideoOutputAdapter(OutputAdapter):
     """One decoder query per space-time patch; linear head to patch voxels,
-    un-patchified back to (B, T, H, W, C)."""
+    un-patchified back to (B, T, H, W, C).
+
+    ``as_patches=True`` skips the un-patchify (returns the raw
+    (B, N_patches, pt·ph·pw·C) head output): the training loss is an
+    elementwise MSE, so it can run in patch space against a patchified
+    target — the same element set, so the loss value agrees to fp
+    reassociation — and the (B, T, H, W, C) transpose pair (forward +
+    cotangent) never materializes. Params are identical either way; a
+    checkpoint moves freely between the two."""
 
     video_shape: Tuple[int, int, int, int] = (16, 224, 224, 3)
     patch_shape: Tuple[int, int, int] = (1, 4, 4)
     num_output_channels: int = 512
     dtype: jnp.dtype = jnp.float32
+    as_patches: bool = False
 
     @property
     def grid_shape(self) -> Tuple[int, int, int]:
@@ -292,9 +301,23 @@ class VideoOutputAdapter(OutputAdapter):
             bias_init=torch_linear_bias_init(self.num_output_channels),
             name="linear",
         )(x)
+        if self.as_patches:
+            return x  # (B, N_patches, pt·ph·pw·C)
         x = x.reshape(b, gt, gh, gw, pt, ph, pw, c)
         x = x.transpose(0, 1, 4, 2, 5, 3, 6, 7)
         return x.reshape(b, *self.video_shape)
+
+
+def patchify_video(target: Array, grid_shape, patch_shape) -> Array:
+    """(B, T, H, W, C) → (B, N_patches, pt·ph·pw·C), the exact inverse of
+    ``VideoOutputAdapter``'s un-patchify — for patch-space reconstruction
+    losses against an ``as_patches=True`` adapter output."""
+    b = target.shape[0]
+    (gt, gh, gw), (pt, ph, pw) = grid_shape, patch_shape
+    c = target.shape[-1]
+    x = target.reshape(b, gt, pt, gh, ph, gw, pw, c)
+    x = x.transpose(0, 1, 3, 5, 2, 4, 6, 7)
+    return x.reshape(b, gt * gh * gw, pt * ph * pw * c)
 
 
 class MultimodalOutputAdapter(OutputAdapter):
@@ -349,11 +372,18 @@ def build_multimodal_autoencoder(
     attn_impl: str = "auto",
     remat: bool = False,
     reuse_kv: bool = True,
+    video_patch_loss: bool = False,
 ):
     """PerceiverIO mapping {'video', 'audio'} → {'video', 'audio', 'label'}
     (Kinetics-style multimodal autoencoding + classification; defaults sized
     after the Perceiver IO paper's configuration — shrink everything for
-    tests)."""
+    tests).
+
+    ``video_patch_loss=True`` keeps the video head in patch space
+    (``VideoOutputAdapter.as_patches``) for elementwise-loss training —
+    exact up to fp reassociation, skips the (B, T, H, W, C) un-patchify
+    transpose pair; ``make_multimodal_steps`` patchifies the target to
+    match. Params are unaffected — checkpoints move freely."""
     from perceiver_io_tpu.models.perceiver import (
         PerceiverDecoder,
         PerceiverEncoder,
@@ -395,6 +425,7 @@ def build_multimodal_autoencoder(
                     patch_shape=video_patch_shape,
                     num_output_channels=c_latent,
                     dtype=dtype,
+                    as_patches=video_patch_loss,
                 ),
             ),
             (
@@ -449,12 +480,33 @@ def multimodal_autoencoding_loss(
     video_weight: float = 1.0,
     audio_weight: float = 1.0,
     label_weight: float = 1.0,
+    video_patch_info: Optional[Tuple[Tuple[int, int, int], Tuple[int, int, int]]] = None,
 ) -> Tuple[Array, dict]:
-    """Weighted MSE(video) + MSE(audio) + CE(label); returns (loss, metrics)."""
+    """Weighted MSE(video) + MSE(audio) + CE(label); returns (loss, metrics).
+
+    ``video_patch_info = (grid_shape, patch_shape)``: required when the video
+    head runs in patch space (``VideoOutputAdapter.as_patches``)."""
     from perceiver_io_tpu.training.losses import classification_loss_and_accuracy
 
+    video_target = batch["video"]
+    video_pred = outputs["video"]
+    if video_pred.ndim == 3 and video_target.ndim == 5:
+        # patch-space head (VideoOutputAdapter.as_patches): patchify the
+        # target instead of un-patchifying the prediction — the MSE sums the
+        # same element set, so the loss agrees to fp reassociation while the
+        # (B, T, H, W, C) transpose pair never materializes in fwd or bwd.
+        # The patch geometry must come from the caller (make_multimodal_steps
+        # reads it off the model's VideoOutputAdapter): it is NOT inferable
+        # from shapes alone — several factorizations can match, and a wrong
+        # one silently pairs predictions with the wrong target elements.
+        if video_patch_info is None:
+            raise ValueError(
+                "patch-space video output needs video_patch_info="
+                "(grid_shape, patch_shape)"
+            )
+        video_target = patchify_video(video_target, *video_patch_info)
     video_loss = jnp.mean(
-        jnp.square(outputs["video"].astype(jnp.float32) - batch["video"])
+        jnp.square(video_pred.astype(jnp.float32) - video_target)
     )
     audio_loss = jnp.mean(
         jnp.square(outputs["audio"].astype(jnp.float32) - batch["audio"])

@@ -249,8 +249,21 @@ def make_multimodal_steps(
 ):
     """(train_step, eval_step) for the multimodal autoencoder: batches
     ``{'video': (B, T, H, W, C), 'audio': (B, S, C_a), 'label': (B,) int}``,
-    loss = weighted MSE(video) + MSE(audio) + CE(label)."""
+    loss = weighted MSE(video) + MSE(audio) + CE(label).
+
+    When the model's video head runs in patch space
+    (``VideoOutputAdapter.as_patches`` — the ``video_patch_loss`` builder
+    knob), the patch geometry is read off the adapter here and the TARGET is
+    patchified in the loss instead of the prediction being un-patchified in
+    the adapter (exact up to fp reassociation)."""
     from perceiver_io_tpu.models.multimodal import multimodal_autoencoding_loss
+
+    video_patch_info = None
+    output_adapter = getattr(
+        getattr(model, "decoder", None), "output_adapter", None)
+    for name, adapter in getattr(output_adapter, "adapters", ()):
+        if name == "video" and getattr(adapter, "as_patches", False):
+            video_patch_info = (adapter.grid_shape, adapter.patch_shape)
 
     def loss_fn(params, batch, rngs, deterministic):
         outputs = model.apply(
@@ -260,7 +273,8 @@ def make_multimodal_steps(
             deterministic=deterministic,
         )
         return multimodal_autoencoding_loss(
-            outputs, batch, video_weight, audio_weight, label_weight
+            outputs, batch, video_weight, audio_weight, label_weight,
+            video_patch_info=video_patch_info,
         )
 
     def train_step(state: TrainState, batch) -> Tuple[TrainState, Metrics]:

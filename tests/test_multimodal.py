@@ -224,3 +224,71 @@ def test_loss_weights():
     expected = 2.0 * 1.0 + 0.5 * 4.0 + float(metrics["label_loss"])
     np.testing.assert_allclose(float(loss), expected, rtol=1e-5)
     assert float(metrics["acc"]) == 1.0
+
+
+def test_video_patch_loss_matches_pixel_loss():
+    """video_patch_loss=True computes the SAME reconstruction loss (to fp
+    reassociation) without the un-patchify transpose pair: the adapter keeps
+    the head output in patch space and the loss patchifies the target with
+    the exact inverse permutation. Params and gradients are unchanged
+    (modulo reassociation); a checkpoint moves freely between the modes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from perceiver_io_tpu.models.multimodal import (
+        build_multimodal_autoencoder,
+        patchify_video,
+    )
+    from perceiver_io_tpu.training.steps import make_multimodal_steps
+    from perceiver_io_tpu.training import (
+        OptimizerConfig,
+        TrainState,
+        make_optimizer,
+    )
+
+    kwargs = dict(
+        video_shape=(4, 8, 8, 3), num_audio_samples=64, samples_per_patch=8,
+        num_classes=5, latent_shape=(8, 16), video_patch_shape=(2, 4, 4),
+        num_layers=1, num_self_attention_layers_per_block=1,
+        num_self_attention_heads=2, video_frequency_bands=2,
+        audio_frequency_bands=2, dtype=jnp.float32,
+    )
+    pixel = build_multimodal_autoencoder(**kwargs)
+    patch = build_multimodal_autoencoder(video_patch_loss=True, **kwargs)
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "video": jnp.asarray(rng.normal(0, 1, (2, 4, 8, 8, 3)), jnp.float32),
+        "audio": jnp.asarray(rng.normal(0, 1, (2, 64, 1)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 5, 2).astype(np.int32)),
+    }
+    inputs = {"video": batch["video"], "audio": batch["audio"]}
+    v = pixel.init({"params": jax.random.key(0)}, inputs)
+    # identical param trees: as_patches only skips the output relayout
+    v2 = patch.init({"params": jax.random.key(0)}, inputs)
+    for a, b in zip(jax.tree_util.tree_leaves(v), jax.tree_util.tree_leaves(v2)):
+        assert bool((a == b).all())
+
+    # the adapter pair is an exact inverse: unpatchified(pred_patches) == pred
+    out_pix = pixel.apply(v, inputs, deterministic=True)
+    out_pat = patch.apply(v, inputs, deterministic=True)
+    grid, pshape = (2, 2, 2), (2, 4, 4)
+    assert bool(
+        (patchify_video(out_pix["video"], grid, pshape) == out_pat["video"]).all()
+    )
+
+    # loss parity through make_multimodal_steps (reads geometry off the model)
+    tx, _ = make_optimizer(OptimizerConfig(learning_rate=1e-3))
+    losses = {}
+    for name, model in (("pixel", pixel), ("patch", patch)):
+        train_step, eval_step = make_multimodal_steps(model)
+        state = TrainState.create(v["params"], tx, jax.random.key(2))
+        _, metrics = jax.jit(train_step)(state, batch)
+        losses[name] = {k: float(val) for k, val in metrics.items()
+                        if k.startswith(("loss", "video", "audio"))}
+    for k in losses["pixel"]:
+        np.testing.assert_allclose(
+            losses["pixel"][k], losses["patch"][k], rtol=1e-5,
+            err_msg=f"metric {k} diverged between pixel and patch loss",
+        )

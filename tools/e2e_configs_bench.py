@@ -219,7 +219,8 @@ def config_multimodal():
     remat OFF (recompute cost > saved traffic at this depth: 28.5 vs
     30.8 ms at b2/auto), attn 'xla' (the area-rule kernel routing LOSES,
     30.8 ms vs xla's 27.7 at b2 — overlap dilution, PERF.md negative (11)).
-    PIT_MM_BATCH / PIT_MM_REMAT=1 override."""
+    PIT_MM_BATCH / PIT_MM_REMAT=1 / PIT_MM_PATCH_LOSS=1 (patch-space video
+    reconstruction loss — exact, skips the un-patchify transposes) override."""
     from perceiver_io_tpu.models.multimodal import build_multimodal_autoencoder
 
     b = int(os.environ.get("PIT_MM_BATCH", "8"))
@@ -228,6 +229,7 @@ def config_multimodal():
         video_shape=video_shape, num_audio_samples=30720, dtype=DTYPE,
         remat=os.environ.get("PIT_MM_REMAT", "0") != "0",
         attn_impl=ATTN_IMPL or "xla",
+        video_patch_loss=os.environ.get("PIT_MM_PATCH_LOSS", "0") != "0",
     )
     batch = {
         "video": jnp.asarray(rng.normal(0, 1, (b, *video_shape)), jnp.float32),

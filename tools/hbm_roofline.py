@@ -284,6 +284,14 @@ def main() -> None:
                         help="with --trace-dir: batch size for ex/s")
     parser.add_argument("--no-mfu", action="store_true",
                         help="skip the flops subprocess (faster)")
+    parser.add_argument("--flops", type=float, default=None,
+                        help="MFU numerator in FLOPs/step, bypassing the "
+                             "cost-analysis subprocess — for re-runs where "
+                             "the numerator is already known (it is shape-"
+                             "stable per config), or when the subprocess's "
+                             "compile window is squeezed by a busy chip "
+                             "(the multimodal numerator compile alone can "
+                             "exceed it)")
     parser.add_argument("--trace-dir", default=None,
                         help="analyze an existing trace instead of capturing")
     args = parser.parse_args()
@@ -292,13 +300,16 @@ def main() -> None:
     config = args.config
     if config is None:
         if args.trace_dir is not None:
-            print("(--trace-dir without --config: MFU omitted — pass the "
-                  "config that produced the trace to get it)")
+            if args.flops is None:
+                print("(--trace-dir without --config: MFU omitted — pass "
+                      "the config that produced the trace, or --flops)")
         else:
             config = "mlm"
 
-    flops = None
-    if config is not None and not args.no_mfu:
+    flops = args.flops
+    if flops is not None:
+        print(f"(MFU numerator: {flops / 1e12:.2f} TF/step, caller-supplied)")
+    elif config is not None and not args.no_mfu:
         flops = model_flops_per_step(config)
         if flops:
             print(f"(MFU numerator: {config} config, "
