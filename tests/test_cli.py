@@ -328,3 +328,26 @@ def test_resume_nothing_to_resume_fails_clearly(tmp_path):
         json.dumps({"num_latents": 8}))
     with pytest.raises(SystemExit, match="no usable checkpoint"):
         train_mlm.main(tiny + ["--resume", str(constructed)])
+
+
+def test_spawn_retry_gate_reads_coordination_errors(tmp_path):
+    """The spawn_hosts port-race retry fires only on distributed-bring-up
+    evidence in a child log — a deterministic fast failure (bad flag,
+    import error) must NOT look like a race (cli/common.py)."""
+    from perceiver_io_tpu.cli.common import _logs_show_coordination_failure
+
+    logs = iter(range(10))
+
+    def fake_log(text):
+        f = (tmp_path / f"rank{next(logs)}.log").open("w+")
+        f.write(text)
+        f.flush()
+        return f
+
+    race = fake_log("jaxlib ... UNAVAILABLE: failed to connect to coordinator")
+    bind = fake_log("RuntimeError: [Errno 98] Address already in use")
+    plain = fake_log("error: unrecognized arguments: --definitely-not-a-flag")
+    assert _logs_show_coordination_failure([None, race])
+    assert _logs_show_coordination_failure([None, bind])
+    assert not _logs_show_coordination_failure([None, plain])
+    assert not _logs_show_coordination_failure([None])  # rank 0 only
