@@ -7,6 +7,8 @@ One definition of the flagship config so ``bench.py``,
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax.numpy as jnp
 
 from perceiver_io_tpu.models.adapters import TextInputAdapter, TextOutputAdapter
@@ -28,6 +30,7 @@ def flagship_tpu_mlm(
     dtype: jnp.dtype = jnp.bfloat16,
     attn_impl: str = "xla",
     remat: bool = False,
+    decoder_attn_impl: Optional[str] = None,
 ) -> PerceiverMLM:
     """The MLM recipe at TPU-native widths (BASELINE.md north-star, closed
     from the other end).
@@ -57,6 +60,7 @@ def flagship_tpu_mlm(
         dtype=dtype,
         attn_impl=attn_impl,
         remat=remat,
+        decoder_attn_impl=decoder_attn_impl,
     )
 
 
@@ -70,10 +74,16 @@ def flagship_mlm(
     dtype: jnp.dtype = jnp.float32,
     attn_impl: str = "auto",
     remat: bool = False,
+    decoder_attn_impl: Optional[str] = None,
 ) -> PerceiverMLM:
     """The BASELINE.md north-star config: reference train_mlm shapes
     (SURVEY.md §3.1 — 512-token sequences, 256 latents, 3 encoder layers ×
-    (cross-attention + 6-layer self-attention block), text in/out adapters)."""
+    (cross-attention + 6-layer self-attention block), text in/out adapters).
+
+    ``decoder_attn_impl``: override the DECODER's attention impl separately
+    (None = same as ``attn_impl``) — the encoder's long-KV streaming shapes
+    and the decoder's many-queries/few-keys gather-decode shape can prefer
+    different paths (PERF.md r5 long-context decomposition)."""
     latent_shape = (num_latents, num_channels)
     return PerceiverMLM(
         encoder=PerceiverEncoder(
@@ -95,7 +105,7 @@ def flagship_mlm(
             ),
             latent_shape=latent_shape,
             dtype=dtype,
-            attn_impl=attn_impl,
+            attn_impl=decoder_attn_impl or attn_impl,
         ),
         masking=TextMasking(
             vocab_size=vocab_size, unk_token_id=1, mask_token_id=2,

@@ -243,6 +243,13 @@ class MultiHeadAttention(nn.Module):
         h = self.num_heads
         if e % h != 0:
             raise ValueError(f"num_q_channels {e} not divisible by num_heads {h}")
+        if self.attn_impl not in ("auto", "xla", "pallas", "pallas_sp", "packed"):
+            # a typo'd impl must not silently fall through to the XLA branch
+            # and get benchmarked under the wrong label (PERF.md discipline)
+            raise ValueError(
+                f"unknown attn_impl {self.attn_impl!r}; expected one of "
+                "'auto', 'xla', 'pallas', 'pallas_sp', 'packed'"
+            )
         d = e // h
 
         wq, bq = _LinearParams(x_q.shape[-1], e, name="q_proj")()

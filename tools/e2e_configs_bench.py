@@ -73,10 +73,14 @@ def _mlm_config(model_factory, batch_size: int, default_head: str,
                 seq: int = 512):
     """Shared MLM bench recipe (synthetic batch, gather decode, PIT_E2E_HEAD
     override: 'pallas'|'xla'|'none' — 'none' also feeds hbm_roofline's
-    MFU-numerator build, where cost analysis must see the head's flops)."""
+    MFU-numerator build, where cost analysis must see the head's flops;
+    PIT_E2E_DEC_ATTN overrides the DECODER attention impl separately —
+    the gather-decode cross is a many-queries/few-keys shape that can
+    prefer a different path than the encoder's long-KV stream)."""
     vocab, b = 10003, batch_size
     model = model_factory(dtype=DTYPE, attn_impl=ATTN_IMPL or "xla",
-                          max_seq_len=seq)
+                          max_seq_len=seq,
+                          decoder_attn_impl=os.environ.get("PIT_E2E_DEC_ATTN"))
     batch = {
         "token_ids": jnp.asarray(rng.integers(3, vocab, (b, seq)).astype(np.int32)),
         "pad_mask": jnp.zeros((b, seq), bool),
