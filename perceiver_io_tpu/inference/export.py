@@ -45,7 +45,14 @@ def export_forward(
     **apply_kwargs,
 ):
     """Export ``model.apply`` in inference mode with ``params`` baked in as
-    constants (self-contained artifact)."""
+    constants (self-contained artifact).
+
+    ``example_inputs`` are splatted POSITIONALLY into ``model.apply`` — for
+    models whose later positional parameters are mode flags (e.g.
+    ``PerceiverMLM(token_ids, pad_mask, masking=...)``), pass only the
+    leading array arguments here and wrap extras like ``positions`` in an
+    explicit fn via :func:`export_fn` instead (a third positional would
+    collide with ``masking``; tools/inference_bench.py shows the pattern)."""
 
     def fn(*inputs):
         return model.apply(
