@@ -188,6 +188,7 @@ def _maybe_kernel_smoke() -> None:
         mtime_before = os.path.getmtime(out_path)
     except OSError:
         mtime_before = None
+    crashed = False
     try:
         proc = subprocess.run(
             [sys.executable, os.path.join(root, "tools", "kernel_smoke.py"),
@@ -198,17 +199,27 @@ def _maybe_kernel_smoke() -> None:
         failure = f"exit code {proc.returncode}" if proc.returncode else None
     except Exception as e:  # timeout, spawn failure
         failure = repr(e)
+        crashed = True
     try:
         refreshed = os.path.getmtime(out_path) != mtime_before
     except OSError:
         refreshed = False
-    if failure is not None and refreshed:
-        # a non-zero exit with a rewritten artifact means the smoke RAN and
-        # recorded regressions in its failures map — that is the signal the
-        # artifact exists to carry, not staleness
+    if failure is None:
+        return
+    if crashed:
+        # a timeout/spawn failure means the run did NOT complete — even if
+        # the kill landed after a partial artifact write (mtime changed), its
+        # contents cannot be trusted as this run's verdict: stamp it stale
+        print(f"bench: kernel smoke did not complete ({failure}) — stamping "
+              f"{out_path} stale", file=sys.stderr)
+        _stamp_stale_kernel_smoke(out_path, failure)
+    elif refreshed:
+        # a CLEAN non-zero exit with a rewritten artifact means the smoke RAN
+        # and recorded regressions in its failures map — that is the signal
+        # the artifact exists to carry, not staleness
         print(f"bench: kernel smoke reported failures ({failure}) — see the "
               f"failures map in {out_path}", file=sys.stderr)
-    elif failure is not None:
+    else:
         print(f"bench: kernel smoke did NOT refresh {out_path} ({failure}) — "
               "the artifact on disk is from an earlier run", file=sys.stderr)
         _stamp_stale_kernel_smoke(out_path, failure)

@@ -14,6 +14,15 @@ Public API mirrors the reference package surface (reference
 `perceiver/__init__.py:1-13`).
 """
 
+import jax as _jax
+
+# Sharding-invariant PRNG (the modern jax default; this build ships it off):
+# the same key must draw the same bits whether a step runs replicated or
+# pjit-sharded — the checkpoint round-trip "restored replicated state
+# continues IDENTICALLY to the live sharded run" guarantee, and the basis of
+# the multi-host lockstep claims, both depend on it.
+_jax.config.update("jax_threefry_partitionable", True)
+
 from perceiver_io_tpu.models.adapters import (
     InputAdapter,
     OutputAdapter,

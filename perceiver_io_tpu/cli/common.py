@@ -573,11 +573,23 @@ def maybe_spawn_hosts(args, argv=None) -> bool:
                 if retrying:
                     print(
                         f"--spawn_hosts: rank {rank} failed (rc={rc}) within "
-                        f"{_SPAWN_RETRY_WINDOW_S:.0f}s with coordination/bind "
+                        f"{_SPAWN_RETRY_WINDOW_S:.0f}s with connect/bind "
                         "errors in the child logs — likely a coordinator-port "
                         "race; retrying with a fresh port",
                         file=sys.stderr,
                     )
+                    # show the evidence on EVERY retry (ADVICE r5): if this
+                    # is actually a deterministic failure that happens to
+                    # match a connect/bind marker, the user sees the real
+                    # error now instead of after two blind retries
+                    if logs[rank] is not None:
+                        logs[rank].flush()
+                        logs[rank].seek(0)
+                        print(
+                            f"--- rank {rank} output (retry {attempt + 1}) ---"
+                            f"\n{logs[rank].read()[-2000:]}",
+                            file=sys.stderr,
+                        )
                     last_failure = failed
                     continue
                 if logs[rank] is not None:
@@ -617,18 +629,17 @@ def maybe_spawn_hosts(args, argv=None) -> bool:
 _SPAWN_RETRY_WINDOW_S = 20.0
 _SPAWN_PORT_RETRIES = 2
 
-# Signatures of a failed jax.distributed bring-up in a child's output: the
-# coordinator losing the bind race or the clients failing to reach it.
+# Signatures of a failed jax.distributed bring-up in a child's output —
+# CONNECT/BIND-specific only (ADVICE r5): broad markers like
+# 'jax.distributed.initialize' or bare 'unavailable:' also appear in
+# deterministic init-failure tracebacks (bad --num_processes arithmetic,
+# plugin errors), which must surface immediately rather than be retried
+# twice under a misleading port-race diagnostic.
 _COORDINATION_ERROR_MARKERS = (
     "address already in use",
     "failed to connect",
     "connection refused",
-    "coordination service",
-    "coordination_service",
-    "deadline_exceeded",
-    "deadline exceeded",
-    "unavailable:",
-    "jax.distributed.initialize",
+    "bind address",
 )
 
 

@@ -27,6 +27,21 @@ import numpy as np
 Batch = Dict[str, np.ndarray]
 
 
+def resolve_bucket_width(length: int, widths: Sequence[int]) -> int:
+    """Smallest of the (sorted, ascending) ``widths`` holding ``length``;
+    lengths beyond the final width (the cap) truncate to it.
+
+    THE bucket rule — shared by the training collator (``data/imdb.py``),
+    this loader's global-batch width oracle (``group_widths``), and the
+    serving engine's variable-length text frontend (``inference/engine.py``),
+    so train-time and serve-time programs land on identical shapes (one
+    compiled executable per width, reused across both paths).
+    """
+    cap = widths[-1]
+    length = min(max(int(length), 1), cap)
+    return next(w for w in widths if w >= length)
+
+
 def image_label_collate(batch) -> Batch:
     """(image, label) examples → {'image': (B, ...), 'label': (B,) int32} —
     the classifier step-function contract, shared by the image data modules."""
@@ -198,9 +213,8 @@ class DataLoader:
         """Bucket width of a GLOBAL batch — identical on every host, because
         it reads the shared ``sort_key`` (token lengths) for the full batch
         rather than any host-local slice."""
-        cap = self.group_widths[-1]
         longest = int(self.sort_key[batch_idx].max(initial=1))
-        return next(w for w in self.group_widths if w >= min(longest, cap))
+        return resolve_bucket_width(longest, self.group_widths)
 
     def skip_next(self, num_batches: int) -> None:
         """Skip the first ``num_batches`` of the NEXT iteration — deterministic

@@ -4,7 +4,17 @@ from perceiver_io_tpu.inference.export import (
     export_forward,
     load_exported,
 )
-from perceiver_io_tpu.inference.mlm import MLMPredictor, encode_masked_texts
+from perceiver_io_tpu.inference.mlm import (
+    MLMPredictor,
+    encode_masked_texts,
+    load_mlm_checkpoint,
+)
+from perceiver_io_tpu.inference.engine import (
+    CachedLatents,
+    EngineClosed,
+    MLMServer,
+    ServingEngine,
+)
 
 __all__ = [
     "Predictor",
@@ -14,4 +24,9 @@ __all__ = [
     "load_exported",
     "MLMPredictor",
     "encode_masked_texts",
+    "load_mlm_checkpoint",
+    "CachedLatents",
+    "EngineClosed",
+    "MLMServer",
+    "ServingEngine",
 ]

@@ -1,0 +1,756 @@
+"""High-throughput serving engine: continuous micro-batching over the
+bucketed XLA programs, plus the latent-cache (encode-once / decode-many) path.
+
+``Predictor`` (``inference/predictor.py``) made single requests
+compile-stable; this module makes a *stream* of requests fast. The three
+ideas, all reusing machinery the training stack already proved out:
+
+1. **Continuous micro-batching** (``ServingEngine``): callers ``submit()``
+   requests into a queue; a worker thread coalesces whatever is pending into
+   one micro-batch, pads it to the next power-of-two bucket (the
+   ``Predictor`` shapes — one XLA program per bucket, ``warmup()`` compiles
+   them all ahead of time so steady state never compiles), and dispatches.
+   Up to ``max_inflight`` dispatches stay in flight, so host work — queue
+   drain, padding, result slicing — overlaps device compute exactly the way
+   ``steps_per_dispatch`` overlaps the training loop. While the device chews
+   on batch *i*, arrivals accumulate and become batch *i+1*: under load the
+   engine serves large batches at device throughput; idle, a lone request
+   dispatches immediately (``max_delay_ms`` optionally holds the first
+   request back to let a batch form).
+
+2. **Latent-cache decode** (``MLMServer.encode`` / ``decode``): Perceiver
+   IO's fixed latent array is the model's entire summary of the input — the
+   architecture's analogue of a KV cache. The split ``encode()``/``decode()``
+   methods on the model core (``models/perceiver.py``) let multi-query
+   workloads (fill-mask at several positions, multi-task decode heads) pay
+   the O(L) encoder cross-attention once and decode arbitrarily many query
+   sets against the cached latents.
+
+3. **Width bucketing for variable-length text** (``MLMServer``): requests
+   tokenize to their natural length and pad to the smallest serving width
+   bucket (``resolve_bucket_width`` — the same rule as the training
+   collator's ``bucket_widths``), so short requests never pay max_seq_len
+   compute. Same-width requests batch together; each (width, batch-bucket,
+   query-bucket) triple is one compiled program, all warmable ahead of time.
+
+bf16 serving: pass ``compute_dtype='bfloat16'`` to an engine built over a
+bf16-``dtype`` model — floating params/inputs are cast ONCE at engine
+construction / dispatch (halving param HBM traffic per batch). Never set it
+on the f32 golden-parity path: bf16 rounds. On TPU the padded input buffers
+are donated to XLA (``donate_argnums``) — each dispatch's staging buffer is
+handed to the device while the host fills the next one (ping-pong staging);
+off-TPU donation is skipped (unimplemented there, and XLA would warn).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from perceiver_io_tpu.inference.predictor import bucket_size
+
+_IDLE_POLL_S = 0.05  # worker wake-up cadence while idle (checks shutdown)
+
+
+class EngineClosed(RuntimeError):
+    """submit() after close()."""
+
+
+class _Future:
+    """Result handle for one submitted request.
+
+    Oversized requests are split into ``num_parts`` sub-dispatches; the
+    future assembles them (axis-0 concat per leaf) when the last completes.
+    ``transform`` (optional) maps the assembled result in the caller's
+    ``result()`` — post-processing (top-k decode, detokenization) stays off
+    the engine worker thread.
+    """
+
+    def __init__(self, num_parts: int = 1,
+                 transform: Optional[Callable[[Any], Any]] = None):
+        self._event = threading.Event()
+        self._parts: List[Any] = [None] * num_parts
+        self._remaining = num_parts
+        self._lock = threading.Lock()
+        self._error: Optional[BaseException] = None
+        self._transform = transform
+        self._assembled = None
+        self._has_result = False
+
+    def _deliver(self, index: int, result) -> None:
+        with self._lock:
+            self._parts[index] = result
+            self._remaining -= 1
+            if self._remaining == 0:
+                self._event.set()
+
+    def _fail(self, error: BaseException) -> None:
+        with self._lock:
+            if self._error is None:
+                self._error = error
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("request not served within timeout")
+        if self._error is not None:
+            raise self._error
+        with self._lock:
+            if not self._has_result:
+                if len(self._parts) == 1:
+                    out = self._parts[0]
+                else:
+                    import jax
+
+                    out = jax.tree.map(
+                        lambda *xs: np.concatenate(xs, axis=0), *self._parts
+                    )
+                if self._transform is not None:
+                    out = self._transform(out)
+                self._assembled, self._has_result = out, True
+                self._parts = []  # free the per-part copies
+        return self._assembled
+
+
+class _Part:
+    """One queue unit: ≤ max_batch rows of one request."""
+
+    __slots__ = ("inputs", "n", "key", "future", "index", "t_submit")
+
+    def __init__(self, inputs: List[np.ndarray], key, future: _Future,
+                 index: int):
+        self.inputs = inputs
+        self.n = inputs[0].shape[0]
+        self.key = key
+        self.future = future
+        self.index = index
+        self.t_submit = time.monotonic()
+
+
+class ServingEngine:
+    """Continuous micro-batching over ``apply_fn(params, *inputs)``.
+
+    - requests with identical non-leading shapes/dtypes (the program *key* —
+      e.g. one sequence-width bucket) coalesce into micro-batches, padded to
+      the next power-of-two ≤ ``max_batch`` (padding repeats row 0; sliced
+      off per request), oldest key first;
+    - requests larger than ``max_batch`` are chunked and reassembled;
+    - ``max_inflight`` dispatches are kept outstanding — assembling batch
+      *i+1* overlaps the device computing batch *i*;
+    - ``warmup(*example)`` compiles every batch bucket for an input
+      signature ahead of time, so steady-state serving never compiles;
+    - ``compute_dtype`` casts floating params (once) and inputs (per batch)
+      — the bf16 serving path; leave None on the f32 parity path;
+    - on TPU, input buffers are donated to XLA (ping-pong staging).
+
+    ``apply_fn`` must treat examples independently along the leading axis
+    (true of every model here) and be deterministic (dropout off).
+    """
+
+    def __init__(
+        self,
+        apply_fn: Callable[..., Any],
+        params,
+        max_batch: int = 64,
+        max_delay_ms: float = 0.0,
+        max_inflight: int = 2,
+        compute_dtype: Optional[str] = None,
+        donate_inputs: Optional[bool] = None,
+        name: str = "serve",
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        self.max_batch = max_batch
+        self.max_delay = max_delay_ms / 1e3
+        self.max_inflight = max_inflight
+        self.name = name
+        self._compute_dtype = (
+            None if compute_dtype is None else jnp.dtype(compute_dtype)
+        )
+        if donate_inputs is None:
+            # donation is a TPU/GPU runtime feature; on CPU XLA ignores it
+            # with a warning per program
+            donate_inputs = jax.default_backend() == "tpu"
+        self.donate_inputs = donate_inputs
+
+        if self._compute_dtype is not None:
+            cast = lambda x: (
+                x.astype(self._compute_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x
+            )
+            params = jax.tree.map(cast, params)
+        self.params = jax.device_put(params)
+
+        self._apply_fn = apply_fn
+
+        def call(p, inputs):
+            return apply_fn(p, *inputs)
+
+        self._call = call
+        self._jitted = jax.jit(
+            call, donate_argnums=(1,) if donate_inputs else ()
+        )
+
+        self._queue: "queue.Queue[_Part]" = queue.Queue()
+        # program-key → deque of pending parts; dict order = arrival order of
+        # the oldest pending part per key (FIFO across keys)
+        self._pending: Dict[Any, deque] = {}
+        self._programs: set = set()  # (key, bucket) pairs ever dispatched
+        self.stats: Dict[str, Any] = {
+            "requests": 0, "rows": 0, "batches": 0, "padded_rows": 0,
+            "latency_s_by_bucket": {},
+        }
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"{name}-engine", daemon=True
+        )
+        self._thread.start()
+
+    # -- submission ----------------------------------------------------------
+
+    def submit(self, *inputs, transform: Optional[Callable] = None) -> _Future:
+        """Enqueue one request (arrays sharing a leading batch axis); returns
+        a future whose ``result()`` is the output pytree sliced to this
+        request's rows (numpy, on host)."""
+        if self._stop.is_set():
+            raise EngineClosed("submit() on a closed engine")
+        arrays = [np.asarray(x) for x in inputs]
+        if not arrays:
+            raise ValueError("submit() needs at least one input array")
+        n = arrays[0].shape[0]
+        if any(a.shape[0] != n for a in arrays):
+            raise ValueError("all inputs must share the leading batch axis")
+        if n == 0:
+            fut = _Future(1, transform)
+            fut._deliver(0, self._empty_result(arrays))
+            return fut
+        starts = list(range(0, n, self.max_batch))
+        fut = _Future(len(starts), transform)
+        self.stats["requests"] += 1
+        for index, start in enumerate(starts):
+            chunk = [a[start: start + self.max_batch] for a in arrays]
+            self._queue.put(_Part(chunk, self._key(chunk), fut, index))
+        if self._stop.is_set() and not self._thread.is_alive():
+            # raced a shutdown/worker-crash: the drain already ran, so these
+            # parts would sit unread forever — fail the future ourselves
+            fut._fail(EngineClosed("engine stopped while request was queued"))
+        return fut
+
+    def predict(self, *inputs, timeout: Optional[float] = None):
+        """Synchronous submit + result."""
+        return self.submit(*inputs).result(timeout=timeout)
+
+    def _key(self, arrays: Sequence[np.ndarray]):
+        return tuple((a.shape[1:], str(a.dtype)) for a in arrays)
+
+    def _empty_result(self, arrays: Sequence[np.ndarray]):
+        """n=0 request: pytree of empty arrays via eval_shape (no device)."""
+        import jax
+
+        ones = tuple(
+            self._cast(np.zeros((1, *a.shape[1:]), a.dtype)) for a in arrays
+        )
+        shapes = jax.eval_shape(self._call, self.params, ones)
+        return jax.tree.map(
+            lambda s: np.zeros((0, *s.shape[1:]), s.dtype), shapes
+        )
+
+    # -- warmup --------------------------------------------------------------
+
+    def warmup(self, *example_inputs,
+               buckets: Optional[Sequence[int]] = None) -> List[int]:
+        """Ahead-of-time compile every batch bucket for this input signature
+        (row 0 of ``example_inputs``, tiled). One call per distinct signature
+        — e.g. per serving width bucket — and steady state never compiles.
+        Returns the bucket sizes warmed."""
+        import jax
+
+        arrays = [np.asarray(x) for x in example_inputs]
+        if any(a.shape[0] < 1 for a in arrays):
+            raise ValueError("warmup needs at least one example row")
+        if buckets is None:
+            buckets, b = [], 1
+            while b < self.max_batch:
+                buckets.append(b)
+                b *= 2
+            buckets.append(self.max_batch)
+        buckets = sorted({bucket_size(int(b), self.max_batch) for b in buckets})
+        key = self._key([a[:1] for a in arrays])
+        for b in buckets:
+            cols = tuple(
+                self._cast(np.ascontiguousarray(
+                    np.broadcast_to(a[:1], (b, *a.shape[1:]))
+                ))
+                for a in arrays
+            )
+            out = self._execute(cols, b, key)
+            jax.block_until_ready(out)
+        return list(buckets)
+
+    # -- worker --------------------------------------------------------------
+
+    def _run(self) -> None:
+        inflight: deque = deque()  # ((device_out, bucket), parts)
+        try:
+            while True:
+                parts = None
+                if len(inflight) < self.max_inflight:
+                    # while dispatches are in flight this poll is
+                    # non-blocking: the device working IS the micro-batching
+                    # window
+                    parts = self._next_batch(0.0 if inflight else _IDLE_POLL_S)
+                if parts is not None:
+                    try:
+                        inflight.append((self._dispatch(parts), parts))
+                    except BaseException as e:  # bad batch: fail it, live on
+                        for p in parts:
+                            p.future._fail(e)
+                    continue
+                if inflight:
+                    self._complete(*inflight.popleft())
+                    continue
+                if (self._stop.is_set() and self._queue.empty()
+                        and not self._pending):
+                    return
+        except BaseException as e:
+            # the worker must never die with futures outstanding — a caller
+            # blocked in result() with no timeout would hang forever. Fail
+            # everything queued/pending/in flight, then stop accepting.
+            self._stop.set()
+            for _, parts in inflight:
+                for p in parts:
+                    p.future._fail(e)
+            for dq in self._pending.values():
+                for p in dq:
+                    p.future._fail(e)
+            self._pending.clear()
+            while True:
+                try:
+                    self._queue.get_nowait().future._fail(e)
+                except queue.Empty:
+                    break
+            raise
+
+    def _absorb(self, part: _Part) -> None:
+        self._pending.setdefault(part.key, deque()).append(part)
+
+    def _rows_pending(self, key) -> int:
+        return sum(p.n for p in self._pending.get(key, ()))
+
+    def _next_batch(self, timeout: float) -> Optional[List[_Part]]:
+        """Collect the next micro-batch: drain the queue into per-key pending
+        lists, wait up to ``max_delay`` for the oldest key to fill (skipped
+        when 0 — pure continuous batching), then seal whole parts of the
+        oldest key up to ``max_batch`` rows."""
+        if not self._pending:
+            try:
+                self._absorb(self._queue.get(timeout=timeout))
+            except queue.Empty:
+                return None
+        deadline = time.monotonic() + self.max_delay
+        while True:
+            try:
+                while True:  # non-blocking drain of everything queued now
+                    self._absorb(self._queue.get_nowait())
+            except queue.Empty:
+                pass
+            key = next(iter(self._pending))
+            if self._rows_pending(key) >= self.max_batch:
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                self._absorb(self._queue.get(timeout=remaining))
+            except queue.Empty:
+                break
+        key = next(iter(self._pending))
+        dq = self._pending[key]
+        parts, total = [], 0
+        while dq and total + dq[0].n <= self.max_batch:
+            part = dq.popleft()
+            parts.append(part)
+            total += part.n
+        if not dq:
+            del self._pending[key]
+        else:
+            # round-robin across keys: a key with work left over goes to the
+            # BACK of the dict order, so sustained load on one program key
+            # cannot starve requests queued under another
+            self._pending[key] = self._pending.pop(key)
+        return parts
+
+    def _cast(self, a: np.ndarray):
+        if self._compute_dtype is not None and np.issubdtype(
+            a.dtype, np.floating
+        ):
+            return a.astype(self._compute_dtype)
+        return a
+
+    def _execute(self, cols: Tuple[np.ndarray, ...], bucket: int, key):
+        import jax
+
+        self._programs.add((key, bucket))
+        with jax.profiler.StepTraceAnnotation(
+            self.name, step_num=self.stats["batches"]
+        ):
+            return self._jitted(self.params, cols)
+
+    def _dispatch(self, parts: List[_Part]):
+        n = sum(p.n for p in parts)
+        bucket = bucket_size(n, self.max_batch)
+        num_inputs = len(parts[0].inputs)
+        cols = []
+        for i in range(num_inputs):
+            col = (
+                parts[0].inputs[i] if len(parts) == 1
+                else np.concatenate([p.inputs[i] for p in parts], axis=0)
+            )
+            if bucket > n:  # padding repeats row 0; sliced off at completion
+                col = np.concatenate(
+                    [col, np.broadcast_to(col[:1], (bucket - n, *col.shape[1:]))],
+                    axis=0,
+                )
+            cols.append(self._cast(np.ascontiguousarray(col)))
+        out = self._execute(tuple(cols), bucket, parts[0].key)
+        self.stats["batches"] += 1
+        self.stats["rows"] += n
+        self.stats["padded_rows"] += bucket - n
+        return out, bucket
+
+    def _complete(self, out_bucket, parts: List[_Part]) -> None:
+        import jax
+
+        out, bucket = out_bucket
+        try:
+            host = jax.tree.map(np.asarray, jax.device_get(out))
+        except BaseException as e:
+            for p in parts:
+                p.future._fail(e)
+            return
+        now = time.monotonic()
+        # bounded: an engine serves indefinitely — unbounded per-request
+        # float lists would grow without limit; the window is plenty for
+        # p50/p95 reporting
+        lat = self.stats["latency_s_by_bucket"].setdefault(
+            bucket, deque(maxlen=4096)
+        )
+        offset = 0
+        for p in parts:
+            o = offset
+            p.future._deliver(
+                p.index, jax.tree.map(lambda a: a[o: o + p.n], host)
+            )
+            lat.append(now - p.t_submit)
+            offset += p.n
+
+    # -- introspection / lifecycle -------------------------------------------
+
+    @property
+    def num_programs(self) -> int:
+        """Distinct (signature, batch-bucket) programs dispatched or warmed."""
+        return len(self._programs)
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Stop accepting requests, drain everything queued, join the worker."""
+        self._stop.set()
+        self._thread.join(timeout)
+        # a submit() racing close() can slip a part in after the worker
+        # exits — fail it rather than leave its future hanging
+        while True:
+            try:
+                self._queue.get_nowait().future._fail(
+                    EngineClosed("engine closed before this request ran")
+                )
+            except queue.Empty:
+                break
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class CachedLatents:
+    """Result of :meth:`MLMServer.encode`: the latent arrays plus the
+    request-side bookkeeping needed to decode against them later."""
+
+    __slots__ = ("latents", "token_ids", "mask_positions")
+
+    def __init__(self, latents: np.ndarray, token_ids: List[np.ndarray],
+                 mask_positions: List[np.ndarray]):
+        self.latents = latents          # (B, N, C) — width-independent
+        self.token_ids = token_ids      # per row, at its serving width
+        self.mask_positions = mask_positions  # per row, [MASK] indices
+
+    def __len__(self) -> int:
+        return self.latents.shape[0]
+
+
+class MLMServer:
+    """Text serving frontend over a ``PerceiverMLM``: tokenize → width-bucket
+    → micro-batching engine; fill-mask via the gathered decode, plus the
+    encode-once/decode-many latent cache.
+
+    ``bucket_widths``: serving sequence-width buckets (the training
+    collator's rule, ``resolve_bucket_width``); None = always ``max_seq_len``.
+    Each (width, batch-bucket, K-bucket) is one program — ``warmup()``
+    compiles them all so steady state never compiles.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        tokenizer,
+        max_seq_len: int,
+        bucket_widths: Optional[Sequence[int]] = None,
+        max_batch: int = 64,
+        max_delay_ms: float = 0.0,
+        max_inflight: int = 2,
+        compute_dtype: Optional[str] = None,
+    ):
+        import jax
+
+        from perceiver_io_tpu.data.tokenizer import MASK_TOKEN
+
+        self.model = model
+        self.tokenizer = tokenizer
+        self.max_seq_len = max_seq_len
+        self.mask_id = tokenizer.token_to_id(MASK_TOKEN)
+        if bucket_widths:
+            widths = sorted({int(w) for w in bucket_widths})
+            if widths[0] <= 0 or widths[-1] > max_seq_len:
+                raise ValueError(
+                    f"bucket_widths must lie in [1, max_seq_len={max_seq_len}],"
+                    f" got {widths}"
+                )
+            if widths[-1] != max_seq_len:
+                widths.append(max_seq_len)
+            self.widths: List[int] = widths
+        else:
+            self.widths = [max_seq_len]
+
+        # ONE device-resident (optionally bf16) param copy shared by all
+        # three programs — the engines receive committed arrays and their
+        # device_put is a no-op
+        if compute_dtype is not None:
+            import jax.numpy as jnp
+
+            dt = jnp.dtype(compute_dtype)
+            params = jax.tree.map(
+                lambda x: x.astype(dt)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x,
+                params,
+            )
+        params = jax.device_put(params)
+
+        def fused_apply(p, token_ids, pad_mask, positions):
+            logits, _ = model.apply(
+                {"params": p}, token_ids, pad_mask, masking=False,
+                deterministic=True, positions=positions,
+            )
+            return logits
+
+        def encode_apply(p, token_ids, pad_mask):
+            return model.apply(
+                {"params": p}, token_ids, pad_mask, deterministic=True,
+                method="encode",
+            )
+
+        def decode_apply(p, latents, positions):
+            return model.apply(
+                {"params": p}, latents, deterministic=True,
+                positions=positions, method="decode",
+            )
+
+        common = dict(
+            max_batch=max_batch, max_delay_ms=max_delay_ms,
+            max_inflight=max_inflight, compute_dtype=compute_dtype,
+        )
+        # fused single-pass path (one-shot requests) + the split pair
+        # (latent-cache workloads); each engine owns one program family
+        self.engine = ServingEngine(fused_apply, params, name="mlm", **common)
+        self.encoder = ServingEngine(
+            encode_apply, params, name="mlm_enc", **common
+        )
+        self.decoder = ServingEngine(
+            decode_apply, params, name="mlm_dec", **common
+        )
+
+    # -- request preparation -------------------------------------------------
+
+    def _prepare(self, text: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Tokenize one text (ONCE, at natural length) and pad to its serving
+        width bucket; returns ``(token_ids (1, W), pad_mask (1, W),
+        mask_positions)``."""
+        from perceiver_io_tpu.data.pipeline import resolve_bucket_width
+        from perceiver_io_tpu.inference.mlm import (
+            masked_token_ids,
+            pad_token_rows,
+        )
+
+        row = masked_token_ids(self.tokenizer, text)
+        width = resolve_bucket_width(len(row), self.widths)
+        ids, pad = pad_token_rows([row], width, self._pad_id())
+        return ids, pad, np.nonzero(ids[0] == self.mask_id)[0]
+
+    def _pad_id(self) -> int:
+        from perceiver_io_tpu.data.tokenizer import PAD_TOKEN
+
+        return self.tokenizer.token_to_id(PAD_TOKEN)
+
+    def _positions_row(self, mask_pos: np.ndarray, width: int) -> np.ndarray:
+        """(1, K-bucket) positions row; filler slots repeat position 0 (their
+        logits are never read). K buckets are powers of two so same-K
+        requests share a program."""
+        kb = bucket_size(max(len(mask_pos), 1), width)
+        row = np.zeros((1, kb), np.int32)
+        row[0, : len(mask_pos)] = mask_pos
+        return row
+
+    def _topk_transform(self, n_masks: int, k: int):
+        def transform(logits: np.ndarray) -> List[List[str]]:
+            out = []
+            for slot in range(n_masks):
+                top = np.argsort(-np.asarray(logits[0, slot], np.float32))[:k]
+                out.append([self.tokenizer.id_to_token(int(t)) for t in top])
+            return out
+
+        return transform
+
+    # -- one-shot fill-mask (fused path) -------------------------------------
+
+    def submit(self, text: str, k: int = 5) -> _Future:
+        """Enqueue one fill-mask request; ``result()`` is the per-``[MASK]``
+        top-k token lists (``MLMPredictor.fill_masks`` row semantics)."""
+        ids, pad, mask_pos = self._prepare(text)
+        if len(mask_pos) == 0:  # nothing to decode: complete without device
+            fut = _Future(1, None)
+            fut._deliver(0, [])
+            return fut
+        positions = self._positions_row(mask_pos, ids.shape[1])
+        return self.engine.submit(
+            ids, pad, positions,
+            transform=self._topk_transform(len(mask_pos), k),
+        )
+
+    def fill_masks(self, texts: Sequence[str], k: int = 5) -> List[List[List[str]]]:
+        """Batch-synchronous fill-mask: submit everything, then collect —
+        the engine micro-batches the whole set."""
+        futures = [self.submit(t, k) for t in texts]
+        return [f.result() for f in futures]
+
+    # -- latent cache: encode once, decode many ------------------------------
+
+    def encode(self, texts: Sequence[str]) -> CachedLatents:
+        """Run the encoder half once per text (width-bucketed, micro-batched)
+        and cache the latents; the O(L) work never repeats across decodes."""
+        prepared = [self._prepare(t) for t in texts]
+        futures = [
+            self.encoder.submit(ids, pad) for ids, pad, _ in prepared
+        ]
+        latents = np.concatenate([f.result() for f in futures], axis=0)
+        return CachedLatents(
+            latents,
+            [ids[0] for ids, _, _ in prepared],
+            [pos for _, _, pos in prepared],
+        )
+
+    def decode(self, cached: CachedLatents, positions: np.ndarray) -> np.ndarray:
+        """Decode explicit (B, K) query ``positions`` against cached latents:
+        (B, K, vocab) logits. B must match ``len(cached)``."""
+        positions = np.asarray(positions, np.int32)
+        if positions.shape[0] != len(cached):
+            raise ValueError(
+                f"positions rows {positions.shape[0]} != cached batch "
+                f"{len(cached)}"
+            )
+        return self.decoder.predict(cached.latents, positions)
+
+    def fill_masks_cached(self, cached: CachedLatents,
+                          k: int = 5) -> List[List[List[str]]]:
+        """Fill-mask from cached latents only — the decode-many half of the
+        cache: each row decodes its own ``[MASK]`` positions (K-bucketed), no
+        encoder work at all."""
+        futures = []
+        for row in range(len(cached)):
+            mask_pos = cached.mask_positions[row]
+            if len(mask_pos) == 0:
+                fut = _Future(1, None)
+                fut._deliver(0, [])
+                futures.append(fut)
+                continue
+            positions = self._positions_row(mask_pos, self.max_seq_len)
+            futures.append(self.decoder.submit(
+                cached.latents[row: row + 1], positions,
+                transform=self._topk_transform(len(mask_pos), k),
+            ))
+        return [f.result() for f in futures]
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def warmup(self, batch_buckets: Optional[Sequence[int]] = None,
+               query_buckets: Sequence[int] = (1, 2, 4)) -> int:
+        """Compile the serving programs ahead of time: for every width bucket
+        × batch bucket (× K bucket for the decode paths). Returns the number
+        of programs warmed — after this, steady-state serving never compiles
+        (the compile-count test pins it)."""
+        warmed = 0
+        for width in self.widths:
+            # pad NOTHING in the warmup example: a fully-padded row would
+            # feed the cross-attention an all-masked KV stream (NaN softmax)
+            ids = np.zeros((1, width), np.int32)
+            pad = np.zeros((1, width), bool)
+            for kb in sorted({bucket_size(int(q), width) for q in query_buckets}):
+                positions = np.zeros((1, kb), np.int32)
+                warmed += len(self.engine.warmup(
+                    ids, pad, positions, buckets=batch_buckets
+                ))
+            warmed += len(self.encoder.warmup(ids, pad, buckets=batch_buckets))
+        latent_row = self.encoder.predict(
+            np.zeros((1, self.widths[0]), np.int32),
+            np.zeros((1, self.widths[0]), bool),
+        )
+        for kb in sorted({bucket_size(int(q), self.max_seq_len)
+                          for q in query_buckets}):
+            positions = np.zeros((1, kb), np.int32)
+            warmed += len(self.decoder.warmup(
+                latent_row, positions, buckets=batch_buckets
+            ))
+        return warmed
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "fused": dict(self.engine.stats),
+            "encode": dict(self.encoder.stats),
+            "decode": dict(self.decoder.stats),
+            "programs": (self.engine.num_programs
+                         + self.encoder.num_programs
+                         + self.decoder.num_programs),
+        }
+
+    def close(self) -> None:
+        self.engine.close()
+        self.encoder.close()
+        self.decoder.close()
+
+    def __enter__(self) -> "MLMServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

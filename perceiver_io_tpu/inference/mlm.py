@@ -17,28 +17,88 @@ from perceiver_io_tpu.inference.predictor import Predictor, bucket_size
 Array = jax.Array
 
 
+def masked_token_ids(tokenizer: WordPieceTokenizer, text: str) -> List[int]:
+    """Token ids for one raw string containing the ``[MASK]`` literal,
+    splicing in the mask token id (the tokenizer treats specials as plain
+    text). Natural length — no padding or truncation; callers pick a width
+    (the serving engine buckets on ``len()`` so each text tokenizes ONCE)."""
+    mask_id = tokenizer.token_to_id(MASK_TOKEN)
+    ids: List[int] = []
+    for i, piece in enumerate(text.split(MASK_TOKEN)):
+        if i > 0:
+            ids.append(mask_id)
+        if piece.strip():
+            ids.extend(tokenizer.encode_ids(piece))
+    return ids
+
+
+def pad_token_rows(
+    rows: Sequence[Sequence[int]], width: int, pad_id: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Rows of ids → ``(token_ids, pad_mask)`` at fixed ``width`` (rows
+    longer than ``width`` truncate)."""
+    token_ids = np.full((len(rows), width), pad_id, dtype=np.int32)
+    for i, ids in enumerate(rows):
+        token_ids[i, : min(len(ids), width)] = ids[:width]
+    return token_ids, token_ids == pad_id
+
+
 def encode_masked_texts(
     tokenizer: WordPieceTokenizer, texts: Sequence[str], max_seq_len: int
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Encode raw strings containing the ``[MASK]`` literal, splicing in the
-    mask token id (the tokenizer treats specials as plain text). Returns
+    """Encode raw strings containing the ``[MASK]`` literal. Returns
     ``(token_ids, pad_mask)`` at fixed width ``max_seq_len``."""
-    mask_id = tokenizer.token_to_id(MASK_TOKEN)
     pad_id = tokenizer.token_to_id(PAD_TOKEN)
-    rows: List[List[int]] = []
-    for text in texts:
-        ids: List[int] = []
-        pieces = text.split(MASK_TOKEN)
-        for i, piece in enumerate(pieces):
-            if i > 0:
-                ids.append(mask_id)
-            if piece.strip():
-                ids.extend(tokenizer.encode_ids(piece))
-        rows.append(ids[:max_seq_len])
-    token_ids = np.full((len(rows), max_seq_len), pad_id, dtype=np.int32)
-    for i, ids in enumerate(rows):
-        token_ids[i, : len(ids)] = ids
-    return token_ids, token_ids == pad_id
+    rows = [masked_token_ids(tokenizer, text) for text in texts]
+    return pad_token_rows(rows, max_seq_len, pad_id)
+
+
+def load_mlm_checkpoint(
+    checkpoint_dir: str,
+    tokenizer: WordPieceTokenizer,
+    step: Optional[int] = None,
+    dtype: Optional[str] = None,
+):
+    """Rebuild a ``PerceiverMLM`` from the hparams embedded in a checkpoint
+    and restore its best/chosen step. Returns ``(model, params, max_seq_len)``
+    — the shared loading path of :class:`MLMPredictor` and the serving
+    engine's ``cli/serve.py``.
+
+    ``dtype`` overrides the COMPUTE dtype of the rebuilt model (e.g.
+    ``'bfloat16'`` for the bf16 serving path); None keeps the checkpoint's
+    recorded dtype or the float32 golden-parity default.
+    """
+    from perceiver_io_tpu.cli import common
+    from perceiver_io_tpu.training.checkpoint import load_hparams, restore_params
+
+    hparams = load_hparams(checkpoint_dir)
+    # Framework-only knobs absent from older / imported-reference
+    # checkpoints (a torch .ckpt's hparams carry only the reference's
+    # argparse surface); the checkpoint's own values override. dtype is
+    # DELIBERATELY float32 (not the CLI's bf16 training default):
+    # imported weights come from an f32 torch model and f32 is the
+    # golden-parity inference path.
+    defaults = {
+        "dtype": "float32", "attn_impl": "auto", "remat": False,
+        "dropout": 0.0,
+    }
+    args = SimpleNamespace(**{**defaults, **hparams})
+    if dtype is not None:
+        args.dtype = dtype
+    vocab_size = tokenizer.get_vocab_size()
+    max_seq_len = hparams["max_seq_len"]
+    model = common.build_mlm(args, vocab_size, max_seq_len)
+
+    ids = np.zeros((1, max_seq_len), np.int32)
+    pad = np.zeros((1, max_seq_len), bool)
+    like = jax.eval_shape(
+        lambda: model.init(
+            {"params": jax.random.key(0), "masking": jax.random.key(1)},
+            ids, pad,
+        )
+    )["params"]
+    params = restore_params(checkpoint_dir, like, step=step)
+    return model, params, max_seq_len
 
 
 class MLMPredictor:
@@ -82,34 +142,9 @@ class MLMPredictor:
     ) -> "MLMPredictor":
         """Rebuild the model from the hparams embedded in the checkpoint
         (``save_hyperparameters`` parity) and restore its best/chosen step."""
-        from perceiver_io_tpu.cli import common
-        from perceiver_io_tpu.training.checkpoint import load_hparams, restore_params
-
-        hparams = load_hparams(checkpoint_dir)
-        # Framework-only knobs absent from older / imported-reference
-        # checkpoints (a torch .ckpt's hparams carry only the reference's
-        # argparse surface); the checkpoint's own values override. dtype is
-        # DELIBERATELY float32 (not the CLI's bf16 training default):
-        # imported weights come from an f32 torch model and f32 is the
-        # golden-parity inference path.
-        defaults = {
-            "dtype": "float32", "attn_impl": "auto", "remat": False,
-            "dropout": 0.0,
-        }
-        args = SimpleNamespace(**{**defaults, **hparams})
-        vocab_size = tokenizer.get_vocab_size()
-        max_seq_len = hparams["max_seq_len"]
-        model = common.build_mlm(args, vocab_size, max_seq_len)
-
-        ids = np.zeros((1, max_seq_len), np.int32)
-        pad = np.zeros((1, max_seq_len), bool)
-        like = jax.eval_shape(
-            lambda: model.init(
-                {"params": jax.random.key(0), "masking": jax.random.key(1)},
-                ids, pad,
-            )
-        )["params"]
-        params = restore_params(checkpoint_dir, like, step=step)
+        model, params, max_seq_len = load_mlm_checkpoint(
+            checkpoint_dir, tokenizer, step=step
+        )
         return cls(model, params, tokenizer, max_seq_len, max_batch=max_batch)
 
     def logits(self, texts: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:

@@ -127,7 +127,12 @@ class _ScaledEmbed(nn.Embed):
     def __call__(self, inputs: Array) -> Array:
         if not jnp.issubdtype(inputs.dtype, jnp.integer):
             raise ValueError("Input type must be an integer or unsigned integer.")
-        (embedding,) = self.promote_dtype(
+        # the free-function spelling (flax.linen.dtypes) — what nn.Embed
+        # itself calls; the Module-method spelling doesn't exist on every
+        # flax release this runs under
+        from flax.linen.dtypes import promote_dtype
+
+        (embedding,) = promote_dtype(
             self.embedding, dtype=self.dtype, inexact=False
         )
         return jnp.take(embedding * self.scale, inputs, axis=0)

@@ -244,6 +244,28 @@ class PerceiverIO(nn.Module):
         x_latent = self.encoder(x, pad_mask=pad_mask, deterministic=enc_det)
         return self.decoder(x_latent, deterministic=deterministic)
 
+    def encode(self, x, pad_mask=None, deterministic=True) -> Array:
+        """Encoder half only: inputs → (B, N, C) latents.
+
+        The latent array is the model's entire summary of the input —
+        Perceiver IO's analogue of a KV cache. Serving callers run this once
+        per input and then :meth:`decode` arbitrarily many query sets against
+        the cached latents (``model.apply(vars, x, method="encode")``),
+        amortizing all O(M) encoder work across decodes.
+        """
+        return self.encoder(x, pad_mask=pad_mask, deterministic=deterministic)
+
+    def decode(self, x_latent: Array, deterministic=True,
+               positions: Optional[Array] = None, return_features: bool = False):
+        """Decoder half only: cached latents (+ optional (B, K) query
+        ``positions``) → task output. Exactly the fused forward's decoder —
+        each output query attends to the latents independently, so
+        ``decode(encode(x))`` is the fused ``__call__`` computation."""
+        return self.decoder(
+            x_latent, deterministic=deterministic, positions=positions,
+            return_features=return_features,
+        )
+
 
 class PerceiverMLM(nn.Module):
     """masking → encoder → decoder, logits truncated to input length
@@ -334,3 +356,27 @@ class PerceiverMLM(nn.Module):
             return_features=return_features,
         )[:, :l, :]
         return x_out, x_labels
+
+    def encode(self, x_input: Array, pad_mask: Optional[Array] = None,
+               deterministic: bool = True) -> Array:
+        """Encoder half, inference path (no masking): token ids → latents.
+
+        Encode once, then :meth:`decode` any number of position sets against
+        the cached latents — multi-position fill-mask and multi-task decode
+        heads pay the encoder cross-attention (all the O(L) work) once.
+        Apply with ``model.apply(vars, ids, pad, method="encode")``.
+        """
+        return self.encoder(x_input, pad_mask=pad_mask, deterministic=deterministic)
+
+    def decode(self, x_latent: Array, deterministic: bool = True,
+               positions: Optional[Array] = None,
+               return_features: bool = False) -> Array:
+        """Decoder half over cached latents: (B, K) ``positions`` → (B, K,
+        vocab) logits (None = the full max_seq_len decode — the caller
+        truncates to its input length, as ``__call__`` does internally).
+        Bit-equivalent to the fused forward's decode: queries never interact,
+        so a subset decode is exactly the corresponding rows."""
+        return self.decoder(
+            x_latent, deterministic=deterministic, positions=positions,
+            return_features=return_features,
+        )

@@ -260,14 +260,22 @@ class MultiHeadAttention(nn.Module):
             xq, wq, bq = nn.dtypes.promote_dtype(x_q, wq, bq, dtype=self.dtype)
             q = xq @ wq + bq
         elif x_q is x_kv:
-            # self-attention: one fused (C, 3E) matmul instead of three — the
-            # input is read once and the three skinny gemms become one
-            # (measured ~6% step win on the flagship MLM config, PERF.md).
-            # Identical math: each output column is an independent dot product.
-            w = jnp.concatenate([wq, wk, wv], axis=1)
-            bias = jnp.concatenate([bq, bk, bv])
+            # self-attention: one fused matmul instead of three — the input
+            # is read once and the three skinny gemms become one (measured
+            # ~6% step win on the flagship MLM config, PERF.md). Identical
+            # math: each output column is an independent dot product.
+            # The fusion stacks the weights on a FRESH leading axis, (3, C,
+            # E), rather than concatenating to (C, 3E): the three kernels
+            # are tensor-parallel-sharded over their LAST axis (PARAM_RULES
+            # (None, 'model')), and a concat along that sharded axis forces
+            # an interleaving reshard that this XLA build's SPMD partitioner
+            # miscompiles (repro'd: ~10 abs error on a 2-way model mesh; the
+            # stacked form is bitwise-identical unsharded and exact sharded).
+            w = jnp.stack([wq, wk, wv])
+            bias = jnp.stack([bq, bk, bv])
             x, w, bias = nn.dtypes.promote_dtype(x_q, w, bias, dtype=self.dtype)
-            q, k, v = jnp.split(x @ w + bias, 3, axis=-1)
+            qkv = jnp.einsum("btc,nce->btne", x, w) + bias
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         else:
             xq, wq, bq = nn.dtypes.promote_dtype(x_q, wq, bq, dtype=self.dtype)
             xkv, wk, bk = nn.dtypes.promote_dtype(x_kv, wk, bk, dtype=self.dtype)

@@ -1,4 +1,4 @@
-"""Fourier position encodings, computed once on host/at-trace as constants.
+"""Fourier position encodings, computed once on host as true constants.
 
 Matches the reference scheme (``perceiver/adapter.py:53-97``):
 
@@ -11,42 +11,52 @@ Matches the reference scheme (``perceiver/adapter.py:53-97``):
 
 Total channels: ``ndim * (2 * num_bands + include_positions)``.
 
-These are pure jnp functions; adapters precompute the encoding for one example
-and close over it as a traced constant, which XLA folds into the program (the
-analogue of the reference's ``register_buffer`` at ``adapter.py:43-51``).
+These are NUMPY functions on purpose — every call site passes static shapes,
+so the encodings are host constants the adapters close over (the analogue of
+the reference's ``register_buffer`` at ``adapter.py:43-51``). Computing them
+with jnp inside a jitted adapter stages the whole meshgrid/stack/concat
+subgraph into the program, where the SPMD partitioner reshards it when the
+consuming axis is sequence-sharded — a pattern the XLA build this runs under
+miscompiles (repro: seq-sharded image inputs came back with permuted
+encodings; the host constant is exact). f32 throughout, matching the
+previous traced-constant numerics.
 """
 
 from __future__ import annotations
 
 from typing import Optional, Sequence, Tuple
 
-import jax.numpy as jnp
+import numpy as np
 
 
 def spatial_positions(
     spatial_shape: Sequence[int], v_min: float = -1.0, v_max: float = 1.0
-) -> jnp.ndarray:
+) -> np.ndarray:
     """Evenly spaced coordinates for each point of ``spatial_shape``.
 
     Returns an array of shape ``(*spatial_shape, len(spatial_shape))`` with
     values in ``[v_min, v_max]`` (reference ``adapter.py:53-62``).
     """
-    coords = [jnp.linspace(v_min, v_max, num=s) for s in spatial_shape]
-    grid = jnp.meshgrid(*coords, indexing="ij")
-    return jnp.stack(grid, axis=-1)
+    coords = [
+        np.linspace(v_min, v_max, num=s, dtype=np.float32)
+        for s in spatial_shape
+    ]
+    grid = np.meshgrid(*coords, indexing="ij")
+    return np.stack(grid, axis=-1)
 
 
 def fourier_position_encodings(
-    p: jnp.ndarray,
+    p: np.ndarray,
     num_frequency_bands: int,
     max_frequencies: Optional[Tuple[int, ...]] = None,
     include_positions: bool = True,
-) -> jnp.ndarray:
+) -> np.ndarray:
     """Fourier-encode positions ``p`` of shape ``(*d, c)`` with c = len(d).
 
     Returns shape ``(*d, c * (2 * num_bands + include_positions))``
     (reference ``adapter.py:64-94``; feature order: positions, all sins, all cosines).
     """
+    p = np.asarray(p, dtype=np.float32)
     if max_frequencies is None:
         max_frequencies = p.shape[:-1]
     if len(max_frequencies) != p.shape[-1]:
@@ -57,15 +67,17 @@ def fourier_position_encodings(
 
     frequency_grids = []
     for i, max_freq in enumerate(max_frequencies):
-        freqs = jnp.linspace(1.0, max_freq / 2.0, num=num_frequency_bands)
+        freqs = np.linspace(
+            1.0, max_freq / 2.0, num=num_frequency_bands, dtype=np.float32
+        )
         frequency_grids.append(p[..., i : i + 1] * freqs)
 
     encodings = []
     if include_positions:
         encodings.append(p)
-    encodings.extend(jnp.sin(jnp.pi * g) for g in frequency_grids)
-    encodings.extend(jnp.cos(jnp.pi * g) for g in frequency_grids)
-    return jnp.concatenate(encodings, axis=-1)
+    encodings.extend(np.sin(np.float32(np.pi) * g) for g in frequency_grids)
+    encodings.extend(np.cos(np.float32(np.pi) * g) for g in frequency_grids)
+    return np.concatenate(encodings, axis=-1)
 
 
 def num_position_encoding_channels(

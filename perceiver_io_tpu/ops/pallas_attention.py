@@ -47,6 +47,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# the TPUCompilerParams -> CompilerParams rename landed in newer jax; alias
+# whichever spelling this build ships
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 Array = jax.Array
 
 # Finite stand-in for -inf: exp() underflows to exactly 0 against any live
@@ -262,7 +266,7 @@ def _fused_attention_fwd_impl(
             pltpu.VMEM((t_blk, _LANES), jnp.float32),  # running denominator
             pltpu.VMEM((t_blk, d), jnp.float32),  # PV accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             # batch/head/query-block grid steps are independent; only the KV
             # axis carries the softmax recurrence
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
@@ -363,7 +367,7 @@ def _fused_attention_bwd_impl(
         out_specs=qo_spec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((t_blk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -386,7 +390,7 @@ def _fused_attention_bwd_impl(
                    jax.ShapeDtypeStruct(v.shape, v.dtype)),
         scratch_shapes=[pltpu.VMEM((s_blk, d), jnp.float32),
                         pltpu.VMEM((s_blk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -634,7 +638,14 @@ def seq_parallel_fused_attention(
     Inputs may be global ``jax.Array``s (sharded or not) or host arrays; S
     must divide evenly by the axis size.
     """
-    from jax import shard_map  # jax.experimental.shard_map deprecated in 0.8
+    # jax >= 0.8 moved shard_map to the top level and renamed check_rep to
+    # check_vma; support both spellings (this build may ship either)
+    try:
+        from jax import shard_map
+        check_kw = "check_vma"
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+        check_kw = "check_rep"
     from jax.sharding import PartitionSpec as P
 
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
@@ -682,11 +693,11 @@ def seq_parallel_fused_attention(
             P(batch_axis, axis),
         ),
         out_specs=P(batch_axis, None, head_axis),
-        # disable varying-manual-axes checking (jax.shard_map's successor to
-        # the legacy check_rep) — custom_vjp + collectives confuse it. The
-        # transpose convention _sp_bwd compensates for is pinned by the
-        # gradient-parity tests; see its docstring.
-        check_vma=False,
+        # disable replication/varying-manual-axes checking (check_rep, or its
+        # jax>=0.8 successor check_vma) — custom_vjp + collectives confuse
+        # it. The transpose convention _sp_bwd compensates for is pinned by
+        # the gradient-parity tests; see its docstring.
+        **{check_kw: False},
     )(q, k, v, bias)
 
 

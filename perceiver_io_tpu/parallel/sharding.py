@@ -39,6 +39,22 @@ from perceiver_io_tpu.parallel.mesh import (
     sequence_parallel_context,
 )
 
+
+def _simple_keystr(path) -> str:
+    """``jax.tree_util.keystr(path, simple=True, separator='/')`` — inlined
+    because not every jax build this runs under has the simple/separator
+    kwargs. Produces the bare-name "/"-joined form the PARAM_RULES regexes
+    match against (``params/encoder/layer_1/.../kernel``)."""
+    parts = []
+    for entry in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(entry, attr):
+                parts.append(str(getattr(entry, attr)))
+                break
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
+
 # (path regex, spec). First match wins; default is fully replicated.
 PARAM_RULES: Sequence[Tuple[str, P]] = (
     (r"(q_proj|k_proj|v_proj)/kernel$", P(None, AXIS_MODEL)),
@@ -83,7 +99,7 @@ def sharding_for_tree(tree: Any, mesh: Mesh, rules: Sequence[Tuple[str, P]] = PA
 
     def assign(path, leaf) -> NamedSharding:
         shape = getattr(leaf, "shape", ())
-        name = jax.tree_util.keystr(path, simple=True, separator="/")
+        name = _simple_keystr(path)
         for pat, spec in compiled:
             if pat.search(name):
                 if _spec_fits(spec, shape, mesh):
@@ -183,7 +199,7 @@ def zero_state_shardings(state, mesh: Mesh, rules=PARAM_RULES,
     shardings = sharding_for_tree(state, mesh, rules)
 
     def add_data(path, leaf, sharding):
-        name = jax.tree_util.keystr(path, simple=True, separator="/")
+        name = _simple_keystr(path)
         shape = getattr(leaf, "shape", ())
         wanted = "opt_state" in name or (params_too and name.startswith("params"))
         if not wanted or len(shape) == 0:

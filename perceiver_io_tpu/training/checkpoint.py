@@ -133,11 +133,13 @@ class CheckpointManager:
                 f"monitored metric {self.monitor!r} missing from metrics "
                 f"{sorted(metrics)}"
             )
+        # item name 'val_metrics': orbax reserves 'metrics' for itself on
+        # the release this runs under (RESERVED_ITEM_NAMES)
         return self._mngr.save(
             int(step),
             args=ocp.args.Composite(
                 state=ocp.args.StandardSave(_to_save_tree(state)),
-                metrics=ocp.args.JsonSave(metrics),
+                val_metrics=ocp.args.JsonSave(metrics),
             ),
             metrics=metrics,
         )
@@ -181,8 +183,9 @@ class CheckpointManager:
         step = self._resolve(step)
         return dict(
             self._mngr.restore(
-                step, args=ocp.args.Composite(metrics=ocp.args.JsonRestore())
-            )["metrics"]
+                step,
+                args=ocp.args.Composite(val_metrics=ocp.args.JsonRestore()),
+            )["val_metrics"]
         )
 
     def _resolve(self, step: Optional[int]) -> int:
@@ -338,11 +341,16 @@ def restore_encoder_params(
 
 
 def _partial_restore(item):
-    """Restore only the leaves present in ``item`` (subtree loading)."""
+    """Restore only the leaves present in ``item`` (subtree loading).
+
+    ``transforms={}`` is the pre-``partial_restore`` spelling this orbax
+    release supports: the output takes ``item``'s structure, every key falls
+    through to the stored value, and leaves absent from ``item`` are never
+    read."""
     return ocp.args.PyTreeRestore(
         item=item,
         restore_args=ocp.checkpoint_utils.construct_restore_args(item),
-        partial_restore=True,
+        transforms={},
     )
 
 

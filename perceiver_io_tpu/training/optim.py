@@ -129,6 +129,38 @@ def _scale_by_adagrad_torch(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def _scale_by_rms_torch(
+    decay: float = 0.99, eps: float = 1e-8
+) -> optax.GradientTransformation:
+    """torch ``RMSprop``'s exact scaling: ``nu = α·nu + (1-α)·g²;
+    g / (sqrt(nu) + eps)`` — eps OUTSIDE the sqrt.
+
+    The optax spelling is ``scale_by_rms(..., eps_in_sqrt=False)``, but the
+    optax build this runs under predates that kwarg, so the torch update is
+    implemented directly. State reuses ``optax.ScaleByRmsState`` (same
+    ``nu`` param-tree mirror), so checkpoints and the ZeRO sharding rules
+    are unchanged.
+    """
+
+    def init_fn(params):
+        return optax.ScaleByRmsState(
+            nu=jax.tree.map(jnp.zeros_like, params)
+        )
+
+    def update_fn(updates, state, params=None):
+        del params
+        nu = jax.tree.map(
+            lambda g, n: decay * n + (1.0 - decay) * jnp.square(g),
+            updates, state.nu,
+        )
+        updates = jax.tree.map(
+            lambda g, n: g / (jnp.sqrt(n) + eps), updates, nu
+        )
+        return updates, optax.ScaleByRmsState(nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 class _MomentState(NamedTuple):
     count: object
     mu: object
@@ -320,7 +352,7 @@ def make_optimizer(
         # torch defaults: alpha=0.99, eps=1e-8, eps OUTSIDE the sqrt
         tx = optax.chain(
             *coupled_wd,
-            optax.scale_by_rms(decay=0.99, eps=1e-8, eps_in_sqrt=False),
+            _scale_by_rms_torch(decay=0.99, eps=1e-8),
             optax.scale_by_learning_rate(schedule),
         )
     elif name == "Adagrad":

@@ -1,0 +1,12 @@
+#!/usr/bin/env python
+"""Fill-mask serving over the micro-batching engine (``cli/serve.py``)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from perceiver_io_tpu.cli.serve import main
+
+if __name__ == "__main__":
+    main()

@@ -120,6 +120,81 @@ def test_train_mlm_then_transfer(tmp_path):
     assert max(r["step"] for r in rows) == 5
 
 
+def test_serve_cli_end_to_end(tmp_path):
+    """Train a tiny MLM, then serve it through the micro-batching engine CLI:
+    fused, latent-cache, and bf16 paths all answer, fused == cached, and the
+    JSON-line results carry per-[MASK] top-k token lists."""
+    import glob
+
+    from perceiver_io_tpu.cli import serve
+
+    run_dir = train_mlm.main(
+        _common(tmp_path, "servemlm") + [
+            "--num_latents", "4", "--num_latent_channels", "16",
+            "--num_encoder_layers", "1",
+            "--num_self_attention_layers_per_block", "1",
+            "--num_cross_attention_heads", "2",
+            "--num_self_attention_heads", "2", "--dtype", "float32",
+            "--synthetic_size", "64", "--batch_size", "16",
+            "--max_seq_len", "32", "--vocab_size", "120",
+            "--max_steps", "2", "--log_every_n_steps", "1",
+            "--num_predictions", "2",
+        ]
+    )
+    ckpt = os.path.join(run_dir, "checkpoints")
+    tok = glob.glob(str(tmp_path / "cache" / "*tokenizer*.json"))[0]
+    base = ["--checkpoint", ckpt, "--tokenizer", tok, "--max_batch", "4",
+            "--k", "3"]
+
+    fused = serve.main(
+        base + ["--bucket_widths", "16",
+                "--texts", "a [MASK] b", "no mask here"]
+    )
+    assert len(fused) == 2
+    assert len(fused[0]["fills"]) == 1 and len(fused[0]["fills"][0]) == 3
+    assert fused[1]["fills"] == []
+
+    cached = serve.main(
+        base + ["--cached", "--no_warmup", "--texts", "a [MASK] b"]
+    )
+    assert cached[0]["fills"] == fused[0]["fills"]
+
+    bf16 = serve.main(
+        base + ["--dtype", "bfloat16", "--no_warmup",
+                "--texts", "a [MASK] b"]
+    )
+    assert len(bf16[0]["fills"][0]) == 3  # bf16 rounds: presence, not parity
+
+    with pytest.raises(SystemExit, match="nothing to serve"):
+        serve.main(base)
+
+
+def test_inference_bench_engine_cpu_emits_one_json_line(tmp_path):
+    """tools/inference_bench.py --engine --cpu runs the full serving-engine
+    A/B offline and emits EXACTLY one JSON line on stdout (the driver's
+    inference-trajectory contract)."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "inference_bench.py"),
+         "--engine", "--cpu", "--preset", "tiny",
+         "--requests", "8", "--rounds", "1"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    result = json.loads(lines[0])
+    assert result["mode"] == "engine" and result["backend"] == "cpu"
+    for key in ("naive_requests_per_s", "engine_requests_per_s", "speedup",
+                "engine_tokens_per_s"):
+        assert key in result, result
+    assert any(k.startswith("bucket") and k.endswith("p50_ms")
+               for k in result), result
+
+
 def test_encode_masked_samples(tmp_path):
     from perceiver_io_tpu.data.imdb import IMDBDataModule
 
@@ -213,6 +288,13 @@ def test_all_parsers_build_and_render_help():
         for flag in ("--dp", "--tp", "--sp", "--zero", "--multihost",
                      "--resume", "--attn_impl", "--dtype"):
             assert flag in help_text, f"{mod.__name__} missing {flag}"
+
+    from perceiver_io_tpu.cli import serve
+
+    help_text = serve.build_parser().format_help()
+    for flag in ("--checkpoint", "--tokenizer", "--bucket_widths", "--dtype",
+                 "--cached", "--max_delay_ms"):
+        assert flag in help_text, f"serve missing {flag}"
 
 
 def test_mlm_preset_flagship_tpu_defaults():

@@ -29,10 +29,22 @@ already fetch their numpy results, which is the same honest sync.
 Prints a human table and ONE final JSON summary line on stdout (this is a
 tools/ bench — bench.py's one-line stdout contract is untouched).
 
+``--engine`` (VERDICT r5 weak #5/#6 closed): the SERVING-ENGINE bench — an
+interleaved same-process A/B of the continuous micro-batcher
+(``inference/engine.py``) against naive per-request ``Predictor`` dispatch on
+a batch-1 request stream, plus request-latency percentiles per batch bucket
+and (on TPU) per-micro-batch device-trace percentiles. Emits exactly ONE
+JSON line on stdout (human progress goes to stderr) so the driver can track
+an inference trajectory alongside ``bench.py``. ``--cpu`` pins the run to
+the CPU backend via ``ensure_cpu_only()`` BEFORE jax initializes — tier-1
+exercises the full path offline with ``--preset tiny``.
+
 Usage::
 
     timeout 1800 python tools/inference_bench.py [--trace-dir DIR]
                                                  [--dtype float32|bfloat16]
+    timeout 1800 python tools/inference_bench.py --engine [--cpu]
+        [--preset auto|tiny|flagship] [--requests N] [--rounds R]
 """
 
 from __future__ import annotations
@@ -47,13 +59,16 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
+# NOTE: jax is imported inside main() AFTER --cpu is handled —
+# utils.platform.ensure_cpu_only must run before any backend initializes.
 import numpy as np
 
 
 def _consume(out) -> None:
     """Honest completion: a scalar slice of each output leaf is computed
     on-device (dependent on the full result) and fetched to the host."""
+    import jax
+
     for leaf in jax.tree_util.tree_leaves(out):
         idx = (0,) * getattr(leaf, "ndim", 0)
         np.asarray(leaf[idx] if idx else leaf)
@@ -78,6 +93,8 @@ def _device_per_call(fn, trace_dir: str, calls: int = 12):
     StepTraceAnnotation so the xplane Steps line carries per-call windows.
     Returns None off-TPU or when the trace has no device plane — the host
     medians still stand on their own."""
+    import jax
+
     from perceiver_io_tpu.utils import xplane
 
     fn()  # compiled before tracing
@@ -101,6 +118,7 @@ def _ms(sec) -> str:
 def _build_predictor(dtype_name: str):
     """Flagship-shaped MLM + a real first-party tokenizer over a synthetic
     Zipf corpus (zero-egress environment: no downloads)."""
+    import jax
     import jax.numpy as jnp
 
     from perceiver_io_tpu.data.tokenizer import (
@@ -144,6 +162,174 @@ def _build_predictor(dtype_name: str):
     return predictor, texts, model, variables["params"], vocab, max_seq_len
 
 
+def _build_engine_model(tiny: bool, dtype_name: str):
+    """Model + tokenizer for the engine A/B: flagship-shaped on TPU, a
+    scaled-down twin for the CPU (tier-1) run — same code path, minutes not
+    hours."""
+    import jax
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.data.tokenizer import create_tokenizer, train_tokenizer
+    from perceiver_io_tpu.models.presets import flagship_mlm
+
+    rng = np.random.default_rng(0)
+    n_words, vocab_target, doc_words, docs = (
+        (800, 503, 40, 200) if tiny else (16000, 10003, 150, 1200)
+    )
+    words = [f"w{i}" for i in range(n_words)]
+    probs = 1.0 / np.arange(1, len(words) + 1)
+    probs /= probs.sum()
+    corpus = [
+        " ".join(rng.choice(words, size=doc_words, p=probs))
+        for _ in range(docs)
+    ]
+    tokenizer = create_tokenizer()
+    train_tokenizer(tokenizer, corpus, vocab_size=vocab_target)
+    max_seq_len = 64 if tiny else 512
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
+    model = flagship_mlm(
+        vocab_size=tokenizer.get_vocab_size(), max_seq_len=max_seq_len,
+        num_latents=16 if tiny else 256, num_channels=32 if tiny else 64,
+        num_layers=2 if tiny else 3,
+        num_self_attention_layers_per_block=1 if tiny else 6,
+        dtype=dtype, attn_impl="auto",
+    )
+    ids = np.zeros((1, max_seq_len), np.int32)
+    variables = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        ids, ids == 0,
+    )
+    return model, variables["params"], tokenizer, max_seq_len
+
+
+def _percentiles(values) -> dict:
+    v = sorted(values)
+    pick = lambda q: v[min(len(v) - 1, int(q * len(v)))]
+    return {"p50_ms": round(pick(0.50) * 1e3, 3),
+            "p95_ms": round(pick(0.95) * 1e3, 3)}
+
+
+def _engine_mode(args) -> None:
+    """Interleaved engine-vs-naive A/B on a batch-1 request stream.
+
+    Both arms run the identical gathered serving forward; the engine's only
+    edge is what it claims — coalescing the stream into bucketed
+    micro-batches with pipelined dispatch. Same process, alternating rounds
+    (the tunnel's ±2x session swing cancels; PERF.md discipline)."""
+    import jax
+
+    from perceiver_io_tpu.inference import Predictor, ServingEngine
+    from perceiver_io_tpu.inference.mlm import encode_masked_texts
+
+    log = lambda *a: print(*a, file=sys.stderr)
+    backend = jax.default_backend()
+    tiny = args.preset == "tiny" or (args.preset == "auto" and backend != "tpu")
+    log(f"backend: {backend}; preset {'tiny' if tiny else 'flagship'}; "
+        f"dtype {args.dtype}; {args.requests} requests x {args.rounds} rounds")
+    model, params, tokenizer, max_seq_len = _build_engine_model(
+        tiny, args.dtype
+    )
+
+    # batch-1 request stream: every text carries two [MASK] slots (the
+    # fill-mask serving shape), identical signature so the A/B isolates
+    # batching — width bucketing has its own tests/bench
+    texts = [
+        f"the {tokenizer.id_to_token(10 + (i % 40))} movie was [MASK] and "
+        f"felt [MASK] overall" for i in range(args.requests)
+    ]
+    ids, pad = encode_masked_texts(tokenizer, texts, max_seq_len)
+    positions = np.zeros((len(texts), 2), np.int32)
+    mask_id = tokenizer.token_to_id("[MASK]")
+    for i in range(len(texts)):
+        positions[i] = np.nonzero(ids[i] == mask_id)[0][:2]
+    requests = [
+        (ids[i: i + 1], pad[i: i + 1], positions[i: i + 1])
+        for i in range(len(texts))
+    ]
+
+    def gathered_apply(p, token_ids, pad_mask, pos):
+        logits, _ = model.apply(
+            {"params": p}, token_ids, pad_mask, masking=False,
+            deterministic=True, positions=pos,
+        )
+        return logits
+
+    naive = Predictor(gathered_apply, params, max_batch=args.max_batch)
+    engine = ServingEngine(
+        gathered_apply, params, max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms, name="engine_bench",
+        compute_dtype="bfloat16" if args.dtype == "bfloat16" else None,
+    )
+    # both arms compile everything they will use before any timing
+    engine.warmup(*requests[0])
+    naive(*requests[0])
+    log(f"warmed {engine.num_programs} engine bucket programs")
+
+    def naive_round() -> float:
+        t0 = time.perf_counter()
+        for r in requests:
+            naive(*r)
+        return time.perf_counter() - t0
+
+    def engine_round() -> float:
+        t0 = time.perf_counter()
+        futures = [engine.submit(*r) for r in requests]
+        for f in futures:
+            f.result()
+        return time.perf_counter() - t0
+
+    naive_round()  # one unmeasured round each: steady-state caches
+    engine_round()
+    naive_s, engine_s = [], []
+    for r in range(args.rounds):  # interleaved: A, B, A, B ...
+        naive_s.append(naive_round())
+        engine_s.append(engine_round())
+        log(f"round {r}: naive {naive_s[-1]:.3f}s engine {engine_s[-1]:.3f}s")
+    n_med, e_med = statistics.median(naive_s), statistics.median(engine_s)
+
+    n = args.requests
+    results = {
+        "mode": "engine", "backend": backend, "dtype": args.dtype,
+        "preset": "tiny" if tiny else "flagship",
+        "requests": n, "rounds": args.rounds,
+        "max_batch": args.max_batch, "seq_len": max_seq_len,
+        "naive_requests_per_s": round(n / n_med, 2),
+        "engine_requests_per_s": round(n / e_med, 2),
+        "engine_tokens_per_s": round(n * max_seq_len / e_med, 1),
+        "speedup": round(n_med / e_med, 3),
+        "batches": engine.stats["batches"],
+        "mean_rows_per_batch": round(
+            engine.stats["rows"] / max(engine.stats["batches"], 1), 2),
+    }
+    for bucket, lats in sorted(
+        engine.stats["latency_s_by_bucket"].items()
+    ):
+        for k, v in _percentiles(lats).items():
+            results[f"bucket{bucket}_{k}"] = v
+
+    # device-trace per-micro-batch percentiles (TPU): the tunnel-insensitive
+    # latency statistic — each engine dispatch is a StepTraceAnnotation step
+    if backend == "tpu":
+        try:
+            from perceiver_io_tpu.utils import xplane
+
+            trace_dir = args.trace_dir or tempfile.mkdtemp(
+                prefix="engine_bench_")
+            with jax.profiler.trace(trace_dir):
+                engine_round()
+            windows = xplane.step_windows(xplane.load_tpu_plane(trace_dir))
+            durations = [(b - a) / 1e12 for a, b in windows]
+            if durations:
+                for k, v in _percentiles(durations).items():
+                    results[f"device_batch_{k}"] = v
+        except Exception as e:
+            log(f"(device trace unavailable: {type(e).__name__}: "
+                f"{str(e)[:80]})")
+
+    engine.close()
+    print(json.dumps(results))
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--trace-dir", default=None,
@@ -152,7 +338,35 @@ def main() -> None:
                         choices=["float32", "bfloat16"],
                         help="serving dtype (float32 = the from_checkpoint "
                              "golden-parity default)")
+    parser.add_argument("--engine", action="store_true",
+                        help="serving-engine A/B mode: ONE JSON line on "
+                             "stdout, progress on stderr")
+    parser.add_argument("--cpu", action="store_true",
+                        help="pin to the CPU backend (ensure_cpu_only before "
+                             "jax initializes) — the offline/tier-1 mode")
+    parser.add_argument("--preset", choices=["auto", "tiny", "flagship"],
+                        default="auto",
+                        help="engine-mode model size: auto = flagship on "
+                             "TPU, tiny elsewhere")
+    parser.add_argument("--requests", type=int, default=64,
+                        help="engine mode: batch-1 requests per round")
+    parser.add_argument("--rounds", type=int, default=4,
+                        help="engine mode: interleaved A/B rounds")
+    parser.add_argument("--max_batch", type=int, default=32,
+                        help="engine mode: micro-batch cap")
+    parser.add_argument("--max_delay_ms", type=float, default=0.0,
+                        help="engine mode: batch-formation hold")
     args = parser.parse_args()
+
+    if args.cpu:
+        from perceiver_io_tpu.utils.platform import ensure_cpu_only
+
+        ensure_cpu_only()
+    import jax
+
+    if args.engine:
+        _engine_mode(args)
+        return
 
     backend = jax.default_backend()
     print(f"backend: {backend}; dtype {args.dtype}")
