@@ -83,6 +83,15 @@ def add_trainer_args(parser: argparse.ArgumentParser) -> None:
                    help="lax.scan N optimizer steps per device dispatch — "
                         "amortizes per-call latency on remote/tunneled "
                         "accelerators (PERF.md)")
+    g.add_argument("--selfprofile_every_n_steps", type=int, default=0,
+                   help="in-loop device-trace watchdog: every N optimizer "
+                        "steps capture a short jax.profiler trace, analyze "
+                        "it in-process (utils/xplane.py lower quartile), and "
+                        "log device/host step time + MFU + compile count as "
+                        "registry gauges and metrics.jsonl rows (PERF.md "
+                        "§Observability). 0 disables")
+    g.add_argument("--selfprofile_steps", type=int, default=4,
+                   help="dispatches per watchdog capture window")
     g.add_argument("--debug_nans", action="store_true",
                    help="NaN localization (sanitizer): enable jax_debug_nans "
                         "so the first dispatch producing NaN/Inf re-runs "
@@ -254,6 +263,9 @@ def trainer_config(args) -> TrainerConfig:
         profile_steps=args.profile_steps,
         steps_per_dispatch=getattr(args, "steps_per_dispatch", 1),
         debug_nans=getattr(args, "debug_nans", False),
+        selfprofile_every_n_steps=getattr(
+            args, "selfprofile_every_n_steps", 0),
+        selfprofile_steps=getattr(args, "selfprofile_steps", 4),
     )
 
 

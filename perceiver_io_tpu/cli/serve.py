@@ -19,6 +19,12 @@ Usage::
 ``--cached`` serves through the encode-once/decode-many latent-cache path
 instead of the fused forward — same results (parity-tested), useful to smoke
 the split pipeline a multi-query deployment would run.
+
+``--metrics_port`` starts the localhost observability sidecar
+(``/metrics`` Prometheus text, ``/healthz``, ``/statz`` JSON snapshot);
+``--heartbeat_deadline_s`` arms the wedged-tunnel dispatch heartbeat;
+``--selfprofile_every`` turns on the in-loop device-trace watchdog. All
+telemetry output rides stderr/HTTP — stdout stays one JSON line per text.
 """
 
 from __future__ import annotations
@@ -68,6 +74,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "requests then pay the compiles)")
     g.add_argument("--stats", action="store_true",
                    help="print engine stats to stderr on exit")
+    o = parser.add_argument_group("observability")
+    o.add_argument("--metrics_port", type=int, default=None,
+                   help="start the localhost observability sidecar on this "
+                        "port (/metrics Prometheus text, /healthz, /statz "
+                        "JSON); 0 picks an ephemeral port — the bound port "
+                        "is printed to stderr. Default: off")
+    o.add_argument("--heartbeat_deadline_s", type=float, default=None,
+                   help="dispatch heartbeat deadline: if no dispatch "
+                        "completes within this many seconds while work is in "
+                        "flight (wedged tunnel), /healthz flips unhealthy and "
+                        "a thread-stack diagnostic is dumped to stderr. "
+                        "Default: off")
+    o.add_argument("--selfprofile_every", type=int, default=0,
+                   help="in-loop device-trace watchdog: every N micro-batches "
+                        "capture a short jax.profiler trace, analyze it "
+                        "in-process, and publish device-clock step time "
+                        "gauges. Default: off")
+    o.add_argument("--events_jsonl", default=None,
+                   help="append runtime events (compiles, warmups, stalls) "
+                        "as JSON lines to this file")
+    parser.add_argument("--cpu", action="store_true",
+                        help="pin to the CPU backend (ensure_cpu_only before "
+                             "jax initializes) — the offline/tier-1 mode")
     return parser
 
 
@@ -76,9 +105,41 @@ def main(argv: Optional[Sequence[str]] = None):
     if not args.texts and not args.stdin:  # catches omitted AND empty --texts
         raise SystemExit("nothing to serve: pass --texts ... or --stdin")
 
+    if args.cpu:
+        from perceiver_io_tpu.utils.platform import ensure_cpu_only
+
+        ensure_cpu_only()
+
+    import perceiver_io_tpu.obs as obs
     from perceiver_io_tpu.data.tokenizer import load_tokenizer
     from perceiver_io_tpu.inference import MLMServer, load_mlm_checkpoint
 
+    if args.events_jsonl:
+        obs.configure_event_log(args.events_jsonl)
+    obs_server = None
+    if args.metrics_port is not None:
+        # started BEFORE the checkpoint load / warmup so probes can watch a
+        # slow bring-up; counters stay zero until requests arrive. stdout is
+        # the result stream — the sidecar address goes to stderr.
+        obs_server = obs.ObsServer(port=args.metrics_port)
+        url = obs_server.start()
+        if url is not None:
+            print(f"serve: metrics on {url}/metrics (also /healthz /statz)",
+                  file=sys.stderr, flush=True)
+
+    try:
+        return _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint)
+    finally:
+        # an exception mid-serve must not leak the sidecar thread or leave
+        # the process-global event log bound to this run's file (serve.main
+        # is also called in-process by tests/other tools)
+        if obs_server is not None:
+            obs_server.close()
+        if args.events_jsonl:
+            obs.configure_event_log(None)
+
+
+def _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint):
     tokenizer = load_tokenizer(args.tokenizer)
     model, params, max_seq_len = load_mlm_checkpoint(
         args.checkpoint, tokenizer, step=args.step,
@@ -92,6 +153,8 @@ def main(argv: Optional[Sequence[str]] = None):
         max_batch=args.max_batch,
         max_delay_ms=args.max_delay_ms,
         compute_dtype="bfloat16" if args.dtype == "bfloat16" else None,
+        heartbeat_deadline_s=args.heartbeat_deadline_s,
+        selfprofile_every=args.selfprofile_every,
     ) as server:
         if not args.no_warmup:
             n = server.warmup()

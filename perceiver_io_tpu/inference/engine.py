@@ -52,6 +52,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+import perceiver_io_tpu.obs as obs
 from perceiver_io_tpu.inference.predictor import bucket_size
 
 _IDLE_POLL_S = 0.05  # worker wake-up cadence while idle (checks shutdown)
@@ -151,6 +152,18 @@ class ServingEngine:
       — the bf16 serving path; leave None on the f32 parity path;
     - on TPU, input buffers are donated to XLA (ping-pong staging).
 
+    Telemetry: every engine publishes ``serving_*`` instruments (labeled
+    ``engine=<name>``) to the metrics registry — request/row/batch/padding
+    counters, queue-depth and in-flight gauges, admission→dispatch wait and
+    per-bucket latency histograms, compile events. ``heartbeat_deadline_s``
+    arms a dispatch heartbeat: if no dispatch completes within the deadline
+    while work is in flight (the wedged-tunnel signature), ``/healthz`` flips
+    unhealthy and a diagnostic snapshot (thread stacks + queue state) is
+    dumped instead of the loop hanging silently. ``selfprofile_every`` > 0
+    turns on the in-loop device-trace watchdog every that-many micro-batches.
+    ``stats()`` remains as a locked, deep-copied per-instance snapshot (the
+    registry is the cross-engine aggregate).
+
     ``apply_fn`` must treat examples independently along the leading axis
     (true of every model here) and be deterministic (dropout off).
     """
@@ -165,6 +178,9 @@ class ServingEngine:
         compute_dtype: Optional[str] = None,
         donate_inputs: Optional[bool] = None,
         name: str = "serve",
+        registry: Optional[obs.MetricsRegistry] = None,
+        heartbeat_deadline_s: Optional[float] = None,
+        selfprofile_every: int = 0,
     ):
         import jax
         import jax.numpy as jnp
@@ -209,10 +225,58 @@ class ServingEngine:
         # the oldest pending part per key (FIFO across keys)
         self._pending: Dict[Any, deque] = {}
         self._programs: set = set()  # (key, bucket) pairs ever dispatched
-        self.stats: Dict[str, Any] = {
+
+        # per-instance stats live behind ONE lock (they are written from the
+        # submit/caller threads AND the worker); stats() deep-copies under it
+        self._stats_lock = threading.Lock()
+        self._stats: Dict[str, Any] = {
             "requests": 0, "rows": 0, "batches": 0, "padded_rows": 0,
             "latency_s_by_bucket": {},
         }
+        self._dispatch_seq = 0  # StepTraceAnnotation ids (under _stats_lock)
+        self._inflight_count = 0  # worker-written, racily read by diagnostics
+
+        self.registry = registry if registry is not None else obs.get_registry()
+        labels = {"engine": name}
+        reg = self.registry
+        self._m_requests = reg.counter(
+            "serving_requests_total", "requests submitted", labels)
+        self._m_rows = reg.counter(
+            "serving_rows_total", "request rows served", labels)
+        self._m_batches = reg.counter(
+            "serving_batches_total", "micro-batches dispatched", labels)
+        self._m_padded = reg.counter(
+            "serving_padded_rows_total",
+            "padded filler rows (bucket waste)", labels)
+        self._m_compiles = reg.counter(
+            "serving_compile_events_total",
+            "new (signature, batch-bucket) programs entered (each is one XLA "
+            "compile unless warmed)", labels)
+        self._m_queue = reg.gauge(
+            "serving_queue_depth", "parts awaiting batch formation", labels)
+        self._m_inflight = reg.gauge(
+            "serving_inflight_dispatches", "dispatches in flight", labels)
+        self._m_programs = reg.gauge(
+            "serving_programs", "distinct compiled programs", labels)
+        self._m_occupancy = reg.histogram(
+            "serving_batch_occupancy",
+            "real rows / bucket rows per micro-batch (1.0 = no padding)",
+            labels)
+        self._m_wait = reg.histogram(
+            "serving_admission_wait_seconds",
+            "submit → dispatch wait per request part", labels)
+        self._latency_hists: Dict[int, obs.Histogram] = {}
+
+        self.heartbeat = obs.Heartbeat(
+            f"{name}-dispatch", deadline_s=heartbeat_deadline_s,
+            diagnostics=self._diagnostics,
+        )
+        self._profiler: Optional[obs.SelfProfiler] = None
+        if selfprofile_every > 0:
+            self._profiler = obs.SelfProfiler(
+                every_n=selfprofile_every, prefix=name, registry=reg
+            )
+
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name=f"{name}-engine", daemon=True
@@ -239,10 +303,13 @@ class ServingEngine:
             return fut
         starts = list(range(0, n, self.max_batch))
         fut = _Future(len(starts), transform)
-        self.stats["requests"] += 1
+        with self._stats_lock:
+            self._stats["requests"] += 1
+        self._m_requests.inc()
         for index, start in enumerate(starts):
             chunk = [a[start: start + self.max_batch] for a in arrays]
             self._queue.put(_Part(chunk, self._key(chunk), fut, index))
+        self._m_queue.set(self._queue.qsize())
         if self._stop.is_set() and not self._thread.is_alive():
             # raced a shutdown/worker-crash: the drain already ran, so these
             # parts would sit unread forever — fail the future ourselves
@@ -298,12 +365,31 @@ class ServingEngine:
             )
             out = self._execute(cols, b, key)
             jax.block_until_ready(out)
+        obs.event("serving_warmup", engine=self.name, buckets=list(buckets))
         return list(buckets)
 
     # -- worker --------------------------------------------------------------
 
     def _run(self) -> None:
         inflight: deque = deque()  # ((device_out, bucket), parts)
+
+        def _sync_inflight() -> None:
+            # watchdog window close: the trace must not stop while dispatches
+            # are still executing — truncated trailing step windows would
+            # bias the lower-quartile device number low
+            import jax
+
+            for (out, _bucket), _parts in list(inflight):
+                jax.block_until_ready(out)
+
+        def _note_inflight() -> None:
+            self._inflight_count = len(inflight)
+            self._m_inflight.set(len(inflight))
+            if inflight:
+                self.heartbeat.arm()
+            else:
+                self.heartbeat.disarm()
+
         try:
             while True:
                 parts = None
@@ -313,14 +399,22 @@ class ServingEngine:
                     # window
                     parts = self._next_batch(0.0 if inflight else _IDLE_POLL_S)
                 if parts is not None:
+                    # armed BEFORE the dispatch call: a wedged tunnel can
+                    # hang the dispatch itself, not just the completion
+                    self.heartbeat.arm()
                     try:
                         inflight.append((self._dispatch(parts), parts))
                     except BaseException as e:  # bad batch: fail it, live on
                         for p in parts:
                             p.future._fail(e)
+                    _note_inflight()
+                    if self._profiler is not None:
+                        self._profiler.tick(sync=_sync_inflight)
                     continue
                 if inflight:
                     self._complete(*inflight.popleft())
+                    self.heartbeat.beat()
+                    _note_inflight()
                     continue
                 if (self._stop.is_set() and self._queue.empty()
                         and not self._pending):
@@ -330,6 +424,9 @@ class ServingEngine:
             # blocked in result() with no timeout would hang forever. Fail
             # everything queued/pending/in flight, then stop accepting.
             self._stop.set()
+            self.heartbeat.disarm()
+            obs.event("engine_worker_crash", engine=self.name,
+                      error=type(e).__name__)
             for _, parts in inflight:
                 for p in parts:
                     p.future._fail(e)
@@ -403,9 +500,20 @@ class ServingEngine:
     def _execute(self, cols: Tuple[np.ndarray, ...], bucket: int, key):
         import jax
 
-        self._programs.add((key, bucket))
+        program = (key, bucket)
+        with self._stats_lock:  # warmup (caller thread) races the worker
+            is_new = program not in self._programs
+            if is_new:
+                self._programs.add(program)
+            self._dispatch_seq += 1
+            step_num = self._dispatch_seq
+        if is_new:
+            self._m_compiles.inc()
+            self._m_programs.set(len(self._programs))
+            obs.event("serving_compile", engine=self.name, bucket=bucket,
+                      programs=len(self._programs))
         with jax.profiler.StepTraceAnnotation(
-            self.name, step_num=self.stats["batches"]
+            self.name, step_num=step_num
         ):
             return self._jitted(self.params, cols)
 
@@ -425,11 +533,31 @@ class ServingEngine:
                     axis=0,
                 )
             cols.append(self._cast(np.ascontiguousarray(col)))
+        now = time.monotonic()
+        for p in parts:
+            self._m_wait.observe(now - p.t_submit)
         out = self._execute(tuple(cols), bucket, parts[0].key)
-        self.stats["batches"] += 1
-        self.stats["rows"] += n
-        self.stats["padded_rows"] += bucket - n
+        with self._stats_lock:
+            self._stats["batches"] += 1
+            self._stats["rows"] += n
+            self._stats["padded_rows"] += bucket - n
+        self._m_batches.inc()
+        self._m_rows.inc(n)
+        self._m_padded.inc(bucket - n)
+        self._m_occupancy.observe(n / bucket)
+        self._m_queue.set(self._queue.qsize())
         return out, bucket
+
+    def _latency_hist(self, bucket: int) -> obs.Histogram:
+        hist = self._latency_hists.get(bucket)
+        if hist is None:
+            hist = self.registry.histogram(
+                "serving_latency_seconds",
+                "submit → result latency by batch bucket",
+                {"engine": self.name, "bucket": str(bucket)},
+            )
+            self._latency_hists[bucket] = hist
+        return hist
 
     def _complete(self, out_bucket, parts: List[_Part]) -> None:
         import jax
@@ -442,20 +570,25 @@ class ServingEngine:
                 p.future._fail(e)
             return
         now = time.monotonic()
-        # bounded: an engine serves indefinitely — unbounded per-request
-        # float lists would grow without limit; the window is plenty for
-        # p50/p95 reporting
-        lat = self.stats["latency_s_by_bucket"].setdefault(
-            bucket, deque(maxlen=4096)
-        )
+        hist = self._latency_hist(bucket)
+        latencies = []
         offset = 0
         for p in parts:
             o = offset
             p.future._deliver(
                 p.index, jax.tree.map(lambda a: a[o: o + p.n], host)
             )
-            lat.append(now - p.t_submit)
+            latencies.append(now - p.t_submit)
+            hist.observe(latencies[-1])
             offset += p.n
+        with self._stats_lock:
+            # bounded: an engine serves indefinitely — unbounded per-request
+            # float lists would grow without limit; the window is plenty for
+            # p50/p95 reporting
+            lat = self._stats["latency_s_by_bucket"].setdefault(
+                bucket, deque(maxlen=4096)
+            )
+            lat.extend(latencies)
 
     # -- introspection / lifecycle -------------------------------------------
 
@@ -464,10 +597,46 @@ class ServingEngine:
         """Distinct (signature, batch-bucket) programs dispatched or warmed."""
         return len(self._programs)
 
+    def stats(self) -> Dict[str, Any]:
+        """Locked, deep-copied snapshot of this instance's counters.
+
+        The compatibility surface over the registry instruments (which
+        aggregate across engines sharing a name): mutating the returned dict
+        or its latency lists never touches live state, and the read is
+        consistent (taken under the same lock every writer holds).
+        """
+        with self._stats_lock:
+            snap: Dict[str, Any] = {
+                k: v for k, v in self._stats.items()
+                if k != "latency_s_by_bucket"
+            }
+            snap["latency_s_by_bucket"] = {
+                b: list(d)
+                for b, d in self._stats["latency_s_by_bucket"].items()
+            }
+        return snap
+
+    def _diagnostics(self) -> Dict[str, Any]:
+        """Heartbeat-stall snapshot: queue/in-flight state + last-known
+        counters (runs on the monitor thread — reads are racy by design;
+        a wedged worker cannot be asked to cooperate)."""
+        snap = self.stats()
+        snap.pop("latency_s_by_bucket", None)
+        return {
+            "queue_parts": self._queue.qsize(),
+            "pending_keys": len(self._pending),
+            "inflight": self._inflight_count,
+            "programs": len(self._programs),
+            "stats": snap,
+        }
+
     def close(self, timeout: Optional[float] = None) -> None:
         """Stop accepting requests, drain everything queued, join the worker."""
         self._stop.set()
         self._thread.join(timeout)
+        self.heartbeat.close()
+        if self._profiler is not None:
+            self._profiler.close()
         # a submit() racing close() can slip a part in after the worker
         # exits — fail it rather than leave its future hanging
         while True:
@@ -523,6 +692,9 @@ class MLMServer:
         max_delay_ms: float = 0.0,
         max_inflight: int = 2,
         compute_dtype: Optional[str] = None,
+        registry: Optional[obs.MetricsRegistry] = None,
+        heartbeat_deadline_s: Optional[float] = None,
+        selfprofile_every: int = 0,
     ):
         import jax
 
@@ -581,6 +753,8 @@ class MLMServer:
         common = dict(
             max_batch=max_batch, max_delay_ms=max_delay_ms,
             max_inflight=max_inflight, compute_dtype=compute_dtype,
+            registry=registry, heartbeat_deadline_s=heartbeat_deadline_s,
+            selfprofile_every=selfprofile_every,
         )
         # fused single-pass path (one-shot requests) + the split pair
         # (latent-cache workloads); each engine owns one program family
@@ -591,6 +765,27 @@ class MLMServer:
         self.decoder = ServingEngine(
             decode_apply, params, name="mlm_dec", **common
         )
+
+        # latent-cache accounting: a "hit" is a fill-mask answered from
+        # cached latents (no encoder work), a "miss" is the fused path
+        reg = registry if registry is not None else obs.get_registry()
+        self._m_fused = reg.counter(
+            "mlm_fill_mask_requests_total", "fill-mask requests by path",
+            {"path": "fused"})
+        self._m_cached = reg.counter(
+            "mlm_fill_mask_requests_total", "fill-mask requests by path",
+            {"path": "cached"})
+        self._m_encoded = reg.counter(
+            "mlm_cache_encodes_total", "texts encoded into the latent cache")
+        self._m_hit_rate = reg.gauge(
+            "mlm_latent_cache_hit_rate",
+            "cached fill-masks / all fill-masks (encode-once pay-off)")
+
+    def _note_fill(self, cached: bool) -> None:
+        (self._m_cached if cached else self._m_fused).inc()
+        total = self._m_cached.value + self._m_fused.value
+        if total:
+            self._m_hit_rate.set(self._m_cached.value / total)
 
     # -- request preparation -------------------------------------------------
 
@@ -638,6 +833,7 @@ class MLMServer:
     def submit(self, text: str, k: int = 5) -> _Future:
         """Enqueue one fill-mask request; ``result()`` is the per-``[MASK]``
         top-k token lists (``MLMPredictor.fill_masks`` row semantics)."""
+        self._note_fill(cached=False)
         ids, pad, mask_pos = self._prepare(text)
         if len(mask_pos) == 0:  # nothing to decode: complete without device
             fut = _Future(1, None)
@@ -661,6 +857,7 @@ class MLMServer:
         """Run the encoder half once per text (width-bucketed, micro-batched)
         and cache the latents; the O(L) work never repeats across decodes."""
         prepared = [self._prepare(t) for t in texts]
+        self._m_encoded.inc(len(prepared))
         futures = [
             self.encoder.submit(ids, pad) for ids, pad, _ in prepared
         ]
@@ -689,6 +886,7 @@ class MLMServer:
         encoder work at all."""
         futures = []
         for row in range(len(cached)):
+            self._note_fill(cached=True)
             mask_pos = cached.mask_positions[row]
             if len(mask_pos) == 0:
                 fut = _Future(1, None)
@@ -735,10 +933,12 @@ class MLMServer:
         return warmed
 
     def stats(self) -> Dict[str, Any]:
+        """Locked, deep-copied snapshot across the three engines (the
+        compatibility shim over the registry instruments)."""
         return {
-            "fused": dict(self.engine.stats),
-            "encode": dict(self.encoder.stats),
-            "decode": dict(self.decoder.stats),
+            "fused": self.engine.stats(),
+            "encode": self.encoder.stats(),
+            "decode": self.decoder.stats(),
             "programs": (self.engine.num_programs
                          + self.encoder.num_programs
                          + self.decoder.num_programs),

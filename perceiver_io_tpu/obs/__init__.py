@@ -1,0 +1,61 @@
+"""Unified runtime telemetry for the framework.
+
+One subsystem, four pieces, every layer wired through it:
+
+- :mod:`registry` — the process-wide thread-safe metrics registry (counters,
+  gauges, bounded histograms with p50/p95/p99); the single source of truth
+  the serving engine, the Trainer/``MetricsLogger``, and the watchdog all
+  publish to.
+- :mod:`tracing` — span/event tracing to JSONL (compiles, warmups, stalls).
+- :mod:`health` — dispatch heartbeats with stall detection + diagnostic
+  thread-stack dumps, aggregated by ``healthz()``.
+- :mod:`watchdog` — the in-loop self-profiler: periodic short device traces
+  analyzed in-process (``utils/xplane.py`` lower-quartile discipline) into
+  live device-step-time / MFU / recompile gauges.
+- :mod:`http` — the localhost sidecar serving ``/metrics`` (Prometheus text),
+  ``/healthz``, and ``/statz``.
+
+Importing this package never initializes a jax backend — entry points stay
+free to pick their platform (``ensure_cpu_only``) first.
+"""
+
+from perceiver_io_tpu.obs.health import Heartbeat, healthz, thread_stacks
+from perceiver_io_tpu.obs.http import ObsServer
+from perceiver_io_tpu.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    is_export_process,
+    sanitize_metric_name,
+)
+from perceiver_io_tpu.obs.tracing import (
+    EventLog,
+    configure_event_log,
+    event,
+    get_event_log,
+    span,
+)
+from perceiver_io_tpu.obs.watchdog import SelfProfiler, install_compile_counter
+
+__all__ = [
+    "Counter",
+    "EventLog",
+    "Gauge",
+    "Heartbeat",
+    "Histogram",
+    "MetricsRegistry",
+    "ObsServer",
+    "SelfProfiler",
+    "configure_event_log",
+    "event",
+    "get_event_log",
+    "get_registry",
+    "healthz",
+    "install_compile_counter",
+    "is_export_process",
+    "sanitize_metric_name",
+    "span",
+    "thread_stacks",
+]

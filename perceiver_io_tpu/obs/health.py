@@ -1,0 +1,182 @@
+"""Dispatch heartbeats and process health.
+
+The axon-tunneled PJRT backend can wedge (CLAUDE.md): a device call simply
+never returns, and a serving loop built on blocking futures hangs silently.
+A ``Heartbeat`` turns that failure mode into a *signal*: the dispatch loop
+arms it when work goes in flight and beats it on every completion; if no beat
+arrives within the deadline, the heartbeat reports stalled — ``/healthz``
+flips to 503 — and (once per stall episode) dumps a diagnostic snapshot:
+every thread's stack, plus whatever queue/stats context the owner's
+``diagnostics`` callback supplies.
+
+Heartbeats self-register in a process-wide set so ``healthz()`` can aggregate
+without wiring; ``close()`` (or garbage collection) removes them.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+import weakref
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from perceiver_io_tpu.obs import tracing
+
+_HEARTBEATS: "weakref.WeakSet[Heartbeat]" = weakref.WeakSet()
+_HEARTBEATS_LOCK = threading.Lock()
+
+
+def thread_stacks() -> Dict[str, str]:
+    """Formatted stack per live thread, keyed by thread name (the core of the
+    stall diagnostic: where is everyone stuck?)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    return {
+        names.get(ident, f"thread-{ident}"):
+            "".join(traceback.format_stack(frame))
+        for ident, frame in sys._current_frames().items()
+    }
+
+
+class Heartbeat:
+    """Deadline-monitored liveness signal for one dispatch loop.
+
+    - ``arm()`` when work goes in flight (starts the deadline clock);
+    - ``beat()`` on every completion (resets it);
+    - ``disarm()`` when nothing is in flight (an idle loop is healthy).
+
+    ``deadline_s=None`` disables monitoring (the heartbeat always reports
+    healthy and no monitor thread runs). With a deadline, a daemon monitor
+    thread watches for a stall and emits the diagnostic dump — detection
+    itself (``stalled()``/``healthy()``) is computed on demand, so a health
+    probe never depends on the monitor's cadence.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        deadline_s: Optional[float] = None,
+        diagnostics: Optional[Callable[[], Dict[str, Any]]] = None,
+    ):
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be positive, got {deadline_s}")
+        self.name = name
+        self.deadline_s = deadline_s
+        self._diagnostics = diagnostics
+        self._lock = threading.Lock()
+        self._armed = False
+        self._last = time.monotonic()
+        self._dumped = False
+        self._closed = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+        with _HEARTBEATS_LOCK:
+            _HEARTBEATS.add(self)
+        if deadline_s is not None:
+            self._monitor = threading.Thread(
+                target=self._watch, name=f"{name}-heartbeat", daemon=True
+            )
+            self._monitor.start()
+
+    # -- the loop's side -----------------------------------------------------
+
+    def arm(self) -> None:
+        with self._lock:
+            if not self._armed:
+                self._armed = True
+                self._last = time.monotonic()
+
+    def beat(self) -> None:
+        with self._lock:
+            self._last = time.monotonic()
+            self._dumped = False
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._armed = False
+
+    # -- the probe's side ----------------------------------------------------
+
+    def stalled(self) -> bool:
+        with self._lock:
+            return (
+                self._armed
+                and self.deadline_s is not None
+                and time.monotonic() - self._last > self.deadline_s
+            )
+
+    def healthy(self) -> bool:
+        return not self.stalled()
+
+    def seconds_since_beat(self) -> float:
+        with self._lock:
+            return time.monotonic() - self._last
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        self._closed.set()
+        self.disarm()
+        with _HEARTBEATS_LOCK:
+            _HEARTBEATS.discard(self)
+
+    # -- stall monitor -------------------------------------------------------
+
+    def _watch(self) -> None:
+        poll = max(0.05, min(self.deadline_s / 4.0, 1.0))
+        while not self._closed.wait(poll):
+            if not self.stalled():
+                continue
+            with self._lock:
+                if self._dumped:
+                    continue
+                self._dumped = True
+            self._dump()
+
+    def _dump(self) -> None:
+        age = self.seconds_since_beat()
+        diag: Dict[str, Any] = {}
+        if self._diagnostics is not None:
+            try:
+                diag = self._diagnostics()
+            except Exception as e:  # a broken callback must not kill the dump
+                diag = {"diagnostics_error": f"{type(e).__name__}: {e}"}
+        stacks = thread_stacks()
+        print(
+            f"[obs] heartbeat {self.name!r} STALLED: no dispatch completion "
+            f"for {age:.1f}s (deadline {self.deadline_s}s) — diagnostic "
+            f"snapshot follows",
+            file=sys.stderr,
+        )
+        for key, val in diag.items():
+            print(f"[obs]   {key}: {val}", file=sys.stderr)
+        for tname, stack in stacks.items():
+            print(f"[obs]   -- thread {tname} --\n{stack}",
+                  file=sys.stderr, end="")
+        sys.stderr.flush()
+        tracing.event(
+            "heartbeat_stall", heartbeat=self.name,
+            seconds_since_beat=round(age, 3), deadline_s=self.deadline_s,
+            diagnostics=diag, threads=sorted(stacks),
+        )
+
+
+def healthz() -> Tuple[bool, Dict[str, Any]]:
+    """Aggregate health over every live heartbeat: ``(ok, detail)``.
+
+    A process with no heartbeats is healthy (nothing claims to be
+    dispatching); any stalled heartbeat makes it unhealthy.
+    """
+    with _HEARTBEATS_LOCK:
+        beats = list(_HEARTBEATS)
+    detail: Dict[str, Any] = {}
+    ok = True
+    for hb in sorted(beats, key=lambda h: h.name):
+        stalled = hb.stalled()
+        detail[hb.name] = {
+            "stalled": stalled,
+            "seconds_since_beat": round(hb.seconds_since_beat(), 3),
+            "deadline_s": hb.deadline_s,
+        }
+        ok = ok and not stalled
+    return ok, {"status": "ok" if ok else "stalled", "heartbeats": detail}

@@ -1,0 +1,115 @@
+"""Localhost HTTP sidecar: ``/metrics`` (Prometheus), ``/healthz``, ``/statz``.
+
+A daemon thread running a ``ThreadingHTTPServer`` bound to loopback — the
+serving process's observability surface. ``/metrics`` is the registry's text
+exposition; ``/healthz`` aggregates the live heartbeats (200 when every
+dispatch loop is beating, 503 with detail when one stalled); ``/statz`` is
+the JSON snapshot (registry + health) for humans and scripts.
+
+Multi-host: ``start()`` is a no-op off process 0 (``is_export_process``) —
+one exporter per job, the same policy as ``MetricsLogger``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from perceiver_io_tpu.obs import health as _health
+from perceiver_io_tpu.obs.registry import (
+    MetricsRegistry,
+    get_registry,
+    is_export_process,
+)
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class ObsServer:
+    """Loopback observability endpoint over a registry + the heartbeat set."""
+
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self._registry = registry or get_registry()
+        self._host = host
+        self._port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        """The bound port (resolves ``port=0`` ephemeral binds); None until
+        started."""
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def url(self) -> Optional[str]:
+        return f"http://{self._host}:{self.port}" if self._httpd else None
+
+    def start(self) -> Optional[str]:
+        """Bind and serve on a daemon thread; returns the base URL (None when
+        this process is not the export process)."""
+        if self._httpd is not None:
+            return self.url
+        if not is_export_process():
+            return None
+        registry = self._registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args) -> None:
+                pass  # scrapes must not spam the serving process's stderr
+
+            def _reply(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    self._reply(200, registry.prometheus_text().encode(),
+                                PROMETHEUS_CONTENT_TYPE)
+                elif path == "/healthz":
+                    ok, detail = _health.healthz()
+                    self._reply(200 if ok else 503,
+                                json.dumps(detail).encode() + b"\n",
+                                "application/json")
+                elif path == "/statz":
+                    ok, detail = _health.healthz()
+                    body = {"health": detail, **registry.snapshot()}
+                    self._reply(200, json.dumps(body).encode() + b"\n",
+                                "application/json")
+                else:
+                    self._reply(404, b"not found\n", "text/plain")
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="obs-http", daemon=True
+        )
+        self._thread.start()
+        return self.url
+
+    def close(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            if self._thread is not None:
+                self._thread.join(timeout=5)
+                self._thread = None
+
+    def __enter__(self) -> "ObsServer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,101 @@
+"""Lightweight span/event tracing to JSONL.
+
+The narrative channel next to the registry's numeric one: discrete runtime
+happenings (a bucket program compiled, a warmup finished, a heartbeat
+stalled) append one JSON object per line to a configured file. Unconfigured,
+``event``/``span`` are near-free no-ops — library code calls them
+unconditionally and only entry points opt into a sink.
+
+Thread-safe (one lock around write+flush); timestamps are wall-clock epoch
+seconds so lines correlate with external logs. Multi-host: configure the sink
+on process 0 only (the helpers never check — the caller owns that policy,
+mirroring ``MetricsLogger``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Any, Dict, Iterator, Optional
+
+__all__ = ["EventLog", "configure_event_log", "event", "get_event_log", "span"]
+
+
+class EventLog:
+    """Append-only JSONL event sink."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._f = open(path, "a")
+        self._write_error_reported = False
+
+    def write(self, record: Dict[str, Any]) -> None:
+        line = json.dumps({"t": time.time(), **record}, default=str)
+        with self._lock:
+            if self._f is None:
+                return
+            try:
+                self._f.write(line + "\n")
+                self._f.flush()
+            except OSError as e:
+                # telemetry must never crash the loop it observes (events
+                # are emitted from the engine worker / trainer hot paths);
+                # a full disk degrades the log, reported once
+                if not self._write_error_reported:
+                    self._write_error_reported = True
+                    import sys
+
+                    print(f"[obs] event log write failed ({e}) — further "
+                          f"events to {self.path!r} may be dropped",
+                          file=sys.stderr)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+_LOG: Optional[EventLog] = None
+_LOG_LOCK = threading.Lock()
+
+
+def configure_event_log(path: Optional[str]) -> Optional[EventLog]:
+    """Install (or, with None, remove) the process-wide event sink."""
+    global _LOG
+    with _LOG_LOCK:
+        if _LOG is not None:
+            _LOG.close()
+        _LOG = EventLog(path) if path else None
+        return _LOG
+
+
+def get_event_log() -> Optional[EventLog]:
+    return _LOG
+
+
+def event(name: str, **fields: Any) -> None:
+    """Record one discrete event (no-op until a sink is configured)."""
+    log = _LOG
+    if log is not None:
+        log.write({"event": name, **fields})
+
+
+@contextlib.contextmanager
+def span(name: str, **fields: Any) -> Iterator[None]:
+    """Record a timed span as one event carrying ``dur_s`` (and ``ok=False``
+    plus the error type when the body raises)."""
+    if _LOG is None:  # stay free when unconfigured
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    except BaseException as e:
+        event(name, dur_s=round(time.perf_counter() - t0, 6), ok=False,
+              error=type(e).__name__, **fields)
+        raise
+    event(name, dur_s=round(time.perf_counter() - t0, 6), ok=True, **fields)

@@ -11,7 +11,12 @@ available (torch is host-side only here — nothing touches the device path);
 every scalar is also appended to ``metrics.jsonl`` so runs remain greppable
 and the logger degrades gracefully on boxes without a TB writer.
 
-Only process 0 writes (multi-host safe).
+Every scalar is ALSO published as a gauge to the process-wide metrics
+registry (``perceiver_io_tpu.obs``) — TB/JSONL and the live exporters
+(``/metrics``, ``/statz``) see the same numbers from one source of truth.
+
+Only process 0 writes files (multi-host safe); gauges are local to every
+process, and the export edge (the HTTP sidecar) is process-0-gated.
 """
 
 from __future__ import annotations
@@ -22,6 +27,8 @@ import re
 from typing import Dict, Optional
 
 import jax
+
+import perceiver_io_tpu.obs as obs
 
 
 def next_version_dir(logdir: str, experiment: str) -> str:
@@ -52,8 +59,10 @@ def next_version_dir(logdir: str, experiment: str) -> str:
 class MetricsLogger:
     """Scalar + text logging to TensorBoard events and ``metrics.jsonl``."""
 
-    def __init__(self, run_dir: str, use_tensorboard: bool = True):
+    def __init__(self, run_dir: str, use_tensorboard: bool = True,
+                 registry: Optional[obs.MetricsRegistry] = None):
         self.run_dir = run_dir
+        self._registry = registry if registry is not None else obs.get_registry()
         self._is_writer = jax.process_index() == 0
         self._jsonl = None
         self._tb = None
@@ -70,9 +79,14 @@ class MetricsLogger:
                 self._tb = None
 
     def log_scalars(self, step: int, metrics: Dict[str, float]) -> None:
+        values = {k: float(v) for k, v in metrics.items()}
+        # registry gauges first: every process records locally (the export
+        # edge is process-0-gated), so /statz mirrors metrics.jsonl exactly
+        self._registry.gauge("logged_step", "last step log_scalars saw").set(step)
+        for k, v in values.items():
+            self._registry.gauge(k).set(v)
         if not self._is_writer:
             return
-        values = {k: float(v) for k, v in metrics.items()}
         self._jsonl.write(json.dumps({"step": int(step), **values}) + "\n")
         if self._tb is not None:
             for k, v in values.items():

@@ -75,6 +75,14 @@ class TrainerConfig:
     compute_mfu: bool = True
     profile_steps: int = 0  # capture a trace of this many steps after warmup
     profile_start_step: int = 10
+    # In-loop self-profiling watchdog (obs.SelfProfiler): every N optimizer
+    # steps, capture a short device trace, analyze it in-process (the
+    # utils/xplane.py lower-quartile discipline — the clock the tunnel cannot
+    # distort), and publish device/host step time + MFU + compile count as
+    # registry gauges AND metrics.jsonl rows. 0 disables. Unlike the in-loop
+    # wall-clock MFU above, these numbers ride the DEVICE clock.
+    selfprofile_every_n_steps: int = 0
+    selfprofile_steps: int = 4  # dispatches per capture window
     # preemption safety (SURVEY.md §5, restart-on-failure): on SIGTERM, save
     # the CURRENT state to the checkpoint dir's unconditional last/ slot and
     # stop cleanly; restore_train_state(prefer_latest=True) resumes from it.
@@ -217,6 +225,18 @@ class Trainer:
         self._flops_per_step: Optional[float] = None
         self._flops_attempted = False
         self._eval_key = jax.random.key(4242)
+
+        self._selfprof = None
+        if config.selfprofile_every_n_steps > 0:
+            from perceiver_io_tpu.obs import SelfProfiler
+
+            self._selfprof = SelfProfiler(
+                every_n=config.selfprofile_every_n_steps,
+                trace_steps=config.selfprofile_steps,
+                prefix="train",
+                flops_per_step=lambda: self._flops_per_step,
+                num_devices=(mesh.size if mesh is not None else 1),
+            )
 
     # -- internals -----------------------------------------------------------
 
@@ -507,6 +527,9 @@ class Trainer:
                         and not profiling_active
                         and not profile_captured
                         and step_i >= cfg.profile_start_step
+                        # the watchdog may hold the process's one trace slot
+                        and not (self._selfprof is not None
+                                 and self._selfprof._tracing)
                     ):
                         jax.profiler.start_trace(self.run_dir)
                         profiling_active = True
@@ -527,6 +550,14 @@ class Trainer:
                         profiling_active = False
                         profile_captured = True
                         self._warn_if_trace_empty()
+
+                    if self._selfprof is not None and not profiling_active:
+                        sp = self._selfprof.tick(
+                            ksteps,
+                            sync=lambda: jax.block_until_ready(metrics),
+                        )
+                        if sp:
+                            self.logger.log_scalars(step_i, sp)
 
                     n = cfg.log_every_n_steps
                     if step_i // n > prev_step // n:
@@ -597,6 +628,8 @@ class Trainer:
             # an active profiler trace into the process
             if profiling_active:
                 jax.profiler.stop_trace()
+            if self._selfprof is not None:
+                self._selfprof.close()  # abort an open watchdog window
             if handler_installed:
                 # signal.signal returned None when the prior disposition was
                 # installed outside Python — restore the default, never leave

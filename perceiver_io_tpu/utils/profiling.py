@@ -16,7 +16,9 @@ here profiling is first-class and TPU-native:
 from __future__ import annotations
 
 import contextlib
-from typing import Iterator, Optional
+import threading
+import warnings
+from typing import Callable, Iterator, Optional, Tuple
 
 import jax
 
@@ -39,14 +41,83 @@ def start_profiler_server(port: int = 9012) -> None:
     jax.profiler.start_server(port)
 
 
+def call_with_deadline(
+    fn: Callable[[], object],
+    deadline_s: Optional[float],
+    name: str = "call",
+) -> Tuple[bool, object]:
+    """Run ``fn`` with a wall-clock deadline: ``(completed, result)``.
+
+    Any device call can hang forever when the axon tunnel wedges (CLAUDE.md),
+    so watchdog-adjacent code must never call the profiler API bare. The call
+    runs on a daemon worker thread; on timeout the caller gets ``(False,
+    None)`` and moves on — the stuck thread is abandoned (it holds no locks
+    of ours and dies with the process). ``deadline_s=None`` calls inline.
+    Exceptions raised by ``fn`` before the deadline propagate unchanged.
+    """
+    if deadline_s is None:
+        return True, fn()
+    box: dict = {}
+    done = threading.Event()
+
+    def _run() -> None:
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised on the caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    worker = threading.Thread(
+        target=_run, name=f"deadline-{name}", daemon=True
+    )
+    worker.start()
+    if not done.wait(deadline_s):
+        return False, None
+    if "error" in box:
+        raise box["error"]
+    return True, box.get("result")
+
+
 @contextlib.contextmanager
-def trace(logdir: str) -> Iterator[None]:
-    """Capture a profiler trace into ``logdir`` (TensorBoard-compatible)."""
-    jax.profiler.start_trace(logdir)
+def trace(logdir: str, deadline_s: Optional[float] = None) -> Iterator[None]:
+    """Capture a profiler trace into ``logdir`` (TensorBoard-compatible).
+
+    With ``deadline_s``, ``start_trace``/``stop_trace`` each run under a
+    deadline: if either hangs (wedged tunnel), the context degrades to a
+    no-op with a warning instead of freezing the loop — callers keep their
+    host timing and simply get no trace to analyze.
+    """
+    started, _ = call_with_deadline(
+        lambda: jax.profiler.start_trace(logdir), deadline_s, "start_trace"
+    )
+    if not started:
+        warnings.warn(
+            f"jax.profiler.start_trace did not complete within {deadline_s}s "
+            "(wedged device tunnel?) — proceeding WITHOUT a trace",
+            stacklevel=2,
+        )
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        # even when start timed out it may have completed late on its worker
+        # thread — best-effort stop either way, never letting a profiler
+        # session leak into the process (stop on a never-started trace raises
+        # harmlessly into the except arm)
+        try:
+            stopped, _ = call_with_deadline(
+                jax.profiler.stop_trace, deadline_s, "stop_trace"
+            )
+            if not stopped:
+                warnings.warn(
+                    f"jax.profiler.stop_trace did not complete within "
+                    f"{deadline_s}s (wedged device tunnel?) — the trace "
+                    f"under {logdir!r} may be unusable",
+                    stacklevel=2,
+                )
+        except Exception:
+            if started:
+                raise
 
 
 def annotate_step(step_num: int) -> jax.profiler.StepTraceAnnotation:

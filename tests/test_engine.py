@@ -180,8 +180,9 @@ def test_engine_queue_drain_mixed_sizes_and_signatures():
             out = fut.result(timeout=60)
             assert out.shape == x.shape
             np.testing.assert_allclose(out, x + 0.5)
-        assert eng.stats["requests"] == len(cases) - 1  # empty skips the queue
-        assert eng.stats["rows"] == sum(len(x) for x, _ in cases)
+        stats = eng.stats()
+        assert stats["requests"] == len(cases) - 1  # empty skips the queue
+        assert stats["rows"] == sum(len(x) for x, _ in cases)
 
 
 def test_engine_concurrent_submitters():
@@ -308,7 +309,7 @@ def test_mlm_server_latent_cache_decode_many(mlm_setup):
         want = server.fill_masks(TEXTS, k=3)
         cached = server.encode(TEXTS)
         assert cached.latents.shape[0] == len(TEXTS)
-        encoder_batches = server.encoder.stats["batches"]
+        encoder_batches = server.encoder.stats()["batches"]
 
         assert server.fill_masks_cached(cached, k=3) == want
         # decode-many against the same latents: 3 more decode rounds
@@ -318,7 +319,7 @@ def test_mlm_server_latent_cache_decode_many(mlm_setup):
         for shift in (1, 2):
             more = server.decode(cached, (positions + shift) % 8)
             assert more.shape == logits.shape
-        assert server.encoder.stats["batches"] == encoder_batches, (
+        assert server.encoder.stats()["batches"] == encoder_batches, (
             "decode-many must not re-run the encoder"
         )
 
@@ -335,6 +336,102 @@ def test_mlm_server_latent_cache_decode_many(mlm_setup):
         np.testing.assert_allclose(
             logits[row], np.asarray(fused)[0], atol=2e-5
         )
+
+
+def test_engine_stats_snapshot_is_locked_and_deep():
+    """stats() is a consistent deep copy: mutating the snapshot (or its
+    latency lists) never touches live engine state, and concurrent submitters
+    hammering the counters while snapshots are taken leave the final tallies
+    exact (the r6 thread-safety hole: requests was bumped on caller threads
+    while the worker wrote rows/batches, unlocked)."""
+
+    def apply_fn(p, x):
+        return x + p
+
+    with ServingEngine(apply_fn, jnp.float32(1.0), max_batch=4) as eng:
+        fut = eng.submit(np.zeros((2, 3), np.float32))
+        fut.result(timeout=60)
+        snap = eng.stats()
+        snap["requests"] = 10**9
+        snap["latency_s_by_bucket"].setdefault(2, []).append(123.0)
+        fresh = eng.stats()
+        assert fresh["requests"] == 1
+        assert 123.0 not in fresh["latency_s_by_bucket"].get(2, [])
+
+        # hammer: 8 threads x 25 requests, snapshots interleaved throughout
+        def client(_):
+            for _ in range(25):
+                eng.submit(np.zeros((1, 3), np.float32)).result(timeout=60)
+                eng.stats()
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        final = eng.stats()
+        assert final["requests"] == 1 + 8 * 25
+        assert final["rows"] == 2 + 8 * 25
+
+
+def test_mlm_server_stats_shim_shape(mlm_setup):
+    """MLMServer.stats() keeps the r6 shape (fused/encode/decode/programs)
+    over the registry-backed engines, stays JSON-serializable (the serve CLI
+    --stats path), and deep-copies."""
+    import json as _json
+
+    tok, model, params = mlm_setup
+    with MLMServer(model, params, tok, max_seq_len=16, max_batch=4) as server:
+        server.fill_masks(["the movie was [MASK]"], k=2)
+        stats = server.stats()
+        assert set(stats) == {"fused", "encode", "decode", "programs"}
+        assert stats["fused"]["requests"] == 1
+        _json.dumps(stats)  # deques would raise here
+        for lats in stats["fused"]["latency_s_by_bucket"].values():
+            lats.append(999.0)
+        assert all(
+            999.0 not in v
+            for v in server.stats()["fused"]["latency_s_by_bucket"].values()
+        )
+
+
+def test_engine_publishes_registry_instruments():
+    """The engine's registry instruments carry the serving telemetry: request
+    /row/batch counters, padding waste, occupancy + latency histograms, and
+    compile events that stay flat in steady state (the recompile detector)."""
+    from perceiver_io_tpu import obs
+
+    reg = obs.MetricsRegistry()
+
+    def apply_fn(p, x):
+        return x * p
+
+    with ServingEngine(
+        apply_fn, jnp.float32(2.0), max_batch=4, name="obs_t", registry=reg
+    ) as eng:
+        eng.warmup(np.zeros((1, 2), np.float32))
+        compiles_after_warmup = reg.counter(
+            "serving_compile_events_total", labels={"engine": "obs_t"}
+        ).value
+        assert compiles_after_warmup == 3  # buckets 1, 2, 4
+        for n in (1, 3, 4):
+            eng.submit(np.zeros((n, 2), np.float32)).result(timeout=60)
+        snap = reg.snapshot()
+        assert snap["counters"]['serving_requests_total{engine="obs_t"}'] == 3
+        assert snap["counters"]['serving_rows_total{engine="obs_t"}'] == 8
+        # 3 requests → 3 buckets (1, 4, 4): the 3-row one padded by 1
+        assert snap["counters"]['serving_padded_rows_total{engine="obs_t"}'] >= 1
+        assert reg.counter(
+            "serving_compile_events_total", labels={"engine": "obs_t"}
+        ).value == compiles_after_warmup, "steady state must not compile"
+        lat = reg.histogram(
+            "serving_latency_seconds",
+            labels={"engine": "obs_t", "bucket": "4"},
+        )
+        assert lat.count >= 1
+        text = reg.prometheus_text()
+        assert '# TYPE serving_requests_total counter' in text
+        assert 'serving_requests_total{engine="obs_t"} 3' in text
 
 
 def test_mlm_server_oversized_and_empty(mlm_setup):

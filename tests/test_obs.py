@@ -1,0 +1,397 @@
+"""Unified runtime telemetry (perceiver_io_tpu.obs): registry, tracing,
+HTTP sidecar, heartbeat health, and the in-loop self-profiling watchdog."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from perceiver_io_tpu import obs
+from perceiver_io_tpu.inference import ServingEngine
+
+
+# -- registry ----------------------------------------------------------------
+
+
+def test_registry_counter_gauge_histogram():
+    reg = obs.MetricsRegistry()
+    c = reg.counter("reqs_total", "requests")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+    g = reg.gauge("depth")
+    g.set(3.5)
+    assert g.value == 3.5
+    g.inc(-1.5)
+    assert g.value == 2.0
+
+    h = reg.histogram("lat_seconds", window=100)
+    for v in range(100):
+        h.observe(v / 100)
+    p = h.percentiles()
+    assert h.count == 100 and abs(h.sum - 49.5) < 1e-9
+    assert p[0.5] == pytest.approx(0.5) and p[0.95] == pytest.approx(0.95)
+    # bounded window: old observations roll off, count/sum stay lifetime
+    for _ in range(200):
+        h.observe(1.0)
+    assert h.count == 300 and len(h.values()) == 100
+
+
+def test_registry_identity_and_type_conflicts():
+    reg = obs.MetricsRegistry()
+    a = reg.counter("x_total", labels={"k": "1"})
+    b = reg.counter("x_total", labels={"k": "1"})
+    other = reg.counter("x_total", labels={"k": "2"})
+    assert a is b and a is not other
+    with pytest.raises(TypeError):
+        reg.gauge("x_total", labels={"k": "1"})
+    with pytest.raises(TypeError):  # same name, new labels, wrong kind
+        reg.histogram("x_total", labels={"k": "9"})
+
+
+def test_registry_thread_safety_exact_counts():
+    reg = obs.MetricsRegistry()
+    c = reg.counter("hammer_total")
+    h = reg.histogram("hammer_seconds")
+
+    def worker():
+        for _ in range(1000):
+            c.inc()
+            h.observe(0.001)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8000
+    assert h.count == 8000
+
+
+def test_prometheus_text_exposition_format():
+    reg = obs.MetricsRegistry()
+    reg.counter("serving_requests_total", "reqs", {"engine": "e1"}).inc(7)
+    reg.gauge("queue_depth", "depth").set(2)
+    h = reg.histogram("lat_seconds", "latency", {"engine": "e1"})
+    h.observe(0.25)
+    text = reg.prometheus_text()
+    assert "# TYPE serving_requests_total counter" in text
+    assert 'serving_requests_total{engine="e1"} 7' in text
+    assert "# TYPE queue_depth gauge" in text
+    assert "queue_depth 2" in text
+    assert "# TYPE lat_seconds summary" in text
+    assert 'lat_seconds{engine="e1",quantile="0.5"} 0.25' in text
+    assert 'lat_seconds_count{engine="e1"} 1' in text
+    # every non-comment line: name{labels} value
+    import re
+
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        assert re.fullmatch(
+            r'[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? \S+', line
+        ), line
+
+
+def test_sanitize_metric_name():
+    assert obs.sanitize_metric_name("val_loss") == "val_loss"
+    assert obs.sanitize_metric_name("bucket64.p95") == "bucket64_p95"
+    assert obs.sanitize_metric_name("9lives") == "_9lives"
+
+
+def test_snapshot_shape():
+    reg = obs.MetricsRegistry()
+    reg.counter("a_total").inc(2)
+    reg.gauge("b").set(1)
+    reg.histogram("c_seconds").observe(0.5)
+    snap = reg.snapshot()
+    assert snap["counters"]["a_total"] == 2
+    assert snap["gauges"]["b"] == 1
+    assert snap["histograms"]["c_seconds"]["count"] == 1
+    json.dumps(snap)  # must stay JSON-able (the /statz body)
+
+
+# -- tracing -----------------------------------------------------------------
+
+
+def test_event_log_span_and_event(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    obs.configure_event_log(path)
+    try:
+        obs.event("compile", engine="e1", bucket=4)
+        with obs.span("warmup", engine="e1"):
+            pass
+        with pytest.raises(RuntimeError):
+            with obs.span("boom"):
+                raise RuntimeError("x")
+    finally:
+        obs.configure_event_log(None)
+    obs.event("after_close")  # must be a silent no-op
+    rows = [json.loads(l) for l in open(path)]
+    assert [r["event"] for r in rows] == ["compile", "warmup", "boom"]
+    assert rows[0]["bucket"] == 4 and "t" in rows[0]
+    assert rows[1]["ok"] is True and rows[1]["dur_s"] >= 0
+    assert rows[2]["ok"] is False and rows[2]["error"] == "RuntimeError"
+
+
+# -- health / heartbeat ------------------------------------------------------
+
+
+def test_heartbeat_stall_detection_and_recovery(capsys):
+    diag_called = []
+    hb = obs.Heartbeat(
+        "t-dispatch", deadline_s=0.15,
+        diagnostics=lambda: diag_called.append(1) or {"queue": 3},
+    )
+    try:
+        assert hb.healthy()  # disarmed = healthy
+        hb.arm()
+        assert hb.healthy()
+        time.sleep(0.4)  # no beat within deadline
+        assert hb.stalled()
+        ok, detail = obs.healthz()
+        assert not ok and detail["heartbeats"]["t-dispatch"]["stalled"]
+        # the monitor thread dumped a diagnostic snapshot exactly once
+        deadline = time.monotonic() + 2
+        while not diag_called and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert diag_called
+        err = capsys.readouterr().err
+        assert "STALLED" in err and "queue: 3" in err
+        assert "thread" in err  # stack dump present
+        hb.beat()  # a completion arrives: healthy again
+        assert hb.healthy()
+        hb.disarm()
+    finally:
+        hb.close()
+    ok, _ = obs.healthz()
+    assert ok  # closed heartbeats leave the aggregate
+
+
+def test_healthz_empty_is_healthy():
+    ok, detail = obs.healthz()
+    assert ok and detail["status"] == "ok"
+
+
+# -- HTTP sidecar ------------------------------------------------------------
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode(), r.headers.get("Content-Type")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode(), e.headers.get("Content-Type")
+
+
+def test_obs_server_endpoints():
+    reg = obs.MetricsRegistry()
+    reg.counter("hits_total", "hits").inc(3)
+    with obs.ObsServer(registry=reg, port=0) as server:
+        assert server.port > 0
+        code, body, ctype = _get(f"{server.url}/metrics")
+        assert code == 200 and "hits_total 3" in body
+        assert "text/plain" in ctype
+        code, body, _ = _get(f"{server.url}/statz")
+        assert code == 200
+        assert json.loads(body)["counters"]["hits_total"] == 3
+        code, body, _ = _get(f"{server.url}/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+        code, _, _ = _get(f"{server.url}/nope")
+        assert code == 404
+    assert server.port is None  # closed
+
+
+def test_healthz_flips_unhealthy_on_stalled_dispatch():
+    """The acceptance drill: a dispatch that never completes (stalled fake
+    device call) flips /healthz to 503 with the stalled heartbeat named;
+    releasing the stall recovers it."""
+    release = threading.Event()
+    reg = obs.MetricsRegistry()
+
+    def apply_fn(p, x):
+        return x + p
+
+    eng = ServingEngine(
+        apply_fn, jnp.float32(1.0), max_batch=2, name="stall_t",
+        registry=reg, heartbeat_deadline_s=0.2,
+    )
+    real_jitted = eng._jitted
+
+    def stalling_jitted(p, cols):
+        release.wait(30)  # the wedged tunnel: dispatch never returns
+        return real_jitted(p, cols)
+
+    eng._jitted = stalling_jitted
+    try:
+        with obs.ObsServer(registry=reg, port=0) as server:
+            fut = eng.submit(np.zeros((1, 2), np.float32))
+            deadline = time.monotonic() + 10
+            code = None
+            while time.monotonic() < deadline:
+                code, body, _ = _get(f"{server.url}/healthz")
+                if code == 503:
+                    break
+                time.sleep(0.05)
+            assert code == 503, body
+            assert json.loads(body)["heartbeats"]["stall_t-dispatch"]["stalled"]
+            release.set()  # the tunnel un-wedges: request completes
+            out = fut.result(timeout=60)
+            np.testing.assert_allclose(out, 1.0)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                code, body, _ = _get(f"{server.url}/healthz")
+                if code == 200:
+                    break
+                time.sleep(0.05)
+            assert code == 200, body
+    finally:
+        release.set()
+        eng.close()
+
+
+# -- self-profiling watchdog -------------------------------------------------
+
+
+def test_selfprofiler_cpu_window_publishes_host_gauges(monkeypatch):
+    """On CPU the xplane analysis finds no TPU plane — the watchdog degrades
+    to host timing and still publishes step time + MFU (peak patched in for
+    the cpu device kind) through the registry."""
+    from perceiver_io_tpu.utils import profiling
+
+    monkeypatch.setitem(profiling._PEAK_FLOPS, "cpu", 1e12)
+    reg = obs.MetricsRegistry()
+    prof = obs.SelfProfiler(
+        every_n=2, trace_steps=2, prefix="t", registry=reg,
+        # tiny fake FLOPs so mfu = flops/step_time/peak stays << 1 no
+        # matter how fast the window runs
+        flops_per_step=1e6, deadline_s=30.0,
+    )
+    f = jax.jit(lambda x: x * 2.0)
+    x = jnp.ones((4, 4))
+    published = None
+    for _ in range(8):
+        f(x).block_until_ready()
+        out = prof.tick(sync=lambda: None)
+        if out is not None:
+            published = out
+            break
+    assert published is not None, "no capture window closed in 8 ticks"
+    assert published["selfprofile_host_step_ms"] > 0
+    assert 0 < published["selfprofile_mfu"] < 1
+    labels = {"loop": "t"}
+    assert reg.gauge("selfprofile_host_step_ms", labels=labels).value > 0
+    assert reg.counter("selfprofile_windows_total", labels=labels).value == 1
+    # no TPU plane on CPU → the window degraded (counted) but host numbers
+    # stand; device gauge untouched
+    assert reg.counter("selfprofile_failures_total", labels=labels).value >= 1
+    assert "selfprofile_device_step_ms" not in published
+
+
+def test_selfprofiler_normalizes_multi_step_dispatches():
+    """Under steps_per_dispatch=K each trace window is one K-step dispatch:
+    the window must close after trace_steps DISPATCHES and publish
+    per-OPTIMIZER-STEP host time (elapsed / K*dispatches), not per-dispatch
+    — the r4 in-loop-MFU unit bug, pinned here for the watchdog."""
+    reg = obs.MetricsRegistry()
+    prof = obs.SelfProfiler(
+        every_n=4, trace_steps=2, prefix="k", registry=reg, deadline_s=30.0,
+    )
+    K = 4
+    dispatch_s = 0.05
+    out = prof.tick(K)  # since_window hits every_n → window opens
+    assert out is None
+    time.sleep(dispatch_s)
+    assert prof.tick(K) is None  # dispatch 1 of 2 — window stays open
+    time.sleep(dispatch_s)
+    published = prof.tick(K)  # dispatch 2 of 2 → closes, 8 steps total
+    assert published is not None
+    host_ms = published["selfprofile_host_step_ms"]
+    # ~100ms over 8 optimizer steps ⇒ ~12.5ms/step; the per-dispatch bug
+    # would report ~50ms. Midpoint bound: clearly per-step, not per-dispatch
+    assert host_ms < 30, host_ms
+    assert reg.counter("selfprofile_windows_total",
+                       labels={"loop": "k"}).value == 1
+
+
+def test_compile_counter_counts_new_shapes():
+    reg = obs.get_registry()
+    counter = obs.install_compile_counter(reg)
+    before = counter.value
+    f = jax.jit(lambda x: x + 1)
+    f(jnp.ones((3,))).block_until_ready()
+    f(jnp.ones((3,))).block_until_ready()  # cache hit: no new compile
+    mid = counter.value
+    assert mid >= before + 1
+    f(jnp.ones((7,))).block_until_ready()  # new shape: recompile
+    assert counter.value >= mid + 1
+
+
+# -- Trainer / MetricsLogger one-source-of-truth -----------------------------
+
+
+def test_metrics_logger_publishes_registry_gauges(tmp_path):
+    from perceiver_io_tpu.training.metrics import MetricsLogger, read_metrics
+
+    reg = obs.MetricsRegistry()
+    with MetricsLogger(str(tmp_path), use_tensorboard=False,
+                       registry=reg) as logger:
+        logger.log_scalars(7, {"train_loss": 1.25, "mfu": 0.5})
+    rows = read_metrics(str(tmp_path))
+    assert rows[0]["train_loss"] == 1.25
+    assert reg.gauge("train_loss").value == 1.25
+    assert reg.gauge("mfu").value == 0.5
+    assert reg.gauge("logged_step").value == 7
+
+
+def test_trainer_smoke_publishes_step_time_and_mfu_gauges(tmp_path, monkeypatch):
+    """The acceptance drill: a CPU Trainer run with the watchdog on publishes
+    step-time + MFU gauges through the SAME registry that feeds metrics.jsonl
+    — and the jsonl rows carry the same selfprofile metrics (one source of
+    truth). On CPU the device plane is absent, so the step-time gauge is the
+    host fallback; MFU flows once the cost-analysis FLOPs land (peak patched
+    in for the cpu device kind)."""
+    from test_trainer import _make_parts
+
+    from perceiver_io_tpu.training import Trainer, TrainerConfig
+    from perceiver_io_tpu.training.metrics import read_metrics
+    from perceiver_io_tpu.utils import profiling
+
+    monkeypatch.setitem(profiling._PEAK_FLOPS, "cpu", 1e12)
+    base, (train_loader, _) = _make_parts(tmp_path)
+    cfg = TrainerConfig(
+        max_steps=6, log_every_n_steps=2,
+        logdir=str(tmp_path / "logs_sp"), experiment="sp",
+        use_tensorboard=False, compute_mfu=True,
+        selfprofile_every_n_steps=2, selfprofile_steps=2,
+    )
+    trainer = Trainer(
+        base._raw_train_step, None, base.state, cfg,
+        example_batch=base._example_batch,
+    )
+    with trainer:
+        trainer.fit(train_loader)
+        rows = read_metrics(trainer.run_dir)
+    base.close()
+
+    sp_rows = [r for r in rows if "selfprofile_host_step_ms" in r]
+    assert sp_rows, rows
+    assert sp_rows[0]["selfprofile_host_step_ms"] > 0
+    assert any("selfprofile_mfu" in r for r in sp_rows)
+    assert any("mfu" in r for r in rows)  # the wall-clock in-loop MFU too
+
+    reg = obs.get_registry()  # the registry MetricsLogger fed
+    labels = {"loop": "train"}
+    assert reg.gauge("selfprofile_host_step_ms", labels=labels).value > 0
+    assert reg.gauge("selfprofile_mfu", labels=labels).value > 0
+    # the logger mirrored every jsonl scalar into the same registry
+    train_rows = [r for r in rows if "train_loss" in r]
+    assert reg.gauge("train_loss").value == train_rows[-1]["train_loss"]

@@ -288,6 +288,7 @@ def _engine_mode(args) -> None:
     n_med, e_med = statistics.median(naive_s), statistics.median(engine_s)
 
     n = args.requests
+    stats = engine.stats()  # locked deep-copied snapshot
     results = {
         "mode": "engine", "backend": backend, "dtype": args.dtype,
         "preset": "tiny" if tiny else "flagship",
@@ -297,13 +298,11 @@ def _engine_mode(args) -> None:
         "engine_requests_per_s": round(n / e_med, 2),
         "engine_tokens_per_s": round(n * max_seq_len / e_med, 1),
         "speedup": round(n_med / e_med, 3),
-        "batches": engine.stats["batches"],
+        "batches": stats["batches"],
         "mean_rows_per_batch": round(
-            engine.stats["rows"] / max(engine.stats["batches"], 1), 2),
+            stats["rows"] / max(stats["batches"], 1), 2),
     }
-    for bucket, lats in sorted(
-        engine.stats["latency_s_by_bucket"].items()
-    ):
+    for bucket, lats in sorted(stats["latency_s_by_bucket"].items()):
         for k, v in _percentiles(lats).items():
             results[f"bucket{bucket}_{k}"] = v
 

@@ -167,7 +167,29 @@ CASES = {
 }
 
 
-def run(out_path: str | None) -> int:
+def run(out_path: str | None, dry: bool = False) -> int:
+    if dry:
+        # --dry: the stdout-contract mode — emit the one JSON line without
+        # touching ANY device (no jax import: safe on a wedged tunnel, and
+        # what CI uses to pin the one-JSON-line-on-stdout invariant)
+        report = {
+            "metric": "kernel_smoke",
+            "dry": True,
+            "backend": None,
+            "device": None,
+            "passed": 0,
+            "total": len(CASES),
+            "cases": [],
+            "skipped": sorted(CASES),
+            "failures": {},
+        }
+        line = json.dumps(report)
+        print(line)
+        if out_path:
+            with open(out_path, "w") as f:
+                f.write(line + "\n")
+        return 0
+
     import jax
 
     results, failures = [], {}
@@ -197,8 +219,11 @@ def run(out_path: str | None) -> int:
 def main():
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--out", default=None, help="also write the JSON here")
+    p.add_argument("--dry", action="store_true",
+                   help="emit the JSON report shape without running any case "
+                        "or touching a device (stdout-contract CI mode)")
     args = p.parse_args()
-    raise SystemExit(run(args.out))
+    raise SystemExit(run(args.out, dry=args.dry))
 
 
 if __name__ == "__main__":
