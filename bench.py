@@ -29,13 +29,61 @@ traffic; 0 = reference-shaped full decode). PIT_BENCH_HEAD selects the vocab
 head ('pallas' default on TPU — the fused flash-CE kernel, device-measured
 10.42 → 9.82 ms/step; 'none' = unfused; 'xla' = chunked-scan variant).
 PIT_BENCH_HOST_ONLY=1 skips the device trace (host clock becomes the
-headline).
+headline). PIT_BENCH_BACKEND_DEADLINE_S (default 120) bounds the first
+backend probe: when the tunnel is dark the probe times out and the script
+prints a single ``{"error": "tpu_unavailable", ...}`` JSON record and exits
+nonzero instead of hanging or dumping a raw traceback (BENCH_r05).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
+
+
+def _probe_backend() -> str:
+    """Resolve ``jax.default_backend()`` under a wall-clock deadline.
+
+    The first backend touch is where a dark axon tunnel bites: the PJRT
+    plugin hangs (or raises) inside ``jax.default_backend()``, which used to
+    escape as a raw traceback on stdout — violating the one-JSON-line
+    contract exactly when the driver most needs a parseable record. The
+    probe runs on an abandonable daemon thread (``call_with_deadline``); on
+    timeout or error ONE JSON error line is printed and the process exits
+    nonzero via ``os._exit`` (a wedged PJRT thread cannot be joined).
+    PIT_BENCH_BACKEND_DEADLINE_S overrides the 120 s default.
+    """
+    import jax
+
+    from perceiver_io_tpu.utils.profiling import call_with_deadline
+
+    deadline = float(os.environ.get("PIT_BENCH_BACKEND_DEADLINE_S", "120"))
+    try:
+        done, backend = call_with_deadline(
+            jax.default_backend, deadline, "default_backend"
+        )
+    except Exception as e:  # backend init raised (plugin error, no devices)
+        _exit_backend_unavailable(f"{type(e).__name__}: {str(e)[:300]}")
+    if not done:
+        _exit_backend_unavailable(
+            f"jax.default_backend() gave no answer within {deadline:g}s "
+            "(wedged axon tunnel?)"
+        )
+    return backend
+
+
+def _exit_backend_unavailable(reason: str) -> None:
+    """Emit the single JSON error record and exit nonzero."""
+    print(json.dumps({
+        "error": "tpu_unavailable",
+        "metric": "mlm_tokens_per_sec_per_chip",
+        "value": None,
+        "reason": reason,
+    }))
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(2)
 
 
 def main() -> None:
@@ -47,6 +95,8 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    backend = _probe_backend()
 
     from perceiver_io_tpu.training import (
         OptimizerConfig,
@@ -72,7 +122,7 @@ def main() -> None:
     if head is None:
         # the fused flash-CE head is a TPU kernel; off-TPU it would run in
         # interpreter mode (orders of magnitude slower)
-        head = "pallas" if jax.default_backend() == "tpu" else "none"
+        head = "pallas" if backend == "tpu" else "none"
     fused_head = {"pallas": "pallas", "xla": True, "none": False}.get(head)
     if fused_head is None:
         raise SystemExit(
@@ -116,7 +166,7 @@ def main() -> None:
     fresh_state = lambda: jax.tree.map(jnp.copy, state)
 
     device_s = None
-    if (jax.default_backend() == "tpu"
+    if (backend == "tpu"
             and os.environ.get("PIT_BENCH_HOST_ONLY") != "1"):
         try:
             device_s, _, _ = time_train_step_device(
@@ -155,10 +205,10 @@ def main() -> None:
         "host_ms_per_step": round(host_s * 1e3, 3),
     }))
 
-    _maybe_kernel_smoke()
+    _maybe_kernel_smoke(backend)
 
 
-def _maybe_kernel_smoke() -> None:
+def _maybe_kernel_smoke(backend: str) -> None:
     """Refresh KERNELSMOKE.json after the headline (VERDICT r3 item 5).
 
     Runs ``tools/kernel_smoke.py`` in a SUBPROCESS (own timeout, stdout
@@ -170,12 +220,8 @@ def _maybe_kernel_smoke() -> None:
     skips (e.g. when iterating on bench timing alone).
     """
     import subprocess
-    import sys
 
-    import jax
-
-    if (jax.default_backend() != "tpu"
-            or os.environ.get("PIT_SKIP_KERNEL_SMOKE") == "1"):
+    if backend != "tpu" or os.environ.get("PIT_SKIP_KERNEL_SMOKE") == "1":
         return
     root = os.path.dirname(os.path.abspath(__file__))
     # A wedged/crashed smoke run must be DISTINGUISHABLE from a passing one:

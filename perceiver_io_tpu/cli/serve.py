@@ -20,6 +20,13 @@ Usage::
 instead of the fused forward — same results (parity-tested), useful to smoke
 the split pipeline a multi-query deployment would run.
 
+``--quantize int8`` is the weight-only int8 serving path: matmul kernels are
+quantized once at load (per-channel symmetric int8, f32 scales —
+``perceiver_io_tpu.quant``) and dequantized inside the compiled programs, so
+each micro-batch streams int8 weight bytes from HBM. The checkpoint stays
+f32 on disk; parity error vs the f32 oracle is bounded and measured
+(`tools/quant_bench.py`, PERF.md §Quantization).
+
 ``--metrics_port`` starts the localhost observability sidecar
 (``/metrics`` Prometheus text, ``/healthz``, ``/statz`` JSON snapshot);
 ``--heartbeat_deadline_s`` arms the wedged-tunnel dispatch heartbeat;
@@ -65,6 +72,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serving compute dtype: float32 is the golden-parity "
                         "path; bfloat16 rebuilds the model at bf16 compute "
                         "and casts params once (the bf16 serving path)")
+    g.add_argument("--quantize", choices=("none", "int8"), default="none",
+                   help="weight-only quantization: int8 stores the matmul "
+                        "kernels as per-channel symmetric int8 (f32 scales), "
+                        "dequantized inside the compiled program — halves "
+                        "the weight bytes streamed from HBM per micro-batch "
+                        "vs bf16 (the measured serving bottleneck). Params "
+                        "are quantized once at load; the checkpoint stays "
+                        "f32 on disk. Composes with --dtype: compute runs "
+                        "at --dtype, only weight STORAGE is int8")
     g.add_argument("--cached", action="store_true",
                    help="serve via the latent-cache split (encode once, "
                         "decode the [MASK] queries) instead of the fused "
@@ -153,6 +169,7 @@ def _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint):
         max_batch=args.max_batch,
         max_delay_ms=args.max_delay_ms,
         compute_dtype="bfloat16" if args.dtype == "bfloat16" else None,
+        quantize=None if args.quantize == "none" else args.quantize,
         heartbeat_deadline_s=args.heartbeat_deadline_s,
         selfprofile_every=args.selfprofile_every,
     ) as server:

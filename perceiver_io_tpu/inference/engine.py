@@ -40,6 +40,19 @@ on the f32 golden-parity path: bf16 rounds. On TPU the padded input buffers
 are donated to XLA (``donate_argnums``) — each dispatch's staging buffer is
 handed to the device while the host fills the next one (ping-pong staging);
 off-TPU donation is skipped (unimplemented there, and XLA would warn).
+
+int8w serving: ``quantize='int8'`` (or the ``compute_dtype='int8w'``
+shorthand — bf16 compute over int8-stored weights) quantizes the matmul
+kernels ONCE at engine construction (``perceiver_io_tpu.quant``: per-channel
+symmetric int8, f32 scales, key paths identical to the f32 tree) and
+dequantizes inside the jitted dispatch, so each micro-batch streams int8
+weight bytes from HBM — the measured roofline's binding term. Same bucket
+programs, same AOT ``warmup()``; checkpoints stay f32 on disk.
+``update_params()`` hot-swaps (re-quantizing under the same mode) without
+recompiling: preparation runs on the caller thread and the worker installs
+the finished tree atomically between micro-batches, so requests that arrive
+mid-(re)quantization queue against the old params rather than racing a
+half-built tree.
 """
 
 from __future__ import annotations
@@ -56,6 +69,52 @@ import perceiver_io_tpu.obs as obs
 from perceiver_io_tpu.inference.predictor import bucket_size
 
 _IDLE_POLL_S = 0.05  # worker wake-up cadence while idle (checks shutdown)
+
+
+def resolve_params_mode(
+    compute_dtype: Optional[str], quantize: Optional[str]
+) -> Tuple[Optional[str], Optional[str]]:
+    """Normalize the (compute_dtype, quantize) pair — ONE definition of the
+    ``'int8w'`` shorthand (bf16 compute over int8-stored weights) and the
+    mode validation, shared by ``ServingEngine`` and ``MLMServer`` so the
+    two can never drift."""
+    # validate BEFORE the shorthand rewrite: compute_dtype='int8w' must not
+    # silently swallow a typo'd quantize= argument
+    if quantize not in (None, "int8"):
+        raise ValueError(
+            f"unknown quantize mode {quantize!r}; expected None or 'int8'"
+        )
+    if compute_dtype == "int8w":
+        compute_dtype, quantize = "bfloat16", "int8"
+    return compute_dtype, quantize
+
+
+def prepare_param_tree(params, compute_dtype, quantize: Optional[str]):
+    """Load-time param preparation under a serving mode (no device_put):
+    cast floating leaves to ``compute_dtype`` (bf16 path), or quantize the
+    matmul kernels to int8 with the remaining floats cast (int8w path —
+    scales computed from the caller's tree, so hand in f32 for full scale
+    precision). A tree that is already ``QuantizedParams`` is trusted as
+    prepared."""
+    import jax
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.quant import is_quantized, quantize_tree
+
+    if is_quantized(params):
+        return params  # prepared upstream (e.g. once for MLMServer's 3 engines)
+    if quantize == "int8":
+        return quantize_tree(
+            params,
+            compute_dtype=str(jnp.dtype(compute_dtype or jnp.float32)),
+        )
+    if compute_dtype is not None:
+        dt = jnp.dtype(compute_dtype)
+        cast = lambda x: (
+            x.astype(dt) if jnp.issubdtype(x.dtype, jnp.floating) else x
+        )
+        return jax.tree.map(cast, params)
+    return params
 
 
 class EngineClosed(RuntimeError):
@@ -176,6 +235,7 @@ class ServingEngine:
         max_delay_ms: float = 0.0,
         max_inflight: int = 2,
         compute_dtype: Optional[str] = None,
+        quantize: Optional[str] = None,
         donate_inputs: Optional[bool] = None,
         name: str = "serve",
         registry: Optional[obs.MetricsRegistry] = None,
@@ -185,6 +245,8 @@ class ServingEngine:
         import jax
         import jax.numpy as jnp
 
+        from perceiver_io_tpu.quant import dequantize_tree, is_quantized
+
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_inflight < 1:
@@ -193,6 +255,14 @@ class ServingEngine:
         self.max_delay = max_delay_ms / 1e3
         self.max_inflight = max_inflight
         self.name = name
+        compute_dtype, quantize = resolve_params_mode(compute_dtype, quantize)
+        if is_quantized(params):
+            # a pre-quantized tree (MLMServer shares ONE across its engines)
+            # implies the mode; its baked compute dtype is validated in
+            # _prepare_params — which also guards update_params, so a later
+            # hot-swap cannot slip in a mismatched tree either
+            quantize = "int8"
+        self.quantize = quantize
         self._compute_dtype = (
             None if compute_dtype is None else jnp.dtype(compute_dtype)
         )
@@ -202,17 +272,23 @@ class ServingEngine:
             donate_inputs = jax.default_backend() == "tpu"
         self.donate_inputs = donate_inputs
 
-        if self._compute_dtype is not None:
-            cast = lambda x: (
-                x.astype(self._compute_dtype)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x
-            )
-            params = jax.tree.map(cast, params)
-        self.params = jax.device_put(params)
+        self._params_lock = threading.Lock()
+        self._pending_params = None
+        # update_params ordering (both under the lock): _params_version hands
+        # out call-order tickets, _params_staged records the newest ticket
+        # whose PREPARED tree actually staged — a failing preparation never
+        # consumes its ticket, so it cannot cancel a concurrent valid update
+        self._params_version = 0
+        self._params_staged = 0
+        self.params = self._prepare_params(params)
 
         self._apply_fn = apply_fn
 
         def call(p, inputs):
+            if is_quantized(p):
+                # traced inside the jit: XLA fuses the int8→compute-dtype
+                # dequant into the matmul operand reads (weight-only int8)
+                p = dequantize_tree(p)
             return apply_fn(p, *inputs)
 
         self._call = call
@@ -282,6 +358,78 @@ class ServingEngine:
             target=self._run, name=f"{name}-engine", daemon=True
         )
         self._thread.start()
+
+    # -- params preparation / hot swap ---------------------------------------
+
+    def _prepare_params(self, params):
+        """:func:`prepare_param_tree` under this engine's mode + device_put.
+
+        Guards BOTH construction and ``update_params``: a pre-quantized tree
+        must match this engine's mode exactly — a mismatched baked compute
+        dtype (or a quantized tree handed to a non-quantized engine) would
+        silently serve a different precision than advertised AND change the
+        params treedef, recompiling every warmed bucket program mid-serving.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from perceiver_io_tpu.quant import is_quantized
+
+        if is_quantized(params):
+            want = str(jnp.dtype(self._compute_dtype or jnp.float32))
+            if self.quantize != "int8" or params.compute_dtype != want:
+                raise ValueError(
+                    f"pre-quantized params (compute_dtype="
+                    f"{params.compute_dtype!r}) do not match this engine's "
+                    f"mode (quantize={self.quantize!r}, compute_dtype="
+                    f"{want!r}) — re-quantize under the engine's mode or "
+                    "pass the raw f32 tree"
+                )
+        return jax.device_put(
+            prepare_param_tree(params, self._compute_dtype, self.quantize)
+        )
+
+    def update_params(self, params) -> None:
+        """Hot-swap the served parameters without recompiling.
+
+        Preparation (the same cast/quantize as construction — hand in the
+        raw f32 tree, not a pre-cast copy) runs on the CALLER thread; the
+        finished tree is installed atomically by the worker between
+        micro-batches. Requests arriving while a (re)quantization is in
+        progress therefore queue normally and are served with whichever
+        complete tree is installed at their dispatch — never a torn one.
+        In-flight dispatches finish on the old params. As long as the new
+        tree matches the old structure/shapes/dtypes (same checkpoint
+        family), the warmed bucket programs are reused without recompiling.
+
+        Concurrent calls resolve in CALL order, not prepare-completion
+        order: each call takes a version ticket up front and only stages its
+        tree if no NEWER call has already staged — a slow (re)quantization
+        of an older tree can never overwrite a newer one, and a call whose
+        preparation RAISES (e.g. a mismatched pre-quantized tree) never
+        consumes its ticket, so it cannot cancel a concurrent valid update.
+        """
+        if self._stop.is_set():
+            raise EngineClosed("update_params() on a closed engine")
+        with self._params_lock:
+            self._params_version += 1
+            version = self._params_version
+        prepared = self._prepare_params(params)  # may raise: nothing consumed
+        with self._params_lock:
+            if version < self._params_staged:
+                return  # a newer update_params call already staged its tree
+            self._params_staged = version
+            self._pending_params = prepared
+        obs.event("engine_params_update_staged", engine=self.name)
+
+    def _install_pending_params(self) -> None:
+        """Worker-only: adopt a staged param tree between micro-batches."""
+        if self._pending_params is None:
+            return
+        with self._params_lock:
+            pending, self._pending_params = self._pending_params, None
+        self.params = pending
+        obs.event("engine_params_update", engine=self.name)
 
     # -- submission ----------------------------------------------------------
 
@@ -392,6 +540,7 @@ class ServingEngine:
 
         try:
             while True:
+                self._install_pending_params()
                 parts = None
                 if len(inflight) < self.max_inflight:
                     # while dispatches are in flight this poll is
@@ -679,6 +828,11 @@ class MLMServer:
     collator's rule, ``resolve_bucket_width``); None = always ``max_seq_len``.
     Each (width, batch-bucket, K-bucket) is one program — ``warmup()``
     compiles them all so steady state never compiles.
+
+    ``quantize='int8'`` (or ``compute_dtype='int8w'``): weight-only int8
+    serving — the checkpoint's f32 params are quantized ONCE here and the
+    single ``QuantizedParams`` copy is shared by all three engines, exactly
+    like the bf16 path shares its one cast copy.
     """
 
     def __init__(
@@ -692,6 +846,7 @@ class MLMServer:
         max_delay_ms: float = 0.0,
         max_inflight: int = 2,
         compute_dtype: Optional[str] = None,
+        quantize: Optional[str] = None,
         registry: Optional[obs.MetricsRegistry] = None,
         heartbeat_deadline_s: Optional[float] = None,
         selfprofile_every: int = 0,
@@ -717,19 +872,17 @@ class MLMServer:
         else:
             self.widths = [max_seq_len]
 
-        # ONE device-resident (optionally bf16) param copy shared by all
-        # three programs — the engines receive committed arrays and their
-        # device_put is a no-op
-        if compute_dtype is not None:
-            import jax.numpy as jnp
-
-            dt = jnp.dtype(compute_dtype)
-            params = jax.tree.map(
-                lambda x: x.astype(dt)
-                if jnp.issubdtype(x.dtype, jnp.floating) else x,
-                params,
-            )
-        params = jax.device_put(params)
+        # ONE device-resident (optionally bf16-cast or int8-quantized) param
+        # copy shared by all three programs — the engines receive committed
+        # arrays and their device_put is a no-op (same mode resolution and
+        # preparation as the engines themselves: resolve_params_mode /
+        # prepare_param_tree, so server and engine can never drift)
+        compute_dtype, quantize = resolve_params_mode(compute_dtype, quantize)
+        self._compute_dtype, self._quantize = compute_dtype, quantize
+        self._update_lock = threading.Lock()
+        params = jax.device_put(
+            prepare_param_tree(params, compute_dtype, quantize)
+        )
 
         def fused_apply(p, token_ids, pad_mask, positions):
             logits, _ = model.apply(
@@ -901,6 +1054,31 @@ class MLMServer:
         return [f.result() for f in futures]
 
     # -- lifecycle -----------------------------------------------------------
+
+    def update_params(self, params) -> None:
+        """Hot-swap the served model across ALL THREE engines from ONE
+        prepared tree (cast/quantized once under the server's mode — not
+        three times), staged on each engine in the same call so the
+        cross-engine mismatch window is one worker-loop iteration, not three
+        independent re-preparations.
+
+        Caveat for latent-cache users: ``CachedLatents`` obtained before the
+        swap were encoded by the OLD weights — decoding them against the new
+        decoder mixes models. Re-``encode()`` after an update.
+
+        Concurrent server-level updates are serialized (one lock around
+        prepare + the three stagings): without it, two racing calls could
+        interleave their per-engine stagings and permanently install
+        DIFFERENT versions on the fused/encoder/decoder paths.
+        """
+        import jax
+
+        with self._update_lock:
+            prepared = jax.device_put(
+                prepare_param_tree(params, self._compute_dtype, self._quantize)
+            )
+            for eng in (self.engine, self.encoder, self.decoder):
+                eng.update_params(prepared)
 
     def warmup(self, batch_buckets: Optional[Sequence[int]] = None,
                query_buckets: Sequence[int] = (1, 2, 4)) -> int:

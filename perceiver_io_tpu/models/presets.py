@@ -64,6 +64,33 @@ def flagship_tpu_mlm(
     )
 
 
+def tiny_mlm(
+    vocab_size: int = 503,
+    max_seq_len: int = 64,
+    num_latents: int = 16,
+    num_channels: int = 32,
+    num_layers: int = 2,
+    num_self_attention_layers_per_block: int = 1,
+    dtype: jnp.dtype = jnp.float32,
+    attn_impl: str = "auto",
+) -> PerceiverMLM:
+    """The CPU-scale twin of the flagship recipe — same code path, minutes
+    not hours. One definition shared by the offline (tier-1) modes of the
+    serving benches (``tools/inference_bench.py --preset tiny``,
+    ``tools/quant_bench.py --cpu``) and the quant parity tests, so the
+    "tiny preset" they all quote is the same model."""
+    return flagship_mlm(
+        vocab_size=vocab_size,
+        max_seq_len=max_seq_len,
+        num_latents=num_latents,
+        num_channels=num_channels,
+        num_layers=num_layers,
+        num_self_attention_layers_per_block=num_self_attention_layers_per_block,
+        dtype=dtype,
+        attn_impl=attn_impl,
+    )
+
+
 def flagship_mlm(
     vocab_size: int = 10003,
     max_seq_len: int = 512,

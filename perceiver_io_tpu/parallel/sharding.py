@@ -40,20 +40,10 @@ from perceiver_io_tpu.parallel.mesh import (
 )
 
 
-def _simple_keystr(path) -> str:
-    """``jax.tree_util.keystr(path, simple=True, separator='/')`` — inlined
-    because not every jax build this runs under has the simple/separator
-    kwargs. Produces the bare-name "/"-joined form the PARAM_RULES regexes
-    match against (``params/encoder/layer_1/.../kernel``)."""
-    parts = []
-    for entry in path:
-        for attr in ("key", "name", "idx"):
-            if hasattr(entry, attr):
-                parts.append(str(getattr(entry, attr)))
-                break
-        else:
-            parts.append(str(entry))
-    return "/".join(parts)
+# The bare-name "/"-joined path rendering the PARAM_RULES regexes match
+# against — ONE definition shared with perceiver_io_tpu.quant (its scale
+# map is keyed by the same rendering; see utils/treepath.py).
+from perceiver_io_tpu.utils.treepath import simple_keystr as _simple_keystr
 
 # (path regex, spec). First match wins; default is fully replicated.
 PARAM_RULES: Sequence[Tuple[str, P]] = (

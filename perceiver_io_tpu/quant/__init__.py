@@ -1,0 +1,30 @@
+"""Weight-only quantization for serving (int8 storage, f32/bf16 compute).
+
+See :mod:`perceiver_io_tpu.quant.int8` for the scheme, the policy, and the
+tree contract (quantized key paths == f32 key paths — sharding rules and
+torch-parity names untouched).
+"""
+
+from perceiver_io_tpu.quant.int8 import (
+    DEFAULT_QUANT_RULES,
+    QuantizedParams,
+    bytes_summary,
+    dequantize_array,
+    dequantize_tree,
+    is_quantized,
+    quantize_array,
+    quantize_tree,
+    tree_bytes,
+)
+
+__all__ = [
+    "DEFAULT_QUANT_RULES",
+    "QuantizedParams",
+    "bytes_summary",
+    "dequantize_array",
+    "dequantize_tree",
+    "is_quantized",
+    "quantize_array",
+    "quantize_tree",
+    "tree_bytes",
+]

@@ -1,0 +1,239 @@
+"""Weight-only int8 quantization for the HBM-bound serving path.
+
+The measured inference roofline (PERF.md, `tools/hbm_roofline.py`) puts the
+binding resource of this workload on HBM param/elementwise streams, not MXU
+FLOPs — every serving micro-batch re-streams the full weight set from HBM.
+Weight-only quantization (LLM.int8(), AWQ) attacks exactly that term: store
+the matmul weights as int8 with per-channel f32 scales (~4x fewer weight
+bytes than f32, ~2x fewer than bf16) and dequantize at apply time, INSIDE
+the jitted program, so XLA fuses the ``convert * scale`` into the matmul
+operand read and the f32/bf16 copy never round-trips HBM. Compute stays in
+the model's compute dtype — this is storage quantization, not int8 matmuls.
+
+Scheme: **per-channel symmetric int8.** For a kernel ``(in, out)`` each
+OUTPUT channel ``j`` gets ``scale[j] = max|w[:, j]| / 127`` (f32) and
+``q[:, j] = round(w[:, j] / scale[j])`` clipped to ±127; dequantization is
+``q * scale`` — elementwise error is bounded by ``scale/2``. Symmetric (no
+zero point) keeps dequant a single fused multiply; per-channel (rather than
+per-tensor) scales keep the quantization grid matched to each output
+column's dynamic range, which is what holds the end-to-end parity error to
+the documented bound (see PERF.md §Quantization).
+
+Policy: quantize the **streamed** weights — 2-D leaves whose path ends in
+``kernel`` (every q/k/v/out_proj, MLP dense_1/dense_2, and the vocab-sized
+head ``linear/kernel``, the single biggest param tensor). GATHERED tables
+(``text_embedding/embedding``, the learned latent/output query arrays,
+``pos_encoding``) stay in compute dtype: a gather touches only the rows it
+reads, while a tree-level dequant would rebuild the full table every
+dispatch — quantizing them would ADD traffic on the HBM-bound path, not
+remove it. Biases and LayerNorm params are 1-D noise.
+
+Tree contract (the invariant everything else leans on): the quantized
+``values`` tree has EXACTLY the key paths of the source f32 tree — int8
+leaves replace f32 kernels in place, scales ride in a separate flat
+``{path: (out,) f32}`` map. Checkpoints stay f32 on disk (quantize at
+load); ``parallel/sharding.py`` path-regex rules resolve against
+``QuantizedParams.values`` unchanged (same paths, same shapes), and the
+torch-parity param names are untouched. The apply-time dequant feeds the
+existing ``_LinearParams`` fusion sites in ``ops/attention.py`` and the
+adapter projections in ``models/`` exactly the tensors they would have read
+from an f32 tree — the model code never sees an int8 array.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# The canonical key-path rendering — the SAME one parallel/sharding.py
+# matches PARAM_RULES against; the scale map is keyed by it.
+from perceiver_io_tpu.utils.treepath import simple_keystr as _simple_keystr
+
+# Path regexes selecting the leaves to quantize (first match wins, like
+# parallel/sharding.PARAM_RULES — and deliberately a SUBSET of the paths
+# those rules shard: every quantized leaf keeps its sharding rule, because
+# the int8 tree re-uses the f32 tree's paths and shapes verbatim).
+DEFAULT_QUANT_RULES: Sequence[str] = (r"kernel$",)
+
+_QMAX = 127.0  # symmetric int8: [-127, 127]; -128 unused (no zero point)
+
+
+def quantize_array(w) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-channel symmetric int8 over the LAST axis: ``(q int8, scale f32)``
+    with ``scale`` shaped like the last dimension. Runs on host numpy — this
+    is one-time load work, not step work."""
+    w = np.asarray(w, np.float32)
+    if w.ndim < 1:
+        raise ValueError("quantize_array needs at least one axis")
+    amax = np.max(np.abs(w), axis=tuple(range(w.ndim - 1)))
+    # an all-zero channel quantizes to zeros under any scale; 1.0 avoids /0
+    scale = np.where(amax > 0, amax / _QMAX, 1.0).astype(np.float32)
+    q = np.clip(np.rint(w / scale), -_QMAX, _QMAX).astype(np.int8)
+    return q, scale
+
+
+def dequantize_array(q, scale, dtype) -> jax.Array:
+    """``q * scale`` in f32, cast to the compute dtype. Traced inside the
+    serving jit: XLA fuses the convert+multiply into the consuming matmul's
+    operand read, so HBM streams the int8 bytes, not a materialized copy."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
+
+
+@jax.tree_util.register_pytree_with_keys_class
+class QuantizedParams:
+    """A params-shaped pytree of int8 weights + their per-channel scales.
+
+    ``values`` mirrors the source tree's key paths exactly (int8 leaves at
+    quantized paths, compute-dtype leaves elsewhere); ``scales`` is a flat
+    ``{path: (out,) f32}`` dict keyed by the same ``/``-joined path strings
+    the sharding rules match. ``compute_dtype`` (static aux data) names the
+    dtype :func:`dequantize_tree` reconstructs.
+    """
+
+    __slots__ = ("values", "scales", "compute_dtype")
+
+    def __init__(self, values: Any, scales: Dict[str, Any], compute_dtype: str):
+        self.values = values
+        self.scales = scales
+        self.compute_dtype = compute_dtype
+
+    def tree_flatten_with_keys(self):
+        return (
+            (
+                (jax.tree_util.GetAttrKey("values"), self.values),
+                (jax.tree_util.GetAttrKey("scales"), self.scales),
+            ),
+            self.compute_dtype,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux_data, children):
+        return cls(children[0], children[1], aux_data)
+
+    def __repr__(self) -> str:
+        return (
+            f"QuantizedParams({len(self.scales)} int8 leaves, "
+            f"compute_dtype={self.compute_dtype!r})"
+        )
+
+
+def is_quantized(tree: Any) -> bool:
+    """True for a tree already prepared by :func:`quantize_tree` (the
+    engine's skip-requantization check when one quantized copy is shared by
+    several engines, e.g. ``MLMServer``'s three program families)."""
+    return isinstance(tree, QuantizedParams)
+
+
+def quantize_tree(
+    params: Any,
+    compute_dtype: str = "float32",
+    rules: Sequence[str] = DEFAULT_QUANT_RULES,
+) -> QuantizedParams:
+    """Quantize a params tree for int8w serving.
+
+    Leaves matching ``rules`` (2-D floating ``kernel`` tensors by default)
+    become int8 with per-output-channel f32 scales computed FROM THE f32
+    SOURCE (never from an already-rounded bf16 copy); every other floating
+    leaf is cast to ``compute_dtype`` (the same cast the bf16 serving path
+    applies). Key paths, shapes, and tree structure are preserved exactly.
+    """
+    compute_dtype = str(jnp.dtype(compute_dtype))
+    compiled = [re.compile(p) for p in rules]
+    scales: Dict[str, Any] = {}
+
+    def convert(path, leaf):
+        name = _simple_keystr(path)
+        # dtype inspection must not touch the device (jnp.asarray would
+        # transfer every leaf just to read .dtype)
+        if not hasattr(leaf, "dtype"):
+            leaf = np.asarray(leaf)
+        is_float = jnp.issubdtype(leaf.dtype, jnp.floating)
+        if (
+            is_float
+            and getattr(leaf, "ndim", 0) == 2
+            and any(p.search(name) for p in compiled)
+        ):
+            q, scale = quantize_array(leaf)
+            scales[name] = jnp.asarray(scale)
+            return jnp.asarray(q)
+        if is_float:
+            return leaf.astype(compute_dtype)
+        return leaf
+
+    values = jax.tree_util.tree_map_with_path(convert, params)
+    if not scales:
+        raise ValueError(
+            "quantize_tree found no quantizable leaves — expected at least "
+            f"one 2-D floating leaf matching {list(rules)}"
+        )
+    return QuantizedParams(values, scales, compute_dtype)
+
+
+def dequantize_tree(qparams: QuantizedParams) -> Any:
+    """Reconstruct a compute-dtype params tree from a quantized one.
+
+    Call this INSIDE the jitted serving forward (``jax.jit(lambda qp, *x:
+    apply(dequantize_tree(qp), *x))``): dequantized kernels are then
+    fusion-local intermediates feeding the ``_LinearParams`` sites, and the
+    program's weight HBM traffic is the int8 bytes. Calling it eagerly
+    outside jit materializes full-size copies and forfeits the win.
+    """
+    if not is_quantized(qparams):
+        raise TypeError(f"expected QuantizedParams, got {type(qparams).__name__}")
+    dtype = jnp.dtype(qparams.compute_dtype)
+
+    def deq(path, leaf):
+        scale = qparams.scales.get(_simple_keystr(path))
+        if scale is None:
+            return leaf
+        return dequantize_array(leaf, scale, dtype)
+
+    return jax.tree_util.tree_map_with_path(deq, qparams.values)
+
+
+def tree_bytes(tree: Any) -> int:
+    """Total parameter bytes of a pytree (``QuantizedParams`` included —
+    its scales count; they are streamed with the weights)."""
+    return sum(
+        int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype")
+    )
+
+
+def bytes_summary(params: Any, qparams: Optional[QuantizedParams] = None,
+                  compute_dtype: str = "bfloat16") -> Dict[str, Any]:
+    """Predicted per-dispatch weight-stream accounting for the quant bench.
+
+    Every serving dispatch streams the full weight set once, so the
+    predicted bytes-per-dispatch ratio IS the byte ratio of the trees:
+    ``int8w_bytes / cast_bytes`` (the bf16-vs-int8w A/B's roofline
+    prediction, checked against the device trace on TPU).
+    """
+    if qparams is None:
+        qparams = quantize_tree(params, compute_dtype=compute_dtype)
+    itemsize = jnp.dtype(compute_dtype).itemsize
+
+    def leaf_cast_bytes(leaf):
+        if not hasattr(leaf, "dtype"):  # python scalars — host-only inspect
+            leaf = np.asarray(leaf)
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            return int(np.prod(leaf.shape)) * itemsize
+        return int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+
+    cast_bytes = sum(
+        leaf_cast_bytes(leaf) for leaf in jax.tree_util.tree_leaves(params)
+    )
+    f32_bytes = tree_bytes(params)
+    int8w_bytes = tree_bytes(qparams)
+    return {
+        "param_bytes_f32": f32_bytes,
+        f"param_bytes_{jnp.dtype(compute_dtype)}": cast_bytes,
+        "param_bytes_int8w": int8w_bytes,
+        "quantized_leaves": len(qparams.scales),
+        "predicted_weight_stream_ratio": round(int8w_bytes / cast_bytes, 4),
+    }

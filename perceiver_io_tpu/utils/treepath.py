@@ -1,0 +1,26 @@
+"""Canonical pytree key-path rendering shared by the path-matching layers.
+
+``simple_keystr`` produces the bare-name "/"-joined form that BOTH
+``parallel/sharding.py``'s PARAM_RULES regexes and
+``perceiver_io_tpu.quant``'s scale map are keyed by. The two must stay
+bit-identical — the quantized-tree contract (scales found at dequant time,
+sharding specs resolving identically on the int8 tree) rides on it — so
+there is exactly ONE definition. Inlined rather than
+``jax.tree_util.keystr(path, simple=True, separator='/')`` because not
+every jax build this runs under has the simple/separator kwargs.
+"""
+
+from __future__ import annotations
+
+
+def simple_keystr(path) -> str:
+    """Bare-name "/"-joined key path (``params/encoder/.../kernel``)."""
+    parts = []
+    for entry in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(entry, attr):
+                parts.append(str(getattr(entry, attr)))
+                break
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)

@@ -165,6 +165,14 @@ def test_serve_cli_end_to_end(tmp_path):
     )
     assert len(bf16[0]["fills"][0]) == 3  # bf16 rounds: presence, not parity
 
+    # weight-only int8 at f32 compute: on this tiny model the top-k picks
+    # match the f32 path (quantization error ≪ the logit gaps)
+    int8w = serve.main(
+        base + ["--quantize", "int8", "--no_warmup",
+                "--texts", "a [MASK] b"]
+    )
+    assert int8w[0]["fills"] == fused[0]["fills"]
+
     with pytest.raises(SystemExit, match="nothing to serve"):
         serve.main(base)
 
@@ -320,8 +328,64 @@ def test_json_emitters_keep_one_line_stdout_contract(tmp_path):
     report = json.loads(lines[0])
     assert report["metric"] == "kernel_smoke" and report["dry"] is True
     assert report["total"] > 0 and report["skipped"]
+    # the weight-only int8 path is registered in the per-round smoke
+    assert "quant-int8w-dequant" in report["skipped"]
     with open(tmp_path / "ks.json") as f:
         assert json.loads(f.read()) == report
+
+
+def test_quant_bench_cpu_emits_one_json_line(tmp_path):
+    """tools/quant_bench.py --cpu runs the interleaved bf16-vs-int8w engine
+    A/B offline and emits EXACTLY one JSON line on stdout (the driver's
+    quant-trajectory contract): throughput both arms, parity error vs the
+    f32 oracle within the documented tiny-preset bound, and the predicted
+    bytes-streamed accounting."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "quant_bench.py"),
+         "--cpu", "--preset", "tiny", "--requests", "8", "--rounds", "1"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    result = json.loads(lines[0])
+    assert result["mode"] == "quant" and result["backend"] == "cpu"
+    for key in ("bf16_requests_per_s", "int8w_requests_per_s",
+                "speedup_int8w_vs_bf16", "parity_bf16_rel_err",
+                "parity_int8w_rel_err", "param_bytes_int8w",
+                "predicted_weight_stream_ratio"):
+        assert key in result, result
+    # the documented tiny-preset parity bound (PERF.md §Quantization)
+    assert result["parity_int8w_rel_err"] <= 0.05, result
+    assert 0 < result["predicted_weight_stream_ratio"] < 1, result
+
+
+def test_bench_backend_probe_emits_json_error_record():
+    """BENCH_r05 regression: with the backend probe unable to answer inside
+    its deadline (deadline 0 simulates the dark-tunnel hang), bench.py must
+    emit ONE JSON error record on stdout — not a raw traceback — and exit
+    nonzero."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py")],
+        env={**os.environ, "PIT_BENCH_CPU": "1",
+             "PIT_BENCH_BACKEND_DEADLINE_S": "0"},
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode != 0
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    record = json.loads(lines[0])
+    assert record["error"] == "tpu_unavailable"
+    assert record["value"] is None
+    assert "reason" in record
 
 
 def test_encode_masked_samples(tmp_path):
@@ -423,7 +487,7 @@ def test_all_parsers_build_and_render_help():
 
     help_text = serve.build_parser().format_help()
     for flag in ("--checkpoint", "--tokenizer", "--bucket_widths", "--dtype",
-                 "--cached", "--max_delay_ms", "--metrics_port",
+                 "--quantize", "--cached", "--max_delay_ms", "--metrics_port",
                  "--heartbeat_deadline_s", "--selfprofile_every",
                  "--events_jsonl", "--cpu"):
         assert flag in help_text, f"serve missing {flag}"

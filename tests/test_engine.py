@@ -2,6 +2,7 @@
 encode/decode latent-cache split, and width-bucketed text serving."""
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -227,6 +228,100 @@ def test_engine_error_propagates_and_engine_survives():
 
     with pytest.raises(EngineClosed):
         eng.submit(np.ones((1, 3), np.float32))
+
+
+def test_engine_update_params_requantize_queues_not_races():
+    """Hot-swapping params on a QUANTIZED engine while submitters hammer it:
+    requests that arrive mid-(re)quantization queue and are served with a
+    COMPLETE tree — every result is consistent with exactly one installed
+    param set (k * row-sum), never a torn mix of old int8 values with new
+    scales. (The quantize-at-load error-isolation satellite.)
+
+    Weights are k * ones(3, 3): per-channel symmetric int8 represents them
+    EXACTLY (w/scale = ±127 on the grid), so any tearing shows up as a
+    result outside the integer-k set, not as quantization noise."""
+
+    def apply_fn(p, x):
+        return x @ p["lin"]["kernel"]
+
+    def params_for(k):
+        return {"lin": {"kernel": np.full((3, 3), float(k), np.float32)}}
+
+    ks = (1, 2, 3, 4, 5)
+    stop = threading.Event()
+    errors = []
+    completed = [0] * 4  # per-client served-request counters (int writes
+    #                      under the GIL; read by the pacing loop below)
+
+    with ServingEngine(
+        apply_fn, params_for(ks[0]), max_batch=8, quantize="int8"
+    ) as eng:
+        eng.warmup(np.zeros((1, 3), np.float32))
+
+        def client(i):
+            rng = np.random.default_rng(i)
+            while not stop.is_set():
+                x = rng.normal(0, 1, (2, 3)).astype(np.float32)
+                out = np.asarray(eng.submit(x).result(timeout=60))
+                completed[i] += 1
+                row_sum = x.sum(axis=1)
+                # out[r, c] must equal k * row_sum[r] for ONE k across the
+                # whole result (a torn tree would mix ratios). Rows with a
+                # small |row_sum| are excluded generously: the division
+                # amplifies f32 summation-order noise, and a torn tree is a
+                # WHOLE-COLUMN integer-ratio flip, not a 1e-3 wiggle.
+                ratios = out / np.where(
+                    np.abs(row_sum[:, None]) < 1e-1, np.nan, row_sum[:, None]
+                )
+                ratios = ratios[np.isfinite(ratios)]
+                if ratios.size == 0:
+                    continue
+                k = np.round(np.median(ratios))
+                if k not in ks or not np.allclose(
+                    ratios, k, rtol=1e-3, atol=1e-3
+                ):
+                    errors.append((k, ratios.min(), ratios.max()))
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+
+        def wait_served(min_total, deadline_s=30.0):
+            # pace the drill so dispatches GENUINELY overlap the staging/
+            # install window — an instantaneous update burst would barely
+            # exercise the queue-not-race property
+            deadline = time.monotonic() + deadline_s
+            while sum(completed) < min_total and time.monotonic() < deadline:
+                time.sleep(0.005)
+
+        wait_served(4)  # every client is in its serving loop
+        # re-quantize repeatedly while the submitters run: preparation on
+        # this (caller) thread, atomic install on the worker thread, with
+        # requests flowing between consecutive swaps
+        served = sum(completed)
+        for _ in range(3):
+            for k in ks:
+                eng.update_params(params_for(k))
+                served += 2
+                wait_served(served)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:5]
+        assert sum(completed) >= served, "drill ended before overlap happened"
+
+        # the LAST staged tree wins once the queue drains
+        x = np.ones((1, 3), np.float32)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            out = np.asarray(eng.submit(x).result(timeout=60))
+            if np.allclose(out, 3.0 * ks[-1]):
+                break
+            time.sleep(0.01)
+        np.testing.assert_allclose(out, 3.0 * ks[-1], rtol=1e-5)
+
+    with pytest.raises(EngineClosed):
+        eng.update_params(params_for(1))
 
 
 def test_engine_bf16_compute_dtype():

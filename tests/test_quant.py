@@ -1,0 +1,307 @@
+"""Weight-only int8 quantization: roundtrip bounds, tree key-path identity,
+engine parity vs the f32 oracle on the tiny preset, and sharding-rule
+resolution against the quantized tree on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu import quant
+from perceiver_io_tpu.models.presets import tiny_mlm
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    model = tiny_mlm()
+    ids = np.zeros((1, 64), np.int32)
+    params = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        jnp.asarray(ids), jnp.asarray(ids == 1),
+    )["params"]
+    return model, params
+
+
+# -- per-channel quant/dequant core -------------------------------------------
+
+
+def test_quantize_array_roundtrip_bound(rng):
+    """Round-to-nearest per-channel symmetric int8: the elementwise
+    reconstruction error is bounded by scale/2, scales are per LAST-axis
+    channel, and channel maxima reconstruct exactly (they sit on the grid)."""
+    for shape in [(8, 16), (64, 32), (128,)]:
+        w = rng.normal(0, 1, shape).astype(np.float32) * rng.uniform(
+            0.01, 10.0, shape[-1]
+        ).astype(np.float32)
+        q, scale = quant.quantize_array(w)
+        assert q.dtype == np.int8 and scale.dtype == np.float32
+        assert scale.shape == (shape[-1],)
+        deq = np.asarray(
+            quant.dequantize_array(jnp.asarray(q), jnp.asarray(scale),
+                                   jnp.float32)
+        )
+        assert np.all(np.abs(deq - w) <= scale / 2 + 1e-7)
+        # the per-channel absolute max is exactly representable: q = ±127
+        amax_idx = np.argmax(np.abs(w.reshape(-1, shape[-1])), axis=0)
+        flat, flat_q = w.reshape(-1, shape[-1]), deq.reshape(-1, shape[-1])
+        np.testing.assert_allclose(
+            flat_q[amax_idx, np.arange(shape[-1])],
+            flat[amax_idx, np.arange(shape[-1])], rtol=1e-6,
+        )
+
+
+def test_quantize_array_zero_channel():
+    """An all-zero channel must not divide by zero and reconstructs to 0."""
+    w = np.zeros((4, 3), np.float32)
+    w[:, 0] = [1, -2, 3, -4]
+    q, scale = quant.quantize_array(w)
+    assert np.all(np.isfinite(scale)) and np.all(scale > 0)
+    deq = np.asarray(quant.dequantize_array(
+        jnp.asarray(q), jnp.asarray(scale), jnp.float32))
+    assert np.all(deq[:, 1:] == 0)
+
+
+# -- tree contract: key paths, dtypes, policy ---------------------------------
+
+
+def _paths(tree):
+    from perceiver_io_tpu.utils.treepath import simple_keystr
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [simple_keystr(p) for p, _ in flat]
+
+
+def test_quantized_tree_mirrors_keypaths(tiny_setup):
+    """The quantized values tree has EXACTLY the f32 tree's key paths and
+    shapes (the invariant sharding rules and torch-parity names ride on);
+    2-D kernels become int8, everything else keeps/casts its float dtype."""
+    _, params = tiny_setup
+    qp = quant.quantize_tree(params, compute_dtype="float32")
+    assert _paths(qp.values) == _paths(params)
+    shapes = jax.tree.map(lambda x: x.shape, params)
+    q_shapes = jax.tree.map(lambda x: x.shape, qp.values)
+    assert shapes == q_shapes
+
+    from perceiver_io_tpu.utils.treepath import simple_keystr
+
+    kernels = [p for p in _paths(params) if p.endswith("kernel")]
+    assert kernels and len(qp.scales) == len(kernels)
+    flat, _ = jax.tree_util.tree_flatten_with_path(qp.values)
+    for path, leaf in flat:
+        name = simple_keystr(path)
+        if name.endswith("kernel"):
+            assert leaf.dtype == jnp.int8, name
+            assert qp.scales[name].shape == (leaf.shape[-1],)
+        else:
+            assert leaf.dtype != jnp.int8, name
+    # gathered tables are deliberately NOT quantized (dequantizing a full
+    # table per dispatch would ADD HBM traffic on the serving path)
+    emb = qp.values["encoder"]["input_adapter"]["text_embedding"]["embedding"]
+    assert emb.dtype == jnp.float32
+
+    # dequant reconstructs the full tree at the compute dtype
+    deq = quant.dequantize_tree(qp)
+    assert _paths(deq) == _paths(params)
+    assert all(
+        leaf.dtype == jnp.float32 for leaf in jax.tree_util.tree_leaves(deq)
+    )
+
+
+def test_quantize_tree_casts_nonquantized_to_compute_dtype(tiny_setup):
+    _, params = tiny_setup
+    qp = quant.quantize_tree(params, compute_dtype="bfloat16")
+    bias = qp.values["decoder"]["output_adapter"]["linear"]["bias"]
+    assert bias.dtype == jnp.bfloat16
+    assert all(s.dtype == jnp.float32 for s in qp.scales.values())
+    acct = quant.bytes_summary(params, qp)
+    assert acct["param_bytes_int8w"] < acct["param_bytes_f32"] / 2
+    assert 0 < acct["predicted_weight_stream_ratio"] < 1
+
+
+# -- engine parity vs the f32 oracle (tiny preset) ----------------------------
+
+
+def test_int8w_engine_parity_vs_f32_oracle(tiny_setup):
+    """The int8w serving path (quantize at load, dequant inside the jitted
+    dispatch) tracks the f32 oracle within the documented bound on the tiny
+    preset: ≤ 0.03 rel-to-peak on the gathered fill-mask logits (measured
+    0.019 — PERF.md §Quantization; the bf16 baseline alone measures 0.009)."""
+    from perceiver_io_tpu.inference import ServingEngine
+
+    model, params = tiny_setup
+    rng = np.random.default_rng(1)
+    ids = rng.integers(3, 503, (4, 64)).astype(np.int32)
+    pad = np.zeros((4, 64), bool)
+    positions = np.tile(np.arange(2, dtype=np.int32), (4, 1))
+
+    def gathered_apply(p, token_ids, pad_mask, pos):
+        logits, _ = model.apply(
+            {"params": p}, token_ids, pad_mask, masking=False,
+            deterministic=True, positions=pos,
+        )
+        return logits
+
+    oracle = np.asarray(
+        jax.jit(gathered_apply)(params, ids, pad, positions), np.float32
+    )
+    peak = float(np.max(np.abs(oracle)))
+
+    # f32 compute over int8 weights: quantization error alone
+    with ServingEngine(
+        gathered_apply, params, max_batch=4, quantize="int8"
+    ) as eng:
+        got = np.asarray(eng.predict(ids, pad, positions, timeout=120),
+                         np.float32)
+        assert float(np.max(np.abs(got - oracle))) / peak <= 0.03
+
+    # the int8w shorthand (bf16 compute + int8 weights): the serving mode
+    with ServingEngine(
+        gathered_apply, params, max_batch=4, compute_dtype="int8w"
+    ) as eng:
+        assert eng.quantize == "int8"
+        assert quant.is_quantized(eng.params)
+        got = np.asarray(eng.predict(ids, pad, positions, timeout=120),
+                         np.float32)
+        assert float(np.max(np.abs(got - oracle))) / peak <= 0.05
+
+
+def test_mlm_server_int8w_top_k_matches_f32(tiny_setup):
+    """MLMServer(quantize='int8') serves fill-mask through ONE shared
+    quantized tree; its top-k token picks on the tiny preset match the f32
+    server (rank stability is the serving-level parity that matters)."""
+    from perceiver_io_tpu.data.tokenizer import (
+        MASK_TOKEN,
+        PAD_TOKEN,
+        UNK_TOKEN,
+        WordPieceTokenizer,
+    )
+    from perceiver_io_tpu.inference import MLMServer
+
+    vocab = {PAD_TOKEN: 0, UNK_TOKEN: 1, MASK_TOKEN: 2}
+    for w in ["movie", "great", "plot", "the", "was", "a", "b"]:
+        vocab[w] = len(vocab)
+    tok = WordPieceTokenizer(vocab=vocab)
+    model = tiny_mlm(vocab_size=tok.get_vocab_size(), max_seq_len=16)
+    ids = np.zeros((1, 16), np.int32)
+    params = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        jnp.asarray(ids), jnp.asarray(ids == 1),
+    )["params"]
+
+    texts = ["the movie was [MASK]", "a [MASK] plot"]
+    with MLMServer(model, params, tok, max_seq_len=16, max_batch=4) as server:
+        want = server.fill_masks(texts, k=3)
+    with MLMServer(
+        model, params, tok, max_seq_len=16, max_batch=4, quantize="int8"
+    ) as server:
+        # all three engines serve the quantized tree (quantized ONCE by the
+        # server; each engine's device_put of committed arrays is a no-op)
+        for eng in (server.engine, server.encoder, server.decoder):
+            assert quant.is_quantized(eng.params)
+            assert eng.quantize == "int8"
+        assert server.warmup() > 0
+        assert server.fill_masks(texts, k=3) == want
+
+
+def test_prequantized_compute_dtype_mismatch_rejected(tiny_setup):
+    """An engine handed a pre-quantized tree whose baked compute dtype
+    differs from the engine's resolved one must fail LOUDLY at construction
+    — silently serving mixed precision (and recompiling every warmed bucket
+    on the next update_params) is the failure mode this guards."""
+    from perceiver_io_tpu.inference import ServingEngine
+
+    _, params = tiny_setup
+    qp = quant.quantize_tree(params, compute_dtype="float32")
+    with pytest.raises(ValueError, match="compute_dtype"):
+        ServingEngine(lambda p, x: x, qp, compute_dtype="bfloat16")
+
+    # the same guard covers the hot-swap path (and a quantized tree handed
+    # to a NON-quantized engine) — update_params must reject, not install
+    with ServingEngine(lambda p, x: x, params) as eng:
+        with pytest.raises(ValueError, match="do not match"):
+            eng.update_params(qp)
+    with ServingEngine(lambda p, x: x, params, quantize="int8") as eng:
+        with pytest.raises(ValueError, match="do not match"):
+            eng.update_params(
+                quant.quantize_tree(params, compute_dtype="bfloat16")
+            )
+    # a typo'd quantize mode is rejected even under the int8w shorthand
+    with pytest.raises(ValueError, match="unknown quantize mode"):
+        ServingEngine(
+            lambda p, x: x, params, compute_dtype="int8w", quantize="int4"
+        )
+
+
+def test_mlm_server_update_params_swaps_all_engines(tiny_setup):
+    """MLMServer.update_params prepares ONE tree under the server's mode and
+    stages it on all three engines — after the swap drains, fills reflect
+    the new weights on the fused AND the latent-cache paths."""
+    import time
+
+    from perceiver_io_tpu.data.tokenizer import (
+        MASK_TOKEN,
+        PAD_TOKEN,
+        UNK_TOKEN,
+        WordPieceTokenizer,
+    )
+    from perceiver_io_tpu.inference import MLMServer
+
+    vocab = {PAD_TOKEN: 0, UNK_TOKEN: 1, MASK_TOKEN: 2}
+    for w in ["movie", "great", "plot", "the", "was"]:
+        vocab[w] = len(vocab)
+    tok = WordPieceTokenizer(vocab=vocab)
+    model = tiny_mlm(vocab_size=tok.get_vocab_size(), max_seq_len=16)
+    ids = np.zeros((1, 16), np.int32)
+
+    def init(seed):
+        return model.init(
+            {"params": jax.random.key(seed), "masking": jax.random.key(1)},
+            jnp.asarray(ids), jnp.asarray(ids == 1),
+        )["params"]
+
+    p_a, p_b = init(0), init(7)
+    text = ["the movie was [MASK]"]
+    with MLMServer(
+        model, p_b, tok, max_seq_len=16, max_batch=4, quantize="int8"
+    ) as server:
+        want_b = server.fill_masks(text, k=3)
+    with MLMServer(
+        model, p_a, tok, max_seq_len=16, max_batch=4, quantize="int8"
+    ) as server:
+        server.fill_masks(text, k=3)
+        server.update_params(p_b)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if server.fill_masks(text, k=3) == want_b:
+                break
+            time.sleep(0.05)
+        assert server.fill_masks(text, k=3) == want_b
+        # the latent-cache path swapped too (fresh encode AFTER the update)
+        cached = server.encode(text)
+        assert server.fill_masks_cached(cached, k=3) == want_b
+
+
+# -- sharding-rule resolution on the quantized tree ---------------------------
+
+
+def test_sharding_rules_resolve_identically_on_quantized_tree(tiny_setup):
+    """parallel/sharding.py path-regex rules resolve the SAME PartitionSpecs
+    on QuantizedParams.values as on the f32 tree (8-device CPU mesh) — the
+    key-path/shape identity doing its job."""
+    from perceiver_io_tpu.parallel import make_mesh
+    from perceiver_io_tpu.parallel.sharding import sharding_for_tree
+
+    _, params = tiny_setup
+    qp = quant.quantize_tree(params, compute_dtype="bfloat16")
+    mesh = make_mesh(dp=4, tp=2)
+    want = jax.tree.map(lambda s: s.spec, sharding_for_tree(params, mesh))
+    got = jax.tree.map(lambda s: s.spec, sharding_for_tree(qp.values, mesh))
+    assert want == got
+    # and the rules actually bit: the q_proj kernel resolved model-sharded
+    # on the int8 tree, not replicated
+    from jax.sharding import PartitionSpec as P
+
+    layer = got["encoder"]["layer_1"]["cross_attention_layer"]
+    assert layer["cross_attention"]["attention"]["q_proj"]["kernel"] == P(
+        None, "model"
+    )

@@ -170,7 +170,7 @@ def _build_engine_model(tiny: bool, dtype_name: str):
     import jax.numpy as jnp
 
     from perceiver_io_tpu.data.tokenizer import create_tokenizer, train_tokenizer
-    from perceiver_io_tpu.models.presets import flagship_mlm
+    from perceiver_io_tpu.models.presets import flagship_mlm, tiny_mlm
 
     rng = np.random.default_rng(0)
     n_words, vocab_target, doc_words, docs = (
@@ -185,13 +185,11 @@ def _build_engine_model(tiny: bool, dtype_name: str):
     ]
     tokenizer = create_tokenizer()
     train_tokenizer(tokenizer, corpus, vocab_size=vocab_target)
-    max_seq_len = 64 if tiny else 512
     dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype_name]
-    model = flagship_mlm(
+    build = tiny_mlm if tiny else flagship_mlm
+    max_seq_len = 64 if tiny else 512
+    model = build(
         vocab_size=tokenizer.get_vocab_size(), max_seq_len=max_seq_len,
-        num_latents=16 if tiny else 256, num_channels=32 if tiny else 64,
-        num_layers=2 if tiny else 3,
-        num_self_attention_layers_per_block=1 if tiny else 6,
         dtype=dtype, attn_impl="auto",
     )
     ids = np.zeros((1, max_seq_len), np.int32)

@@ -30,6 +30,9 @@ Covered paths and what each geometry pins:
 - the sequence-parallel shard_map path compiled on the real chip (1-device
   seq axis — the collective merge compiles and matches; multi-device
   equivalence is CI's job on the 8-device CPU mesh).
+- the weight-only int8 serving path (`perceiver_io_tpu.quant`): in-program
+  dequant (int8 values × f32 per-channel scales → bf16) feeding a matmul,
+  parity-checked against the f32 oracle.
 """
 
 from __future__ import annotations
@@ -114,6 +117,37 @@ def _ce_case(rows, c, vocab, seed=0):
         _assert_close(name, g, r)
 
 
+def _quant_case():
+    """int8w dequant-inside-jit parity on the real compiler: quantize a
+    small kernel tree, run the bf16 matmul over the in-program dequant, and
+    check against the f32 oracle — pins that the convert*scale lowering
+    stays numerically sane as the compiler moves (the serving engines'
+    weight-only path, `perceiver_io_tpu.quant`)."""
+    import jax
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.quant import dequantize_tree, quantize_tree
+
+    rng = np.random.default_rng(0)
+    params = {
+        "dense": {
+            "kernel": rng.normal(0, 1, (256, 512)).astype(np.float32),
+            "bias": rng.normal(0, 0.02, (512,)).astype(np.float32),
+        }
+    }
+    x = jnp.asarray(rng.normal(0, 1, (64, 256)), jnp.bfloat16)
+
+    def apply_fn(p, x):
+        d = p["dense"]
+        return x @ d["kernel"].astype(x.dtype) + d["bias"].astype(x.dtype)
+
+    ref = apply_fn(params, x)
+    qp = quantize_tree(params, compute_dtype="bfloat16")
+
+    got = jax.jit(lambda q, x: apply_fn(dequantize_tree(q), x))(qp, x)
+    _assert_close("int8w-matmul", got, ref)
+
+
 def _sp_case():
     import jax
     import jax.numpy as jnp
@@ -164,6 +198,9 @@ CASES = {
     "ce-padded-rows": lambda: _ce_case(39328, 64, 10003),
     # the shard_map'd sequence-parallel kernel compiled on real hardware
     "sp-shard": _sp_case,
+    # weight-only int8: in-program dequant feeding a bf16 matmul stays
+    # within parity vs the f32 oracle (the serving engines' int8w path)
+    "quant-int8w-dequant": _quant_case,
 }
 
 

@@ -1,0 +1,281 @@
+"""Weight-only int8 serving A/B: bf16 vs int8w through the micro-batching
+engine, with parity vs the f32 oracle and bytes-streamed accounting.
+
+The measured roofline (PERF.md, `tools/hbm_roofline.py`) shows the serving
+forward bound by HBM param/elementwise streams, and every engine dispatch
+re-streams the full weight set — so weight bytes are the lever. This tool
+measures what `perceiver_io_tpu.quant` actually buys, per the PERF.md
+discipline:
+
+1. **Throughput A/B**: same process, interleaved rounds (bf16, int8w,
+   bf16, int8w, ... — the tunnel's ±2x session swing cancels) of the same
+   batch-1 gathered fill-mask request stream through two ``ServingEngine``s
+   that differ ONLY in weight storage (both compute in bf16; int8w
+   dequantizes inside the compiled program).
+2. **Parity**: both arms' logits against the f32 oracle (the golden-parity
+   forward on the identical inputs), reported as max |err| / max |oracle|
+   — the bound documented in PERF.md §Quantization and pinned by
+   ``tests/test_quant.py`` on the same tiny preset.
+3. **Bytes-streamed**: the roofline PREDICTION (param-tree bytes per
+   dispatch: int8 values + f32 scales vs the bf16 cast — every dispatch
+   streams the weights once) and, on TPU, the ACHIEVED per-dispatch HBM
+   bytes from the device trace's per-op ``memory_access_breakdown`` summed
+   inside the engine's StepTraceAnnotation windows (the same analysis
+   `tools/hbm_roofline.py` runs) — prediction vs measurement in one record.
+
+Prints ONE JSON line on stdout (logs on stderr) — the driver-trackable
+contract shared with ``tools/inference_bench.py --engine``. ``--cpu`` pins
+the CPU backend before jax initializes (the tier-1 offline mode, tiny
+preset); TPU runs additionally carry the ``device_*``/``achieved_*`` keys.
+
+Usage::
+
+    timeout 1800 python tools/quant_bench.py [--cpu]
+        [--preset auto|tiny|flagship] [--requests N] [--rounds R]
+        [--max_batch M] [--trace-dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# jax is imported inside main() AFTER --cpu is handled (ensure_cpu_only must
+# run before any backend initializes)
+import numpy as np
+
+
+def _log(*a) -> None:
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _build(tiny: bool):
+    """Tiny/flagship MLM at f32 (the oracle dtype) + a synthetic batch-1
+    gathered fill-mask request stream. No tokenizer: quant parity and the
+    byte stream are properties of the forward, and synthetic token ids keep
+    the tier-1 mode in minutes."""
+    import jax
+
+    from perceiver_io_tpu.models.presets import flagship_mlm, tiny_mlm
+
+    build = tiny_mlm if tiny else flagship_mlm
+    model = build()  # f32: scales quantize from the full-precision tree
+    # read the shapes back off the preset (ONE definition — presets.py)
+    max_seq_len = model.encoder.input_adapter.max_seq_len
+    vocab = model.encoder.input_adapter.vocab_size
+
+    ids = np.zeros((1, max_seq_len), np.int32)
+    variables = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        ids, ids == 1,
+    )
+    return model, variables["params"], max_seq_len, vocab
+
+
+def _requests(n: int, max_seq_len: int, vocab: int):
+    rng = np.random.default_rng(0)
+    ids = rng.integers(3, vocab, (n, max_seq_len)).astype(np.int32)
+    pad = np.zeros((n, max_seq_len), bool)
+    positions = np.stack(
+        [rng.choice(max_seq_len, 2, replace=False) for _ in range(n)]
+    ).astype(np.int32)
+    return [
+        (ids[i: i + 1], pad[i: i + 1], positions[i: i + 1]) for i in range(n)
+    ]
+
+
+def _rel_to_peak_err(got: np.ndarray, ref: np.ndarray) -> float:
+    scale = float(np.max(np.abs(ref))) or 1.0
+    return float(np.max(np.abs(got - ref))) / scale
+
+
+def _trace_hbm_per_dispatch(round_fn, trace_dir: str):
+    """TPU only: per-engine-dispatch HBM bytes + lower-quartile device
+    seconds, from one traced round (each engine dispatch is a
+    StepTraceAnnotation step — the hbm_roofline analysis, reused)."""
+    import jax
+
+    from perceiver_io_tpu.utils.xplane import load_tpu_plane, step_windows
+    from tools.hbm_roofline import HBM_SPACE, parse_memory_breakdown
+
+    with jax.profiler.trace(trace_dir):
+        round_fn()
+    tpu = load_tpu_plane(trace_dir)
+    names = {k: v.name for k, v in tpu.stat_metadata.items()}
+    hbm_by_meta = {}
+    for mid, em in tpu.event_metadata.items():
+        st = {names.get(s.metadata_id): s for s in em.stats}
+        if "memory_access_breakdown" not in st:
+            continue
+        brk = parse_memory_breakdown(st["memory_access_breakdown"].bytes_value)
+        hbm_by_meta[mid] = sum(b for _, sp, b in brk if sp == HBM_SPACE)
+    windows = step_windows(tpu)
+    if not windows:
+        return None, None, 0
+    ops_line = [l for l in tpu.lines if l.name == "XLA Ops"][0]
+    tot_hbm = 0
+    for e in ops_line.events:
+        if any(a <= e.offset_ps < b for a, b in windows):
+            tot_hbm += hbm_by_meta.get(e.metadata_id, 0)
+    durs = sorted(b - a for a, b in windows)
+    lq_s = durs[len(durs) // 4] / 1e12
+    return tot_hbm / len(windows), lq_s, len(windows)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--cpu", action="store_true",
+                        help="pin to the CPU backend (ensure_cpu_only before "
+                             "jax initializes) — the offline/tier-1 mode")
+    parser.add_argument("--preset", choices=["auto", "tiny", "flagship"],
+                        default="auto",
+                        help="model size: auto = flagship on TPU, tiny "
+                             "elsewhere (models/presets.py tiny_mlm)")
+    parser.add_argument("--requests", type=int, default=64,
+                        help="batch-1 requests per round")
+    parser.add_argument("--rounds", type=int, default=4,
+                        help="interleaved A/B rounds")
+    parser.add_argument("--max_batch", type=int, default=32,
+                        help="engine micro-batch cap")
+    parser.add_argument("--trace-dir", default=None,
+                        help="keep TPU traces here instead of a temp dir")
+    args = parser.parse_args()
+
+    if args.cpu:
+        from perceiver_io_tpu.utils.platform import ensure_cpu_only
+
+        ensure_cpu_only()
+    import jax
+
+    from perceiver_io_tpu import quant
+    from perceiver_io_tpu.inference import ServingEngine
+
+    backend = jax.default_backend()
+    tiny = args.preset == "tiny" or (args.preset == "auto" and backend != "tpu")
+    _log(f"backend: {backend}; preset {'tiny' if tiny else 'flagship'}; "
+         f"{args.requests} requests x {args.rounds} rounds")
+
+    model, params, max_seq_len, vocab = _build(tiny)
+    requests = _requests(args.requests, max_seq_len, vocab)
+
+    def gathered_apply(p, token_ids, pad_mask, pos):
+        logits, _ = model.apply(
+            {"params": p}, token_ids, pad_mask, masking=False,
+            deterministic=True, positions=pos,
+        )
+        return logits
+
+    # f32 oracle over the whole stream in one shot (golden-parity path)
+    stacked = tuple(
+        np.concatenate([r[i] for r in requests], axis=0) for i in range(3)
+    )
+    oracle = np.asarray(
+        jax.jit(gathered_apply)(params, *stacked), np.float32
+    )
+
+    bytes_acct = quant.bytes_summary(params, compute_dtype="bfloat16")
+    _log(f"param bytes: f32 {bytes_acct['param_bytes_f32']:,} / bf16 "
+         f"{bytes_acct['param_bytes_bfloat16']:,} / int8w "
+         f"{bytes_acct['param_bytes_int8w']:,} "
+         f"(predicted weight-stream ratio "
+         f"{bytes_acct['predicted_weight_stream_ratio']})")
+
+    engines = {
+        "bf16": ServingEngine(
+            gathered_apply, params, max_batch=args.max_batch,
+            compute_dtype="bfloat16", name="quant_bench_bf16",
+        ),
+        "int8w": ServingEngine(
+            gathered_apply, params, max_batch=args.max_batch,
+            compute_dtype="int8w", name="quant_bench_int8w",
+        ),
+    }
+    try:
+        for name, eng in engines.items():
+            eng.warmup(*requests[0])
+            _log(f"{name}: warmed {eng.num_programs} bucket programs")
+
+        # parity vs the f32 oracle, identical inputs through the engine path
+        parity = {}
+        for name, eng in engines.items():
+            futs = [eng.submit(*r) for r in requests]
+            got = np.concatenate(
+                [np.asarray(f.result(timeout=600), np.float32) for f in futs],
+                axis=0,
+            )
+            parity[name] = _rel_to_peak_err(got, oracle)
+            _log(f"{name}: rel-to-peak parity err vs f32 oracle "
+                 f"{parity[name]:.4g}")
+
+        def engine_round(eng) -> float:
+            t0 = time.perf_counter()
+            futs = [eng.submit(*r) for r in requests]
+            for f in futs:
+                f.result(timeout=600)
+            return time.perf_counter() - t0
+
+        for eng in engines.values():  # unmeasured steady-state round each
+            engine_round(eng)
+        times = {"bf16": [], "int8w": []}
+        for r in range(args.rounds):  # interleaved: A, B, A, B, ...
+            for name, eng in engines.items():
+                times[name].append(engine_round(eng))
+            _log(f"round {r}: bf16 {times['bf16'][-1]:.3f}s "
+                 f"int8w {times['int8w'][-1]:.3f}s")
+        med = {k: statistics.median(v) for k, v in times.items()}
+
+        n = args.requests
+        results = {
+            "mode": "quant", "backend": backend,
+            "preset": "tiny" if tiny else "flagship",
+            "requests": n, "rounds": args.rounds,
+            "max_batch": args.max_batch, "seq_len": max_seq_len,
+            "bf16_requests_per_s": round(n / med["bf16"], 2),
+            "int8w_requests_per_s": round(n / med["int8w"], 2),
+            "speedup_int8w_vs_bf16": round(med["bf16"] / med["int8w"], 3),
+            "parity_bf16_rel_err": round(parity["bf16"], 6),
+            "parity_int8w_rel_err": round(parity["int8w"], 6),
+            **bytes_acct,
+        }
+
+        # achieved bytes-streamed (TPU): trace one round per arm, sum HBM
+        # bytes inside the dispatch step windows — prediction vs measurement
+        if backend == "tpu":
+            trace_root = args.trace_dir or tempfile.mkdtemp(prefix="quant_bench_")
+            for name, eng in engines.items():
+                try:
+                    hbm, lq_s, steps = _trace_hbm_per_dispatch(
+                        lambda e=eng: engine_round(e),
+                        os.path.join(trace_root, name),
+                    )
+                    if hbm is not None:
+                        results[f"achieved_hbm_bytes_per_dispatch_{name}"] = (
+                            int(hbm))
+                        results[f"device_dispatch_lq_ms_{name}"] = round(
+                            lq_s * 1e3, 4)
+                        _log(f"{name}: {steps} traced dispatches, "
+                             f"{hbm / 1e6:.2f} MB HBM/dispatch, "
+                             f"lq {lq_s * 1e3:.3f} ms")
+                except Exception as e:
+                    _log(f"({name} device trace unavailable: "
+                         f"{type(e).__name__}: {str(e)[:120]})")
+            a, b = (results.get("achieved_hbm_bytes_per_dispatch_int8w"),
+                    results.get("achieved_hbm_bytes_per_dispatch_bf16"))
+            if a and b:
+                results["achieved_hbm_ratio_int8w_vs_bf16"] = round(a / b, 4)
+    finally:
+        for eng in engines.values():
+            eng.close()
+
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()
