@@ -104,6 +104,29 @@ def add_trainer_args(parser: argparse.ArgumentParser) -> None:
                         "checkpoint (the preemption last/ slot if present), "
                         "override model args from its hparams, and keep "
                         "logging into the same run directory")
+    g.add_argument("--skip_nonfinite_steps", action="store_true",
+                   help="self-healing: check the loss after EVERY dispatch "
+                        "and SKIP a non-finite step (keep the pre-step state) "
+                        "instead of letting NaN poison the moments; after "
+                        "--rollback_after_bad_steps consecutive bad steps, "
+                        "roll back to the newest checkpoint. Costs one host "
+                        "sync per dispatch and disables state donation "
+                        "(PERF.md §Reliability)")
+    g.add_argument("--rollback_after_bad_steps", type=int, default=3,
+                   help="with --skip_nonfinite_steps: consecutive bad steps "
+                        "before rolling back to the newest checkpoint "
+                        "(0 = skip only, never roll back)")
+    g.add_argument("--dispatch_error_retries", type=int, default=0,
+                   help="self-healing: retry a train dispatch that fails "
+                        "with a TRANSIENT error (tunnel drop, PJRT "
+                        "UNAVAILABLE — never divergence or shape bugs) with "
+                        "exponential backoff, up to N times per step. "
+                        "Implies the per-dispatch host sync. 0 disables")
+    g.add_argument("--fit_attempts", type=int, default=1,
+                   help="self-healing: total fit attempts — on a transient "
+                        "failure that escapes the per-step retries, "
+                        "auto-resume from the newest checkpoint "
+                        "(fit_with_recovery supervisor). 1 = no supervisor")
 
 
 def add_mesh_args(parser: argparse.ArgumentParser) -> None:
@@ -266,7 +289,20 @@ def trainer_config(args) -> TrainerConfig:
         selfprofile_every_n_steps=getattr(
             args, "selfprofile_every_n_steps", 0),
         selfprofile_steps=getattr(args, "selfprofile_steps", 4),
+        skip_nonfinite_steps=getattr(args, "skip_nonfinite_steps", False),
+        rollback_after_bad_steps=getattr(args, "rollback_after_bad_steps", 3),
+        dispatch_error_retries=getattr(args, "dispatch_error_retries", 0),
+        fit_attempts=getattr(args, "fit_attempts", 1),
     )
+
+
+def run_fit(trainer, train_loader, val_loader=None):
+    """Drive ``trainer.fit`` — through the ``fit_with_recovery`` supervisor
+    whenever the config asks for more than one attempt (``--fit_attempts``),
+    so every train CLI gets the auto-resume story from one switch."""
+    if trainer.config.fit_attempts > 1:
+        return trainer.fit_with_recovery(train_loader, val_loader)
+    return trainer.fit(train_loader, val_loader)
 
 
 def optimizer_from_args(args):

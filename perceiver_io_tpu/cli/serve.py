@@ -32,6 +32,14 @@ f32 on disk; parity error vs the f32 oracle is bounded and measured
 ``--heartbeat_deadline_s`` arms the wedged-tunnel dispatch heartbeat;
 ``--selfprofile_every`` turns on the in-loop device-trace watchdog. All
 telemetry output rides stderr/HTTP — stdout stays one JSON line per text.
+
+Self-healing (``perceiver_io_tpu.resilience``, PERF.md §Reliability):
+``--request_deadline_s`` sheds requests whose deadline expires before
+dispatch, ``--queue_limit`` bounds the queue with fast-fail load shedding,
+``--dispatch_retries`` re-dispatches transiently-failed micro-batches with
+backoff, and ``--breaker_failures``/``--breaker_cooldown_s`` arm the circuit
+breaker (consecutive failures or a heartbeat stall open it; submissions
+fast-fail until a half-open probe succeeds; state rides /metrics + /healthz).
 """
 
 from __future__ import annotations
@@ -90,6 +98,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "requests then pay the compiles)")
     g.add_argument("--stats", action="store_true",
                    help="print engine stats to stderr on exit")
+    r = parser.add_argument_group(
+        "resilience (PERF.md §Reliability: retry/shed/breaker semantics)")
+    r.add_argument("--request_deadline_s", type=float, default=None,
+                   help="per-request deadline: a request still waiting for "
+                        "dispatch past this is SHED with DeadlineExceeded "
+                        "(at admission and batch assembly) instead of "
+                        "occupying the queue as dead work. Default: none")
+    r.add_argument("--queue_limit", type=int, default=None,
+                   help="bounded queue: admission fast-fails with "
+                        "RejectedError once this many micro-batch parts are "
+                        "backlogged (explicit load shedding instead of "
+                        "unbounded growth). Default: unbounded")
+    r.add_argument("--dispatch_retries", type=int, default=2,
+                   help="transient dispatch/completion failures re-dispatch "
+                        "the micro-batch with exponential backoff up to this "
+                        "many times before failing its requests (the error "
+                        "taxonomy never retries fatal errors). 0 disables")
+    r.add_argument("--breaker_failures", type=int, default=0,
+                   help="circuit breaker: open after this many CONSECUTIVE "
+                        "dispatch failures (or a heartbeat stall) and "
+                        "fast-fail submissions until a cooldown probe "
+                        "succeeds; state exported to /metrics and /healthz. "
+                        "0 disables (default)")
+    r.add_argument("--breaker_cooldown_s", type=float, default=5.0,
+                   help="seconds an open breaker fast-fails before admitting "
+                        "a half-open probe")
     o = parser.add_argument_group("observability")
     o.add_argument("--metrics_port", type=int, default=None,
                    help="start the localhost observability sidecar on this "
@@ -172,6 +206,11 @@ def _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint):
         quantize=None if args.quantize == "none" else args.quantize,
         heartbeat_deadline_s=args.heartbeat_deadline_s,
         selfprofile_every=args.selfprofile_every,
+        request_deadline_s=args.request_deadline_s,
+        queue_limit=args.queue_limit,
+        dispatch_retries=args.dispatch_retries,
+        breaker_failures=args.breaker_failures,
+        breaker_cooldown_s=args.breaker_cooldown_s,
     ) as server:
         if not args.no_warmup:
             n = server.warmup()

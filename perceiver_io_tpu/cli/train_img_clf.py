@@ -84,7 +84,7 @@ def main(argv: Optional[Sequence[str]] = None):
         run_dir=resume_dir,
     )
     with trainer:
-        trainer.fit(data.train_dataloader(), data.val_dataloader())
+        common.run_fit(trainer, data.train_dataloader(), data.val_dataloader())
     return trainer.run_dir
 
 

@@ -233,7 +233,7 @@ def main(argv: Optional[Sequence[str]] = None):
         tokens_per_example=args.max_seq_len,
     )
     with trainer:
-        trainer.fit(data.train_dataloader(), data.val_dataloader())
+        common.run_fit(trainer, data.train_dataloader(), data.val_dataloader())
     return trainer.run_dir
 
 

@@ -12,12 +12,20 @@ data is absent, and a network failure surfaces one clear error naming the
 offline alternatives (pre-placing the tree, or ``--synthetic``). On a
 zero-egress box the guarded path is exercised by tests against a localhost
 HTTP server.
+
+Transient HTTP failures (5xx responses, reset/aborted connections, read
+timeouts) are retried per-URL with capped exponential backoff
+(``perceiver_io_tpu.resilience.retry`` — no jax import) before falling
+through to the next mirror; deterministic failures (404, refused connection
+on an offline box, checksum mismatch) fail immediately so ``--no_download``
+and the offline fast-fail stay instant.
 """
 
 from __future__ import annotations
 
 import gzip
 import hashlib
+import http.client
 import os
 import shutil
 import tarfile
@@ -25,6 +33,13 @@ import tempfile
 import urllib.error
 import urllib.request
 from typing import Optional, Sequence
+
+from perceiver_io_tpu.resilience.retry import (
+    FATAL,
+    TRANSIENT,
+    RetryPolicy,
+    call_with_retry,
+)
 
 # Stanford AI original; the only canonical source (what torchtext fetches),
 # with torchtext's pinned md5 for the tarball.
@@ -51,6 +66,30 @@ class DownloadError(RuntimeError):
     """A dataset could not be fetched (offline box, dead mirror, bad hash)."""
 
 
+# capped exponential backoff for per-URL transient retries; small base so the
+# offline/tier-1 paths stay fast even when a retry does fire
+HTTP_RETRY_POLICY = RetryPolicy(max_retries=2, base_s=0.2, multiplier=2.0,
+                                max_s=2.0, jitter=0.25)
+
+
+def _classify_http_error(exc: BaseException) -> str:
+    """Transient = worth re-asking the SAME url: server-side 5xx, dropped or
+    half-read connections, read timeouts. Everything else (404, DNS failure,
+    connection refused on an offline box, checksum mismatch) is fatal for
+    this url — fall through to the next mirror immediately."""
+    if isinstance(exc, urllib.error.HTTPError):
+        return TRANSIENT if exc.code >= 500 else FATAL
+    if isinstance(exc, urllib.error.URLError):
+        reason = exc.reason
+        return (_classify_http_error(reason)
+                if isinstance(reason, BaseException) else FATAL)
+    if isinstance(exc, (ConnectionResetError, ConnectionAbortedError,
+                        BrokenPipeError, http.client.IncompleteRead,
+                        http.client.RemoteDisconnected, TimeoutError)):
+        return TRANSIENT
+    return FATAL
+
+
 def _md5(path: str) -> str:
     digest = hashlib.md5()
     with open(path, "rb") as f:
@@ -60,40 +99,57 @@ def _md5(path: str) -> str:
 
 
 def download_file(
-    url: str, dest: str, md5: Optional[str] = None, timeout: float = 60.0
+    url: str, dest: str, md5: Optional[str] = None, timeout: float = 60.0,
+    retry_policy: RetryPolicy = HTTP_RETRY_POLICY,
 ) -> str:
-    """Fetch ``url`` to ``dest`` atomically; verify ``md5`` when given."""
+    """Fetch ``url`` to ``dest`` atomically; verify ``md5`` when given.
+
+    Transient failures (5xx, reset connections, read timeouts) re-fetch the
+    url up to ``retry_policy.max_retries`` times with capped exponential
+    backoff; each attempt writes a fresh temp file, so a half-downloaded
+    attempt never leaks into the next one (or into ``dest``).
+    """
     os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(dest) or ".", suffix=".part")
-    try:
-        with os.fdopen(fd, "wb") as out, urllib.request.urlopen(
-            url, timeout=timeout
-        ) as resp:
-            shutil.copyfileobj(resp, out)
-        if md5 is not None:
-            got = _md5(tmp)
-            if got != md5:
-                raise DownloadError(
-                    f"checksum mismatch for {url}: expected {md5}, got {got}"
-                )
-        os.replace(tmp, dest)
-        return dest
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+
+    def fetch() -> str:
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(dest) or ".", suffix=".part"
+        )
+        try:
+            with os.fdopen(fd, "wb") as out, urllib.request.urlopen(
+                url, timeout=timeout
+            ) as resp:
+                shutil.copyfileobj(resp, out)
+            if md5 is not None:
+                got = _md5(tmp)
+                if got != md5:
+                    raise DownloadError(
+                        f"checksum mismatch for {url}: expected {md5}, got {got}"
+                    )
+            os.replace(tmp, dest)
+            return dest
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    return call_with_retry(
+        fetch, policy=retry_policy, classify=_classify_http_error,
+    )
 
 
 def download_any(
     urls: Sequence[str], dest: str, md5: Optional[str] = None,
-    timeout: float = 60.0,
+    timeout: float = 60.0, retry_policy: RetryPolicy = HTTP_RETRY_POLICY,
 ) -> str:
-    """Try each mirror in order; raise :class:`DownloadError` naming every
-    failure if none succeeds."""
+    """Try each mirror in order (each with its own transient-retry budget);
+    raise :class:`DownloadError` naming every failure if none succeeds."""
     failures = []
     for url in urls:
         try:
-            return download_file(url, dest, md5=md5, timeout=timeout)
-        except (urllib.error.URLError, OSError, DownloadError) as e:
+            return download_file(url, dest, md5=md5, timeout=timeout,
+                                 retry_policy=retry_policy)
+        except (urllib.error.URLError, OSError, DownloadError,
+                http.client.HTTPException) as e:
             failures.append(f"{url}: {e}")
     raise DownloadError(
         "all mirrors failed:\n  " + "\n  ".join(failures)

@@ -15,6 +15,11 @@ from perceiver_io_tpu.inference.engine import (
     MLMServer,
     ServingEngine,
 )
+from perceiver_io_tpu.resilience import (
+    BreakerOpen,
+    DeadlineExceeded,
+    RejectedError,
+)
 
 __all__ = [
     "Predictor",
@@ -25,8 +30,11 @@ __all__ = [
     "MLMPredictor",
     "encode_masked_texts",
     "load_mlm_checkpoint",
+    "BreakerOpen",
     "CachedLatents",
+    "DeadlineExceeded",
     "EngineClosed",
     "MLMServer",
+    "RejectedError",
     "ServingEngine",
 ]

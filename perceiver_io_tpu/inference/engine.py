@@ -53,6 +53,29 @@ recompiling: preparation runs on the caller thread and the worker installs
 the finished tree atomically between micro-batches, so requests that arrive
 mid-(re)quantization queue against the old params rather than racing a
 half-built tree.
+
+Self-healing (``perceiver_io_tpu.resilience``): the engine assumes the
+device can misbehave the way the tunneled backend actually does —
+
+- **request deadlines** (``request_deadline_s`` / ``submit(deadline_s=)``):
+  enforced at admission (an already-expired deadline is refused) and again
+  at batch assembly, where expired parts are shed with
+  :class:`~perceiver_io_tpu.resilience.DeadlineExceeded` instead of burning
+  a dispatch on work whose caller's ``result(timeout=)`` already gave up;
+- **bounded queue** (``queue_limit``): admission fast-fails with
+  :class:`~perceiver_io_tpu.resilience.RejectedError` once that many parts
+  are backlogged — explicit load shedding instead of unbounded queue growth;
+- **transient re-dispatch** (``dispatch_retries``): a dispatch or completion
+  failure the taxonomy classifies transient re-queues the micro-batch with
+  exponential backoff instead of failing every rider's future;
+- **circuit breaker** (``breaker_failures`` > 0): consecutive dispatch
+  failures — or a heartbeat stall, via the monitor's ``on_stall`` hook —
+  open it; submissions then fast-fail
+  (:class:`~perceiver_io_tpu.resilience.BreakerOpen`) until a cooldown
+  half-open probe succeeds. State rides the obs registry and ``/healthz``.
+
+Shed/retry/breaker counts export as ``serving_shed_total{reason=...}`` /
+``serving_dispatch_retries_total`` / ``breaker_*``.
 """
 
 from __future__ import annotations
@@ -67,6 +90,15 @@ import numpy as np
 
 import perceiver_io_tpu.obs as obs
 from perceiver_io_tpu.inference.predictor import bucket_size
+from perceiver_io_tpu.resilience import (
+    BreakerOpen,
+    CircuitBreaker,
+    DeadlineExceeded,
+    RejectedError,
+    RetryPolicy,
+    faults,
+    is_transient,
+)
 
 _IDLE_POLL_S = 0.05  # worker wake-up cadence while idle (checks shutdown)
 
@@ -181,18 +213,26 @@ class _Future:
 
 
 class _Part:
-    """One queue unit: ≤ max_batch rows of one request."""
+    """One queue unit: ≤ max_batch rows of one request.
 
-    __slots__ = ("inputs", "n", "key", "future", "index", "t_submit")
+    ``deadline`` (monotonic, or None) is checked at batch assembly — expired
+    parts are shed, never dispatched. ``retries`` counts transient
+    re-dispatch cycles this part has ridden (worker-thread-only writes).
+    """
+
+    __slots__ = ("inputs", "n", "key", "future", "index", "t_submit",
+                 "deadline", "retries")
 
     def __init__(self, inputs: List[np.ndarray], key, future: _Future,
-                 index: int):
+                 index: int, deadline: Optional[float] = None):
         self.inputs = inputs
         self.n = inputs[0].shape[0]
         self.key = key
         self.future = future
         self.index = index
         self.t_submit = time.monotonic()
+        self.deadline = deadline
+        self.retries = 0
 
 
 class ServingEngine:
@@ -241,6 +281,12 @@ class ServingEngine:
         registry: Optional[obs.MetricsRegistry] = None,
         heartbeat_deadline_s: Optional[float] = None,
         selfprofile_every: int = 0,
+        request_deadline_s: Optional[float] = None,
+        queue_limit: Optional[int] = None,
+        dispatch_retries: int = 2,
+        retry_policy: Optional[RetryPolicy] = None,
+        breaker_failures: int = 0,
+        breaker_cooldown_s: float = 5.0,
     ):
         import jax
         import jax.numpy as jnp
@@ -251,10 +297,22 @@ class ServingEngine:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        if request_deadline_s is not None and request_deadline_s <= 0:
+            raise ValueError(
+                f"request_deadline_s must be positive, got {request_deadline_s}"
+            )
+        if queue_limit is not None and queue_limit < 1:
+            raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
         self.max_batch = max_batch
         self.max_delay = max_delay_ms / 1e3
         self.max_inflight = max_inflight
         self.name = name
+        self.request_deadline_s = request_deadline_s
+        self.queue_limit = queue_limit
+        self._retry_policy = (
+            retry_policy if retry_policy is not None
+            else RetryPolicy(max_retries=max(0, int(dispatch_retries)))
+        )
         compute_dtype, quantize = resolve_params_mode(compute_dtype, quantize)
         if is_quantized(params):
             # a pre-quantized tree (MLMServer shares ONE across its engines)
@@ -342,10 +400,35 @@ class ServingEngine:
             "serving_admission_wait_seconds",
             "submit → dispatch wait per request part", labels)
         self._latency_hists: Dict[int, obs.Histogram] = {}
+        shed_help = "requests/parts shed instead of served, by reason"
+        self._m_shed = {
+            reason: reg.counter("serving_shed_total", shed_help,
+                                {**labels, "reason": reason})
+            for reason in ("queue_full", "breaker_open", "deadline")
+        }
+        self._m_retries = reg.counter(
+            "serving_dispatch_retries_total",
+            "transient micro-batch re-dispatch cycles", labels)
+        self._backlog = 0  # parts admitted but not yet dispatched/shed
+                           # (written under _stats_lock)
+
+        self.breaker: Optional[CircuitBreaker] = None
+        if breaker_failures > 0:
+            self.breaker = CircuitBreaker(
+                name=name, failure_threshold=breaker_failures,
+                cooldown_s=breaker_cooldown_s, registry=reg,
+            )
 
         self.heartbeat = obs.Heartbeat(
             f"{name}-dispatch", deadline_s=heartbeat_deadline_s,
             diagnostics=self._diagnostics,
+            # a wedged dispatch never FAILS — only the stall monitor can see
+            # it; tripping the breaker makes submission fast-fail while the
+            # worker is stuck inside the hung device call
+            on_stall=(
+                (lambda: self.breaker.trip("heartbeat stall (wedged dispatch)"))
+                if self.breaker is not None else None
+            ),
         )
         self._profiler: Optional[obs.SelfProfiler] = None
         if selfprofile_every > 0:
@@ -353,6 +436,7 @@ class ServingEngine:
                 every_n=selfprofile_every, prefix=name, registry=reg
             )
 
+        self._crash: Optional[BaseException] = None
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name=f"{name}-engine", daemon=True
@@ -410,7 +494,7 @@ class ServingEngine:
         consumes its ticket, so it cannot cancel a concurrent valid update.
         """
         if self._stop.is_set():
-            raise EngineClosed("update_params() on a closed engine")
+            raise self._closed_error("update_params()")
         with self._params_lock:
             self._params_version += 1
             version = self._params_version
@@ -433,12 +517,47 @@ class ServingEngine:
 
     # -- submission ----------------------------------------------------------
 
-    def submit(self, *inputs, transform: Optional[Callable] = None) -> _Future:
+    def _closed_error(self, verb: str = "submit()") -> EngineClosed:
+        """EngineClosed naming WHY the engine is closed; a worker crash is
+        chained as ``__cause__`` so post-crash callers see the root error,
+        not just 'closed'."""
+        if self._crash is not None:
+            err = EngineClosed(
+                f"{verb} on a crashed engine (worker died: "
+                f"{type(self._crash).__name__}: {self._crash})"
+            )
+            err.__cause__ = self._crash
+            return err
+        return EngineClosed(f"{verb} on a closed engine")
+
+    def submit(self, *inputs, transform: Optional[Callable] = None,
+               deadline_s: Optional[float] = None) -> _Future:
         """Enqueue one request (arrays sharing a leading batch axis); returns
         a future whose ``result()`` is the output pytree sliced to this
-        request's rows (numpy, on host)."""
+        request's rows (numpy, on host).
+
+        ``deadline_s`` (default: the engine's ``request_deadline_s``) bounds
+        how long the request may wait for a dispatch: an expired request is
+        shed with :class:`DeadlineExceeded` at admission or batch assembly
+        instead of occupying the queue as dead work. Admission can also
+        fast-fail with :class:`RejectedError` (queue full) or
+        :class:`BreakerOpen` (device presumed down).
+        """
         if self._stop.is_set():
-            raise EngineClosed("submit() on a closed engine")
+            raise self._closed_error()
+        if self.breaker is not None and not self.breaker.allow():
+            self._m_shed["breaker_open"].inc()
+            raise BreakerOpen(
+                f"engine {self.name!r}: circuit breaker open "
+                f"(device presumed down; cooldown {self.breaker.cooldown_s:g}s)"
+            )
+        if deadline_s is None:
+            deadline_s = self.request_deadline_s
+        if deadline_s is not None and deadline_s <= 0:
+            self._m_shed["deadline"].inc()
+            raise DeadlineExceeded(
+                f"request deadline {deadline_s}s already expired at admission"
+            )
         arrays = [np.asarray(x) for x in inputs]
         if not arrays:
             raise ValueError("submit() needs at least one input array")
@@ -450,18 +569,39 @@ class ServingEngine:
             fut._deliver(0, self._empty_result(arrays))
             return fut
         starts = list(range(0, n, self.max_batch))
+        # backlog is tracked unconditionally (diagnostics read it); the
+        # bound is only ENFORCED when queue_limit is set
+        with self._stats_lock:
+            if (self.queue_limit is not None
+                    and self._backlog + len(starts) > self.queue_limit):
+                backlog = self._backlog
+                admitted = False
+            else:
+                self._backlog += len(starts)
+                admitted = True
+        if not admitted:
+            self._m_shed["queue_full"].inc()
+            raise RejectedError(
+                f"engine {self.name!r}: queue full ({backlog} parts "
+                f"backlogged, limit {self.queue_limit}) — request shed"
+            )
         fut = _Future(len(starts), transform)
+        deadline = (
+            None if deadline_s is None else time.monotonic() + deadline_s
+        )
         with self._stats_lock:
             self._stats["requests"] += 1
         self._m_requests.inc()
         for index, start in enumerate(starts):
             chunk = [a[start: start + self.max_batch] for a in arrays]
-            self._queue.put(_Part(chunk, self._key(chunk), fut, index))
+            self._queue.put(
+                _Part(chunk, self._key(chunk), fut, index, deadline=deadline)
+            )
         self._m_queue.set(self._queue.qsize())
         if self._stop.is_set() and not self._thread.is_alive():
             # raced a shutdown/worker-crash: the drain already ran, so these
             # parts would sit unread forever — fail the future ourselves
-            fut._fail(EngineClosed("engine stopped while request was queued"))
+            fut._fail(self._closed_error("request queued"))
         return fut
 
     def predict(self, *inputs, timeout: Optional[float] = None):
@@ -548,14 +688,20 @@ class ServingEngine:
                     # window
                     parts = self._next_batch(0.0 if inflight else _IDLE_POLL_S)
                 if parts is not None:
+                    with self._stats_lock:
+                        self._backlog -= len(parts)
+                    # assembly-side deadline enforcement: a part whose caller
+                    # already gave up must not burn a dispatch
+                    parts = self._shed_expired(parts)
+                    if not parts:
+                        continue
                     # armed BEFORE the dispatch call: a wedged tunnel can
                     # hang the dispatch itself, not just the completion
                     self.heartbeat.arm()
                     try:
                         inflight.append((self._dispatch(parts), parts))
-                    except BaseException as e:  # bad batch: fail it, live on
-                        for p in parts:
-                            p.future._fail(e)
+                    except BaseException as e:  # bad batch: retry or fail it
+                        self._batch_failed(parts, e, where="dispatch")
                     _note_inflight()
                     if self._profiler is not None:
                         self._profiler.tick(sync=_sync_inflight)
@@ -571,7 +717,9 @@ class ServingEngine:
         except BaseException as e:
             # the worker must never die with futures outstanding — a caller
             # blocked in result() with no timeout would hang forever. Fail
-            # everything queued/pending/in flight, then stop accepting.
+            # everything queued/pending/in flight, record the cause (so
+            # submit() raises EngineClosed chained from it), stop accepting.
+            self._crash = e
             self._stop.set()
             self.heartbeat.disarm()
             obs.event("engine_worker_crash", engine=self.name,
@@ -588,7 +736,65 @@ class ServingEngine:
                     self._queue.get_nowait().future._fail(e)
                 except queue.Empty:
                     break
+            with self._stats_lock:
+                self._backlog = 0
             raise
+
+    def _shed_expired(self, parts: List[_Part]) -> List[_Part]:
+        """Worker-only: drop parts whose deadline passed; their futures fail
+        with :class:`DeadlineExceeded` (a terminal result — the caller's
+        ``result(timeout=)`` has almost certainly given up already, and the
+        part must not occupy a dispatch)."""
+        now = time.monotonic()
+        alive = []
+        for p in parts:
+            if p.deadline is not None and now >= p.deadline:
+                self._m_shed["deadline"].inc()
+                obs.event("engine_request_shed", engine=self.name,
+                          reason="deadline",
+                          waited_s=round(now - p.t_submit, 4))
+                p.future._fail(DeadlineExceeded(
+                    f"request deadline expired before dispatch "
+                    f"(waited {now - p.t_submit:.3f}s in engine "
+                    f"{self.name!r})"
+                ))
+            else:
+                alive.append(p)
+        return alive
+
+    def _batch_failed(self, parts: List[_Part], error: BaseException,
+                      where: str) -> None:
+        """Worker-only: a micro-batch dispatch (or its completion fetch)
+        raised. Transient errors re-queue the parts — with backoff, at the
+        front of their key's line — up to the retry budget, so one flaky
+        dispatch no longer fails every rider's future; fatal errors (and
+        exhausted budgets) fail the futures and feed the breaker."""
+        if self.breaker is not None:
+            self.breaker.record_failure(error)
+        policy = self._retry_policy
+        retries = parts[0].retries
+        if (retries < policy.max_retries and is_transient(error)
+                and not self._stop.is_set()):
+            for p in parts:
+                p.retries += 1
+            self._m_retries.inc()
+            with self._stats_lock:
+                self._backlog += len(parts)  # back into the admission count
+            pause = policy.backoff_s(retries + 1)
+            obs.event("engine_dispatch_retry", engine=self.name, where=where,
+                      error=type(error).__name__, retry=retries + 1,
+                      backoff_s=round(pause, 4))
+            if pause > 0:
+                self._stop.wait(pause)
+            # front of the key's deque: retried work keeps its place in line
+            self._pending.setdefault(parts[0].key, deque()).extendleft(
+                reversed(parts)
+            )
+            return
+        obs.event("engine_batch_failed", engine=self.name, where=where,
+                  error=type(error).__name__, retries=retries)
+        for p in parts:
+            p.future._fail(error)
 
     def _absorb(self, part: _Part) -> None:
         self._pending.setdefault(part.key, deque()).append(part)
@@ -667,6 +873,7 @@ class ServingEngine:
             return self._jitted(self.params, cols)
 
     def _dispatch(self, parts: List[_Part]):
+        faults.inject("engine.dispatch")  # chaos hook: no-op unless installed
         n = sum(p.n for p in parts)
         bucket = bucket_size(n, self.max_batch)
         num_inputs = len(parts[0].inputs)
@@ -713,11 +920,13 @@ class ServingEngine:
 
         out, bucket = out_bucket
         try:
+            faults.inject("engine.complete")  # chaos hook
             host = jax.tree.map(np.asarray, jax.device_get(out))
         except BaseException as e:
-            for p in parts:
-                p.future._fail(e)
+            self._batch_failed(parts, e, where="complete")
             return
+        if self.breaker is not None:
+            self.breaker.record_success()
         now = time.monotonic()
         hist = self._latency_hist(bucket)
         latencies = []
@@ -771,10 +980,15 @@ class ServingEngine:
         a wedged worker cannot be asked to cooperate)."""
         snap = self.stats()
         snap.pop("latency_s_by_bucket", None)
+        with self._stats_lock:
+            backlog = self._backlog
         return {
             "queue_parts": self._queue.qsize(),
             "pending_keys": len(self._pending),
             "inflight": self._inflight_count,
+            "backlog_parts": backlog,
+            "breaker": (self.breaker.state if self.breaker is not None
+                        else "absent"),
             "programs": len(self._programs),
             "stats": snap,
         }
@@ -784,6 +998,8 @@ class ServingEngine:
         self._stop.set()
         self._thread.join(timeout)
         self.heartbeat.close()
+        if self.breaker is not None:
+            self.breaker.close()
         if self._profiler is not None:
             self._profiler.close()
         # a submit() racing close() can slip a part in after the worker
@@ -850,6 +1066,11 @@ class MLMServer:
         registry: Optional[obs.MetricsRegistry] = None,
         heartbeat_deadline_s: Optional[float] = None,
         selfprofile_every: int = 0,
+        request_deadline_s: Optional[float] = None,
+        queue_limit: Optional[int] = None,
+        dispatch_retries: int = 2,
+        breaker_failures: int = 0,
+        breaker_cooldown_s: float = 5.0,
     ):
         import jax
 
@@ -908,6 +1129,12 @@ class MLMServer:
             max_inflight=max_inflight, compute_dtype=compute_dtype,
             registry=registry, heartbeat_deadline_s=heartbeat_deadline_s,
             selfprofile_every=selfprofile_every,
+            # resilience knobs: per-engine breakers (labeled by engine name)
+            # over the shared device, shared deadline/shed/retry policy
+            request_deadline_s=request_deadline_s, queue_limit=queue_limit,
+            dispatch_retries=dispatch_retries,
+            breaker_failures=breaker_failures,
+            breaker_cooldown_s=breaker_cooldown_s,
         )
         # fused single-pass path (one-shot requests) + the split pair
         # (latent-cache workloads); each engine owns one program family

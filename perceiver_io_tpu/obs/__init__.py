@@ -19,7 +19,13 @@ Importing this package never initializes a jax backend — entry points stay
 free to pick their platform (``ensure_cpu_only``) first.
 """
 
-from perceiver_io_tpu.obs.health import Heartbeat, healthz, thread_stacks
+from perceiver_io_tpu.obs.health import (
+    Heartbeat,
+    healthz,
+    register_health_source,
+    thread_stacks,
+    unregister_health_source,
+)
 from perceiver_io_tpu.obs.http import ObsServer
 from perceiver_io_tpu.obs.registry import (
     Counter,
@@ -55,7 +61,9 @@ __all__ = [
     "healthz",
     "install_compile_counter",
     "is_export_process",
+    "register_health_source",
     "sanitize_metric_name",
     "span",
     "thread_stacks",
+    "unregister_health_source",
 ]

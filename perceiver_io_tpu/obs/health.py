@@ -27,6 +27,24 @@ from perceiver_io_tpu.obs import tracing
 _HEARTBEATS: "weakref.WeakSet[Heartbeat]" = weakref.WeakSet()
 _HEARTBEATS_LOCK = threading.Lock()
 
+# Non-heartbeat health contributors (circuit breakers, future sources):
+# anything exposing health_status() -> (name, ok, detail). Registered by the
+# resilience layer; obs stays free of upward imports.
+_SOURCES: "weakref.WeakSet" = weakref.WeakSet()
+_SOURCES_LOCK = threading.Lock()
+
+
+def register_health_source(source) -> None:
+    """Add a ``health_status() -> (name, ok, detail)`` contributor to
+    ``healthz()`` aggregation (weakly referenced; GC removes it)."""
+    with _SOURCES_LOCK:
+        _SOURCES.add(source)
+
+
+def unregister_health_source(source) -> None:
+    with _SOURCES_LOCK:
+        _SOURCES.discard(source)
+
 
 def thread_stacks() -> Dict[str, str]:
     """Formatted stack per live thread, keyed by thread name (the core of the
@@ -51,6 +69,11 @@ class Heartbeat:
     thread watches for a stall and emits the diagnostic dump — detection
     itself (``stalled()``/``healthy()``) is computed on demand, so a health
     probe never depends on the monitor's cadence.
+
+    ``on_stall`` (optional) is invoked once per stall episode from the
+    monitor thread, right before the diagnostic dump — the actuation hook
+    (e.g. tripping a circuit breaker open: a wedged dispatch never *fails*,
+    so only the stall monitor can observe it).
     """
 
     def __init__(
@@ -58,12 +81,14 @@ class Heartbeat:
         name: str,
         deadline_s: Optional[float] = None,
         diagnostics: Optional[Callable[[], Dict[str, Any]]] = None,
+        on_stall: Optional[Callable[[], None]] = None,
     ):
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(f"deadline_s must be positive, got {deadline_s}")
         self.name = name
         self.deadline_s = deadline_s
         self._diagnostics = diagnostics
+        self._on_stall = on_stall
         self._lock = threading.Lock()
         self._armed = False
         self._last = time.monotonic()
@@ -127,6 +152,17 @@ class Heartbeat:
         while not self._closed.wait(poll):
             if not self.stalled():
                 continue
+            if self._on_stall is not None:
+                # EVERY poll while stalled, not once per episode: the hook
+                # must keep re-asserting for as long as the stall persists
+                # (a tripped breaker's cooldown can elapse mid-stall — the
+                # re-trip is what keeps it from parking half-open and
+                # admitting traffic into a still-wedged dispatch loop)
+                try:
+                    self._on_stall()
+                except Exception as e:  # actuation must not kill the monitor
+                    print(f"[obs] heartbeat {self.name!r} on_stall hook "
+                          f"failed: {type(e).__name__}: {e}", file=sys.stderr)
             with self._lock:
                 if self._dumped:
                     continue
@@ -162,10 +198,12 @@ class Heartbeat:
 
 
 def healthz() -> Tuple[bool, Dict[str, Any]]:
-    """Aggregate health over every live heartbeat: ``(ok, detail)``.
+    """Aggregate health over every live heartbeat and registered health
+    source (circuit breakers): ``(ok, detail)``.
 
-    A process with no heartbeats is healthy (nothing claims to be
-    dispatching); any stalled heartbeat makes it unhealthy.
+    A process with no heartbeats or sources is healthy (nothing claims to be
+    dispatching); any stalled heartbeat or unhealthy source (an OPEN breaker)
+    makes it unhealthy.
     """
     with _HEARTBEATS_LOCK:
         beats = list(_HEARTBEATS)
@@ -179,4 +217,22 @@ def healthz() -> Tuple[bool, Dict[str, Any]]:
             "deadline_s": hb.deadline_s,
         }
         ok = ok and not stalled
-    return ok, {"status": "ok" if ok else "stalled", "heartbeats": detail}
+    with _SOURCES_LOCK:
+        sources = list(_SOURCES)
+    source_detail: Dict[str, Any] = {}
+    for src in sources:
+        try:
+            name, src_ok, src_info = src.health_status()
+        except Exception as e:  # a broken source must not break the probe
+            name, src_ok, src_info = (
+                f"{type(src).__name__}", False,
+                {"error": f"{type(e).__name__}: {e}"},
+            )
+        source_detail[name] = src_info
+        ok = ok and src_ok
+    body: Dict[str, Any] = {
+        "status": "ok" if ok else "degraded", "heartbeats": detail,
+    }
+    if source_detail:
+        body["sources"] = dict(sorted(source_detail.items()))
+    return ok, body

@@ -1,0 +1,53 @@
+"""Self-healing primitives for the runtime paths (SURVEY.md §5, actuation).
+
+r7 built the *detection* half of the reliability story — heartbeats, stall
+diagnostics, ``/healthz``. This package is the *actuation* half, plus the
+chaos substrate that proves it works without real hardware failures:
+
+- :mod:`faults` — deterministic, test-seedable fault injection (transient
+  errors, wedged-dispatch hangs, host slowdowns, NaN corruption) behind
+  no-op-by-default hooks at the dispatch sites; env-gated via ``PIT_FAULTS``.
+- :mod:`retry` — the error taxonomy (transient vs fatal, with the measured
+  scoped-VMEM-OOM carve-out) and capped exponential backoff with jitter.
+- :mod:`breaker` — a circuit breaker (closed → open on consecutive failures
+  or heartbeat stalls → half-open probe), exported to the metrics registry
+  and ``healthz()``.
+
+Consumers: ``inference/engine.py`` (deadline shedding, bounded-queue
+admission, transient re-dispatch, breaker-gated submission),
+``training/trainer.py`` (bad-step skip/rollback, dispatch retry,
+``fit_with_recovery``), ``data/download.py`` (transient-HTTP backoff).
+
+Importing this package never initializes a jax backend.
+"""
+
+from perceiver_io_tpu.resilience.breaker import BreakerOpen, CircuitBreaker
+from perceiver_io_tpu.resilience.faults import (
+    FaultInjector,
+    FaultSpec,
+    InjectedFatalError,
+    InjectedTransientError,
+)
+from perceiver_io_tpu.resilience.retry import (
+    DeadlineExceeded,
+    RejectedError,
+    RetryPolicy,
+    call_with_retry,
+    classify_error,
+    is_transient,
+)
+
+__all__ = [
+    "BreakerOpen",
+    "CircuitBreaker",
+    "DeadlineExceeded",
+    "FaultInjector",
+    "FaultSpec",
+    "InjectedFatalError",
+    "InjectedTransientError",
+    "RejectedError",
+    "RetryPolicy",
+    "call_with_retry",
+    "classify_error",
+    "is_transient",
+]

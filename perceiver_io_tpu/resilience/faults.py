@@ -1,0 +1,249 @@
+"""Deterministic, test-seedable fault injection for the runtime paths.
+
+The environment this framework targets exhibits real failure modes — wedged
+device tunnels that hang a dispatch indefinitely, transient PJRT/remote-compile
+errors, throughput collapses, silent NaN outputs (CLAUDE.md, PERF.md r3/r6).
+None of them can be provoked on demand from a CPU test box, so the recovery
+machinery (``retry``/``breaker``, the engine's shed/retry paths, the trainer's
+bad-step guard) would otherwise ship untested. This module is the substrate
+for the chaos suite: instrumented sites in the dispatch paths call
+:func:`inject` / :func:`corrupt`, which are no-ops until a
+:class:`FaultInjector` is installed — then they raise, hang, sleep, or
+NaN-corrupt exactly where the real failures would.
+
+Faults are **deterministic**: each spec names the 1-based call indices at
+which it fires (``at=(2, 5)``), or an every-N cadence, so a chaos drill
+replays identically. A ``hang`` spec blocks on a ``threading.Event`` the test
+holds (the wedged-tunnel simulation — release it to "un-wedge" the tunnel).
+
+Instrumented sites (grep for ``faults.inject`` / ``faults.corrupt``):
+
+- ``engine.dispatch`` — inside :meth:`ServingEngine._execute`, before the
+  jitted call (raise/hang here = the dispatch itself failing/wedging);
+- ``engine.complete`` — before the worker's ``device_get`` (a completion-side
+  failure);
+- ``trainer.dispatch`` — before the trainer's train-step dispatch;
+- ``trainer.metrics`` — ``corrupt`` hook over the train-step metrics (NaN
+  loss injection: the signature of a poisoned step).
+
+Env gating for whole-process chaos runs (no code changes)::
+
+    PIT_FAULTS="engine.dispatch:transient@2,5;trainer.metrics:nan@3" python ...
+
+is parsed by :func:`install_from_env`, called lazily on the first ``inject``.
+Production default: ``PIT_FAULTS`` unset, no injector installed, every hook
+is a None-check.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+ENV_VAR = "PIT_FAULTS"
+
+_KINDS = ("transient", "fatal", "hang", "slow", "nan")
+
+
+class InjectedTransientError(RuntimeError):
+    """An injected fault standing in for a transient runtime error (the
+    classifier in :mod:`perceiver_io_tpu.resilience.retry` maps it to
+    ``'transient'``, like a PJRT UNAVAILABLE)."""
+
+
+class InjectedFatalError(RuntimeError):
+    """An injected fault the taxonomy must treat as fatal (no retry)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One fault at one site.
+
+    ``at``: 1-based call indices of the site at which the fault fires;
+    ``every``: alternatively fire on every Nth call (``at`` wins when set).
+    ``kind``: ``transient`` / ``fatal`` raise; ``hang`` blocks until
+    ``release`` is set (or ``delay_s`` elapses, when given); ``slow`` sleeps
+    ``delay_s``; ``nan`` fires only through :func:`corrupt` and NaN-fills
+    every floating leaf of the payload.
+    """
+
+    site: str
+    kind: str
+    at: Tuple[int, ...] = ()
+    every: int = 0
+    delay_s: float = 0.0
+    release: Optional[threading.Event] = None
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; one of {_KINDS}")
+        if not self.at and self.every <= 0:
+            raise ValueError("FaultSpec needs at=(indices...) or every=N")
+
+    def fires(self, call_index: int) -> bool:
+        if self.at:
+            return call_index in self.at
+        return call_index % self.every == 0
+
+
+class FaultInjector:
+    """Holds the fault plan plus per-site call counters (thread-safe: sites
+    are hit from engine workers, submitter threads, and the trainer loop)."""
+
+    def __init__(self, specs: Iterable[FaultSpec] = ()):
+        self._specs = list(specs)
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self.fired: Dict[str, int] = {}  # site -> faults actually fired
+
+    def add(self, spec: FaultSpec) -> "FaultInjector":
+        self._specs.append(spec)
+        return self
+
+    def calls(self, site: str) -> int:
+        with self._lock:
+            return self._counts.get(site, 0)
+
+    def _tick(self, site: str, kinds: Tuple[str, ...]):
+        """Count one call of ``site`` and return the specs that fire on it."""
+        with self._lock:
+            n = self._counts.get(site, 0) + 1
+            self._counts[site] = n
+            due = [
+                s for s in self._specs
+                if s.site == site and s.kind in kinds and s.fires(n)
+            ]
+            if due:
+                self.fired[site] = self.fired.get(site, 0) + len(due)
+        return due
+
+    def inject(self, site: str) -> None:
+        for spec in self._tick(site, ("transient", "fatal", "hang", "slow")):
+            if spec.kind == "slow":
+                _interruptible_sleep(spec.delay_s)
+            elif spec.kind == "hang":
+                # the wedged tunnel: block until the test un-wedges it (or a
+                # bounded delay, so a forgotten release can't hang a suite)
+                if spec.release is not None:
+                    spec.release.wait(spec.delay_s or None)
+                else:
+                    _interruptible_sleep(spec.delay_s or 3600.0)
+            elif spec.kind == "transient":
+                raise InjectedTransientError(
+                    f"injected transient fault at {site!r} "
+                    f"(call {self.calls(site)})"
+                )
+            else:
+                raise InjectedFatalError(
+                    f"injected fatal fault at {site!r} (call {self.calls(site)})"
+                )
+
+    def corrupt(self, site: str, payload):
+        """NaN-fill the floating leaves of ``payload`` when a ``nan`` spec
+        fires on this call of ``site``; otherwise return it unchanged."""
+        if not self._tick(site, ("nan",)):
+            return payload
+        import jax
+
+        def poison(x):
+            a = np.asarray(x)
+            if np.issubdtype(a.dtype, np.floating):
+                return np.full_like(a, np.nan)
+            return x
+
+        return jax.tree.map(poison, payload)
+
+
+def _interruptible_sleep(seconds: float) -> None:
+    # Event.wait rather than time.sleep: a daemon thread stuck in a plain
+    # sleep delays interpreter shutdown on some platforms
+    threading.Event().wait(seconds)
+
+
+# -- process-global install point --------------------------------------------
+
+_ACTIVE: Optional[FaultInjector] = None
+_ENV_CHECKED = False
+
+
+def install(injector: Optional[FaultInjector]) -> Optional[FaultInjector]:
+    """Install (or with None, remove) the process-global injector; returns the
+    previous one so tests can restore it."""
+    global _ACTIVE, _ENV_CHECKED
+    previous, _ACTIVE = _ACTIVE, injector
+    _ENV_CHECKED = True  # an explicit install wins over the env var
+    return previous
+
+
+def get() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+def parse_spec(text: str) -> FaultInjector:
+    """Parse the ``PIT_FAULTS`` grammar:
+    ``site:kind@1,4;site2:kind2@every:3[@delay:0.5]``.
+
+    Each ``;``-separated clause is ``site:kind@WHEN`` where WHEN is a
+    comma-list of 1-based call indices or ``every:N``; an optional trailing
+    ``@delay:SECONDS`` sets the hang/slow duration.
+    """
+    inj = FaultInjector()
+    for clause in filter(None, (c.strip() for c in text.split(";"))):
+        try:
+            site, rest = clause.split(":", 1)
+            kind, _, when = rest.partition("@")
+            delay = 0.0
+            if "@delay:" in when:
+                when, _, d = when.partition("@delay:")
+                delay = float(d)
+            if when.startswith("every:"):
+                inj.add(FaultSpec(site=site, kind=kind,
+                                  every=int(when[len("every:"):]),
+                                  delay_s=delay))
+            else:
+                inj.add(FaultSpec(
+                    site=site, kind=kind, delay_s=delay,
+                    at=tuple(int(i) for i in when.split(",") if i),
+                ))
+        except (ValueError, TypeError) as e:
+            raise ValueError(
+                f"bad {ENV_VAR} clause {clause!r} "
+                f"(expected site:kind@1,4 or site:kind@every:N): {e}"
+            ) from e
+    return inj
+
+
+def install_from_env() -> None:
+    """Install an injector from ``PIT_FAULTS`` once per process (no-op when
+    unset or an injector was installed explicitly)."""
+    global _ENV_CHECKED, _ACTIVE
+    if _ENV_CHECKED:
+        return
+    _ENV_CHECKED = True
+    text = os.environ.get(ENV_VAR)
+    if text:
+        _ACTIVE = parse_spec(text)
+
+
+# -- the site-side hooks (near-zero cost when inactive) ----------------------
+
+
+def inject(site: str) -> None:
+    """Instrumentation hook: raise/hang/sleep if a fault is due at ``site``."""
+    if not _ENV_CHECKED:
+        install_from_env()
+    if _ACTIVE is not None:
+        _ACTIVE.inject(site)
+
+
+def corrupt(site: str, payload):
+    """Instrumentation hook: NaN-corrupt ``payload`` if a fault is due."""
+    if not _ENV_CHECKED:
+        install_from_env()
+    if _ACTIVE is not None:
+        return _ACTIVE.corrupt(site, payload)
+    return payload

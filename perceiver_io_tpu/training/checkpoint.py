@@ -20,6 +20,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import warnings
 from typing import Any, Dict, Optional
 
 import jax
@@ -246,26 +247,58 @@ def restore_train_state(
     both the ranked checkpoints and the unconditional ``last/`` slot
     (``CheckpointManager.save_last``) and restores whichever holds the highest
     step — continuing training from the newest state rather than the champion.
+
+    In that mode a candidate that fails to restore — the signature of a run
+    killed MID-SAVE, leaving a truncated/partial step dir — is skipped with a
+    warning and the next-newest step is tried instead of crashing the resume
+    (exactly the moment a corrupted checkpoint must not be fatal). Only when
+    every candidate fails does the last error propagate.
     """
     restore_args = ocp.args.Composite(
         state=ocp.args.StandardRestore(_to_save_tree(like_state))
     )
     last_dir = os.path.join(os.path.abspath(directory), LAST_SUBDIR)
-    if prefer_latest and step is None and os.path.isdir(last_dir):
+    if prefer_latest and step is None:
         # open each manager once: construction re-scans the directory (and
         # synchronizes cross-host), so probing and restoring reuse the handle
-        with ocp.CheckpointManager(last_dir) as last_mngr:
-            last_step = last_mngr.latest_step()
-            with _read_manager(directory, monitor, mode) as mngr:
-                main_step = mngr.latest_step()
-                if last_step is None or (main_step is not None
-                                         and main_step > last_step):
-                    if main_step is None:
-                        raise FileNotFoundError(f"no checkpoints in {directory}")
-                    restored = mngr.restore(main_step, args=restore_args)["state"]
+        import contextlib
+
+        with contextlib.ExitStack() as stack:
+            last_mngr = None
+            candidates = []
+            if os.path.isdir(last_dir):
+                last_mngr = stack.enter_context(
+                    ocp.CheckpointManager(last_dir))
+                candidates += [(int(s), "last") for s in last_mngr.all_steps()]
+            # a PLAIN (rank-free) manager for the main slot: prefer_latest
+            # never needs best_fn, and a ranked manager eagerly json-parses
+            # every step's metrics at construction — a truncated step from a
+            # killed-mid-save run would crash the scan before the per-step
+            # fallback below could skip it
+            mngr = stack.enter_context(
+                ocp.CheckpointManager(os.path.abspath(directory)))
+            candidates += [(int(s), "main") for s in mngr.all_steps()]
+            # newest step first; on a tie the last/ slot wins (it is by
+            # construction at least as new as the ranked save of that step)
+            candidates.sort(key=lambda c: (c[0], c[1] == "last"), reverse=True)
+            if not candidates:
+                raise FileNotFoundError(f"no checkpoints in {directory}")
+            errors = []
+            for cand_step, source in candidates:
+                use = last_mngr if source == "last" else mngr
+                try:
+                    restored = use.restore(cand_step, args=restore_args)["state"]
                     return _from_save_tree(restored, like_state)
-            restored = last_mngr.restore(last_step, args=restore_args)["state"]
-        return _from_save_tree(restored, like_state)
+                except Exception as e:  # corrupt/partial step dir
+                    errors.append(e)
+                    warnings.warn(
+                        f"checkpoint step {cand_step} ({source} slot) failed "
+                        f"to restore ({type(e).__name__}: {e}) — likely a "
+                        f"partial save from an interrupted run; falling back "
+                        f"to the previous checkpoint",
+                        stacklevel=2,
+                    )
+            raise errors[-1]
     with _read_manager(directory, monitor, mode) as mngr:
         step = _resolve_step(mngr, step, directory)
         restored = mngr.restore(step, args=restore_args)["state"]

@@ -32,7 +32,14 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+import perceiver_io_tpu.obs as obs
 from perceiver_io_tpu.parallel.mesh import AXIS_SEQ, sequence_parallel_context
+from perceiver_io_tpu.resilience import (
+    RetryPolicy,
+    call_with_retry,
+    faults,
+    is_transient,
+)
 from perceiver_io_tpu.parallel.sharding import (
     PARAM_RULES,
     batch_shardings,
@@ -101,10 +108,46 @@ class TrainerConfig:
     # train state (the de-optimized re-run replays the same arguments, which
     # donation would have invalidated). Use for post-mortems, not production.
     debug_nans: bool = False
+    # SELF-HEALING (SURVEY.md §5 actuation; perceiver_io_tpu.resilience).
+    # skip_nonfinite_steps: check the loss after EVERY dispatch; a non-finite
+    # step is SKIPPED (the pre-step state is kept, the poisoned update
+    # discarded) instead of silently poisoning the moments the way the
+    # halt_on_nonfinite log-boundary check can only report after the fact.
+    # After rollback_after_bad_steps CONSECUTIVE bad steps the trainer
+    # restores the newest checkpoint (prefer_latest — the last/ slot when
+    # present; one is saved at fit() start if none exists yet) and continues.
+    # Recovery mode costs one host sync per dispatch and disables train-state
+    # donation (the kept pre-step state must stay alive) — a measured
+    # robustness/throughput trade, off by default.
+    skip_nonfinite_steps: bool = False
+    rollback_after_bad_steps: int = 3
+    # dispatch_error_retries: re-dispatch the SAME batch with exponential
+    # backoff when the step raises an error the taxonomy calls transient
+    # (tunnel drops, PJRT UNAVAILABLE); fatal errors raise immediately.
+    # Implies the per-dispatch sync too (async errors must surface inside
+    # the retry scope). 0 disables.
+    dispatch_error_retries: int = 0
+    # fit_attempts: budget for fit_with_recovery's supervisor loop — on a
+    # transient failure escaping the per-dispatch retries, auto-resume from
+    # the newest checkpoint up to this many total attempts.
+    fit_attempts: int = 1
 
     def __post_init__(self):
         if self.max_epochs is None and self.max_steps is None:
             raise ValueError("set max_epochs and/or max_steps")
+        if self.dispatch_error_retries < 0:
+            raise ValueError(
+                f"dispatch_error_retries must be >= 0, got "
+                f"{self.dispatch_error_retries}"
+            )
+        if self.fit_attempts < 1:
+            raise ValueError(f"fit_attempts must be >= 1, got {self.fit_attempts}")
+
+    @property
+    def recovery_active(self) -> bool:
+        """True when fit() runs the per-dispatch recovery path (loss sync,
+        no state donation)."""
+        return self.skip_nonfinite_steps or self.dispatch_error_retries > 0
 
 
 class Trainer:
@@ -146,6 +189,19 @@ class Trainer:
         run_dir: Optional[str] = None,
     ):
         self.config = config
+        if ((config.recovery_active or config.fit_attempts > 1)
+                and jax.process_count() > 1):
+            # same per-host-divergence hazard the SIGTERM handler gates on:
+            # one host retrying/skipping/restarting a COLLECTIVE train step
+            # while the others advance deadlocks the job in mismatched
+            # programs. Multi-host failure recovery is restart-from-
+            # checkpoint (--resume), which every host performs identically.
+            raise ValueError(
+                "trainer recovery (skip_nonfinite_steps / "
+                "dispatch_error_retries / fit_attempts > 1) is "
+                "single-process only — multi-host runs recover by "
+                "restarting from the newest checkpoint (--resume)"
+            )
         self.mesh = mesh
         self.predict_hook = predict_hook
         self.tokens_per_example = tokens_per_example
@@ -180,15 +236,19 @@ class Trainer:
             step_example = {
                 k: np.stack([v]) for k, v in self._example_batch.items()
             }
+        # donation is off under debug_nans (the de-optimized re-run replays
+        # the original arguments) AND under recovery (a skipped bad step
+        # keeps serving the PRE-step state, and a transient retry re-runs the
+        # dispatch with it — donation would have invalidated both)
+        no_donate = config.debug_nans or config.recovery_active
+        self.donates_state = not no_donate
         if mesh is not None:
             self._train_step, self.state, self._batch_shardings = (
                 make_sharded_train_step(
                     step_fn, mesh, state, step_example,
                     rules=rules, shard_seq=shard_seq, zero_opt=zero_opt,
                     stacked=self._k > 1,
-                    # jax_debug_nans re-runs the dispatch with the ORIGINAL
-                    # arguments — donation would have deleted them
-                    donate_state=not config.debug_nans,
+                    donate_state=not no_donate,
                 )
             )
             # Eval batches are never stacked (no scan axis) — with
@@ -199,7 +259,7 @@ class Trainer:
                 self._example_batch, mesh, shard_seq
             )
         else:
-            donate = () if config.debug_nans else (0,)
+            donate = () if no_donate else (0,)
             jitted = jax.jit(step_fn, donate_argnums=donate)
             self._train_step = lambda s, b: jitted(s, {k: b[k] for k in self._keys})
             self._train_step.jitted = jitted
@@ -225,6 +285,25 @@ class Trainer:
         self._flops_per_step: Optional[float] = None
         self._flops_attempted = False
         self._eval_key = jax.random.key(4242)
+
+        # recovery telemetry: the chaos drills (tests/test_resilience.py)
+        # assert these, and operators watch them the same way they watch the
+        # serving shed/retry counters
+        reg = obs.get_registry()
+        self._m_bad_steps = reg.counter(
+            "trainer_bad_steps_total", "non-finite train steps skipped")
+        self._m_rollbacks = reg.counter(
+            "trainer_rollbacks_total",
+            "checkpoint rollbacks after consecutive bad steps")
+        self._m_step_retries = reg.counter(
+            "trainer_dispatch_retries_total",
+            "transient train-dispatch retries")
+        self._m_restarts = reg.counter(
+            "trainer_fit_restarts_total",
+            "fit_with_recovery auto-resumes after transient failures")
+        self._retry_policy = RetryPolicy(
+            max_retries=config.dispatch_error_retries)
+        self._bad_streak = 0
 
         self._selfprof = None
         if config.selfprofile_every_n_steps > 0:
@@ -374,6 +453,134 @@ class Trainer:
                 out["mfu"] = u
         return out
 
+    # -- self-healing (resilience) -------------------------------------------
+
+    def _ensure_rollback_target(self, step_i: int) -> None:
+        """Make sure a rollback has somewhere to land: with no checkpoint yet
+        (bad steps can hit before the first validation pass), save the
+        CURRENT state to the unconditional ``last/`` slot."""
+        if self.checkpoints.latest_step is None:
+            self.checkpoints.save_last(step_i, self.state)
+
+    def _rollback(self, step_i: int) -> None:
+        """K consecutive bad steps: the in-memory state is presumed poisoned
+        (NaN moments survive a skipped update's discard only if the corruption
+        predates the streak) — restore the newest checkpoint and continue."""
+        from perceiver_io_tpu.training.checkpoint import restore_train_state
+
+        self.checkpoints.wait()
+        restored = restore_train_state(
+            self.checkpoints.directory, self.state, prefer_latest=True
+        )
+        self.state = restored
+        self._bad_streak = 0
+        self._m_rollbacks.inc()
+        to_step = int(jax.device_get(restored.step))
+        obs.event("trainer_rollback", from_step=step_i, to_step=to_step)
+        self.logger.log_text(
+            "events", step_i,
+            f"{self.config.rollback_after_bad_steps} consecutive non-finite "
+            f"steps: rolled back to checkpoint step {to_step}",
+        )
+        self.logger.flush()
+
+    def _recovering_step(self, batch, step_i: int):
+        """One dispatch under the recovery config: transient-error retry with
+        backoff, per-dispatch finite check, skip / rollback. Returns
+        ``(status, metrics)`` with status ``'ok'`` (state advanced),
+        ``'skipped'`` (bad step discarded) or ``'rolled_back'`` (state
+        restored from checkpoint — the caller must re-read ``state.step``).
+
+        The ``float(loss)`` here is the recovery mode's per-dispatch host
+        sync: it surfaces async dispatch errors INSIDE the retry scope and
+        feeds the finite guard (the documented robustness/throughput trade).
+        """
+        cfg = self.config
+
+        def attempt():
+            faults.inject("trainer.dispatch")  # chaos hook (no-op unless
+            with profiling.annotate_step(step_i):  # an injector is live)
+                new_state, metrics = self._train_step(
+                    self.state, self._to_global(batch)
+                )
+            metrics = faults.corrupt("trainer.metrics", metrics)
+            loss = float(metrics["loss"]) if "loss" in metrics else None
+            return new_state, metrics, loss
+
+        def on_retry(retry: int, error: BaseException, pause: float) -> None:
+            self._m_step_retries.inc()
+            obs.event("trainer_dispatch_retry", retry=retry,
+                      error=type(error).__name__, backoff_s=round(pause, 4))
+            self.logger.log_text(
+                "events", step_i,
+                f"transient dispatch error ({type(error).__name__}: {error});"
+                f" retry {retry}/{self._retry_policy.max_retries} after "
+                f"{pause:.2f}s",
+            )
+
+        new_state, metrics, loss = call_with_retry(
+            attempt, policy=self._retry_policy, on_retry=on_retry
+        )
+        if (cfg.skip_nonfinite_steps and loss is not None
+                and not np.isfinite(loss)):
+            self._bad_streak += 1
+            self._m_bad_steps.inc()
+            obs.event("trainer_bad_step", step=step_i, loss=str(loss),
+                      streak=self._bad_streak)
+            self.logger.log_text(
+                "events", step_i,
+                f"non-finite loss {loss} at step {step_i}: step skipped, "
+                f"pre-step state kept (streak {self._bad_streak})",
+            )
+            if (cfg.rollback_after_bad_steps > 0
+                    and self._bad_streak >= cfg.rollback_after_bad_steps):
+                self._rollback(step_i)
+                return "rolled_back", None
+            return "skipped", None
+        self._bad_streak = 0
+        self.state = new_state
+        return "ok", metrics
+
+    def fit_with_recovery(self, train_loader, val_loader=None,
+                          max_attempts: Optional[int] = None):
+        """:meth:`fit` under a supervisor: an attempt that dies with a
+        TRANSIENT error (``resilience.classify_error`` — tunnel drops, PJRT
+        UNAVAILABLE; never divergence or shape bugs) auto-resumes from the
+        newest checkpoint (``prefer_latest``, the same path ``--resume``
+        takes — falling back to the in-memory state when none exists yet) and
+        retries, up to ``max_attempts`` total attempts (default
+        ``config.fit_attempts``). Completes the SIGTERM/resume story for
+        failures that kill the step instead of the process."""
+        from perceiver_io_tpu.training.checkpoint import restore_train_state
+
+        attempts = max(1, int(self.config.fit_attempts if max_attempts is None
+                              else max_attempts))
+        for attempt in range(1, attempts + 1):
+            try:
+                return self.fit(train_loader, val_loader)
+            except Exception as e:
+                if attempt >= attempts or not is_transient(e):
+                    raise
+                self._m_restarts.inc()
+                obs.event("trainer_fit_restart", attempt=attempt,
+                          error=type(e).__name__)
+                try:
+                    self.checkpoints.wait()
+                    self.state = restore_train_state(
+                        self.checkpoints.directory, self.state,
+                        prefer_latest=True,
+                    )
+                except FileNotFoundError:
+                    pass  # nothing saved yet: resume from the in-memory state
+                resumed = int(jax.device_get(self.state.step))
+                self.logger.log_text(
+                    "events", resumed,
+                    f"fit attempt {attempt} failed with transient "
+                    f"{type(e).__name__}: {e}; auto-resuming from step "
+                    f"{resumed} ({attempts - attempt} attempts left)",
+                )
+                self.logger.flush()
+
     def _run_eval(self, val_loader) -> Dict[str, float]:
         totals: Dict[str, float] = {}
         weight = 0.0
@@ -477,6 +684,9 @@ class Trainer:
         profiling_active = False
         profile_captured = False
         last_validated_step = step_i
+        self._bad_streak = 0
+        if cfg.skip_nonfinite_steps and cfg.rollback_after_bad_steps > 0:
+            self._ensure_rollback_target(step_i)
 
         # SIGTERM = preemption notice: finish the in-flight step, save the
         # newest state unconditionally, stop cleanly. The handler only sets a
@@ -504,7 +714,9 @@ class Trainer:
                 if cfg.max_epochs is not None and epoch >= cfg.max_epochs:
                     break
                 steps_this_epoch = 0
+                batches_this_epoch = 0
                 for batch, ksteps in self._dispatch_batches(train_loader):
+                    batches_this_epoch += 1
                     if self._sigterm:
                         self.checkpoints.save_last(step_i, self.state)
                         self.logger.log_text(
@@ -535,10 +747,25 @@ class Trainer:
                         profiling_active = True
                         profile_start = step_i
 
-                    with profiling.annotate_step(step_i):
-                        self.state, metrics = self._train_step(
-                            self.state, self._to_global(batch)
-                        )
+                    if cfg.recovery_active:
+                        status, stepped = self._recovering_step(batch, step_i)
+                        if status == "rolled_back":
+                            # the restored state's step is authoritative; the
+                            # loader stream continues from its current
+                            # position (recovery favors forward progress over
+                            # exact batch replay — logged above)
+                            step_i = int(jax.device_get(self.state.step))
+                            window_start = time.perf_counter()
+                            window_steps = 0
+                            continue
+                        if status == "skipped":
+                            continue  # state unchanged; batch consumed
+                        metrics = stepped
+                    else:
+                        with profiling.annotate_step(step_i):
+                            self.state, metrics = self._train_step(
+                                self.state, self._to_global(batch)
+                            )
                     prev_step = step_i
                     step_i += ksteps
                     window_steps += ksteps
@@ -610,10 +837,21 @@ class Trainer:
                         break
                 if self._sigterm:
                     break
-                if steps_this_epoch == 0:
+                if batches_this_epoch == 0:
                     raise ValueError(
                         "train_loader produced no batches (dataset shard smaller "
                         "than the batch size with drop_last?)"
+                    )
+                if steps_this_epoch == 0:
+                    # batches flowed but EVERY step was skipped as non-finite
+                    # (and rollback is off or landed back in the same state):
+                    # the run cannot progress — surface the real diagnosis
+                    # instead of looping epochs forever
+                    raise FloatingPointError(
+                        f"every train step of epoch {epoch} was skipped as "
+                        f"non-finite ({batches_this_epoch} batches) — the "
+                        f"run cannot make progress; inspect with debug_nans "
+                        f"or lower the learning rate"
                     )
                 epoch += 1
                 if not cfg.eval_every_n_steps:

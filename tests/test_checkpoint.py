@@ -253,6 +253,43 @@ def test_prefer_latest_without_last_slot(tmp_path, state_and_batch):
     assert int(restored.step) == int(state.step)
 
 
+def test_prefer_latest_falls_back_past_corrupt_newest_step(tmp_path,
+                                                           state_and_batch):
+    """A run killed MID-SAVE leaves a truncated newest step dir; the
+    crash-resume path (prefer_latest) must warn and restore the previous
+    good step instead of crashing exactly when recovery is needed."""
+    import glob
+    import warnings as _warnings
+
+    _, state, _, _ = state_and_batch
+    directory = str(tmp_path / "ckpt")
+    with CheckpointManager(directory, max_to_keep=3, async_save=False) as mngr:
+        for step in (1, 2):
+            mngr.save(step, state.replace(step=jnp.asarray(step)),
+                      {"val_loss": float(step)})
+    # truncate every file of the newest step (the killed-mid-save signature)
+    for path in glob.glob(os.path.join(directory, "2", "**"), recursive=True):
+        if os.path.isfile(path):
+            open(path, "wb").close()
+
+    like = TrainState.create(
+        jax.tree.map(jnp.zeros_like, state.params), state.tx, jax.random.key(0)
+    )
+    with pytest.warns(UserWarning, match="failed to restore"):
+        restored = restore_train_state(directory, like, prefer_latest=True)
+    assert int(restored.step) == 1
+    assert _trees_equal(restored.params, state.params)
+
+    # every candidate corrupt → the restore error propagates (no silent junk)
+    for path in glob.glob(os.path.join(directory, "1", "**"), recursive=True):
+        if os.path.isfile(path):
+            open(path, "wb").close()
+    with pytest.raises(Exception):
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("ignore")
+            restore_train_state(directory, like, prefer_latest=True)
+
+
 def test_zero3_sharded_state_round_trip(tmp_path, state_and_batch):
     """A ZeRO-3-sharded TrainState (params AND opt-state over the data axis)
     checkpoints and restores: saved values equal the sharded originals, and

@@ -73,6 +73,8 @@ def test_train_mlm_fused_head_flag(tmp_path):
         train_mlm.main(args + ["--tp", "2"])
 
 
+@pytest.mark.slow  # encoder-transfer restore semantics stay tier-1 in
+# tests/test_checkpoint.py::test_encoder_transfer; this is the CLI ride
 def test_train_mlm_then_transfer(tmp_path):
     mlm_args = _common(tmp_path, "mlm") + TINY_MODEL + [
         "--synthetic_size", "96", "--batch_size", "16",
@@ -146,8 +148,12 @@ def test_serve_cli_end_to_end(tmp_path):
     base = ["--checkpoint", ckpt, "--tokenizer", tok, "--max_batch", "4",
             "--k", "3"]
 
+    # the resilience flags ride the happy path too: generous deadline/queue
+    # bound and an armed breaker must not perturb results
     fused = serve.main(
         base + ["--bucket_widths", "16",
+                "--request_deadline_s", "60", "--queue_limit", "256",
+                "--breaker_failures", "3", "--breaker_cooldown_s", "1",
                 "--texts", "a [MASK] b", "no mask here"]
     )
     assert len(fused) == 2
@@ -480,7 +486,9 @@ def test_all_parsers_build_and_render_help():
         help_text = parser.format_help()
         for flag in ("--dp", "--tp", "--sp", "--zero", "--multihost",
                      "--resume", "--attn_impl", "--dtype",
-                     "--selfprofile_every_n_steps"):
+                     "--selfprofile_every_n_steps",
+                     "--skip_nonfinite_steps", "--rollback_after_bad_steps",
+                     "--dispatch_error_retries", "--fit_attempts"):
             assert flag in help_text, f"{mod.__name__} missing {flag}"
 
     from perceiver_io_tpu.cli import serve
@@ -489,7 +497,9 @@ def test_all_parsers_build_and_render_help():
     for flag in ("--checkpoint", "--tokenizer", "--bucket_widths", "--dtype",
                  "--quantize", "--cached", "--max_delay_ms", "--metrics_port",
                  "--heartbeat_deadline_s", "--selfprofile_every",
-                 "--events_jsonl", "--cpu"):
+                 "--events_jsonl", "--cpu", "--request_deadline_s",
+                 "--queue_limit", "--dispatch_retries", "--breaker_failures",
+                 "--breaker_cooldown_s"):
         assert flag in help_text, f"serve missing {flag}"
 
 

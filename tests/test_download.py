@@ -73,6 +73,67 @@ def _write_mnist_files(srv):
     return entries
 
 
+@pytest.fixture
+def flaky_http_root(tmp_path):
+    """Serve tmp_path/srv, failing each path's first N requests with a 503
+    (N set per-test via the returned dict); yields (base_url, srv, counts)."""
+    srv = tmp_path / "srv"
+    srv.mkdir()
+    counts = {}
+    fail_times = {"n": 0}
+
+    class Handler(SimpleHTTPRequestHandler):
+        def __init__(self, *a, **k):
+            super().__init__(*a, directory=str(srv), **k)
+
+        def log_message(self, *a, **k):
+            pass
+
+        def do_GET(self):
+            seen = counts.get(self.path, 0)
+            counts[self.path] = seen + 1
+            if seen < fail_times["n"]:
+                self.send_error(503, "injected transient failure")
+                return
+            super().do_GET()
+
+    server = HTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_address[1]}/", srv, counts, fail_times
+    server.shutdown()
+    thread.join()
+
+
+def test_download_retries_transient_5xx(flaky_http_root, tmp_path):
+    """A mirror that 503s twice then serves must succeed through the capped
+    backoff retry — and a 404 must NOT be retried (deterministic failure)."""
+    from perceiver_io_tpu.resilience import RetryPolicy
+
+    base, srv, counts, fail_times = flaky_http_root
+    (srv / "blob.bin").write_bytes(b"eventually consistent")
+    fail_times["n"] = 2
+    policy = RetryPolicy(max_retries=2, base_s=0.01, jitter=0.0)
+    dest = tmp_path / "out" / "blob.bin"
+    download_file(base + "blob.bin", str(dest), retry_policy=policy)
+    assert dest.read_bytes() == b"eventually consistent"
+    assert counts["/blob.bin"] == 3  # two 503s + the success
+
+    # budget exhausted: the 5xx propagates (as a mirror failure upstream)
+    fail_times["n"] = 10
+    with pytest.raises(Exception, match="503|all mirrors failed"):
+        download_any([base + "blob.bin"], str(tmp_path / "x.bin"),
+                     retry_policy=policy)
+    assert counts["/blob.bin"] == 3 + 3  # one attempt + two retries, then out
+
+    # a 404 is deterministic: exactly ONE request, no backoff stalls
+    fail_times["n"] = 0
+    with pytest.raises(Exception, match="404|Not Found"):
+        download_file(base + "missing.bin", str(tmp_path / "y.bin"),
+                      retry_policy=policy)
+    assert counts["/missing.bin"] == 1
+
+
 def test_download_file_and_checksum(http_root, tmp_path):
     base, srv = http_root
     (srv / "blob.bin").write_bytes(b"hello dataset")

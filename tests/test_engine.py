@@ -324,6 +324,39 @@ def test_engine_update_params_requantize_queues_not_races():
         eng.update_params(params_for(1))
 
 
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_engine_worker_crash_closes_engine_with_cause():
+    """A worker crash must leave the engine CLOSED, not half-dead: post-crash
+    submits raise EngineClosed immediately (never enqueue into a dead queue
+    and hang toward a timeout), with the crash cause chained as __cause__."""
+
+    def apply_fn(p, x):
+        return x + p
+
+    eng = ServingEngine(apply_fn, jnp.float32(1.0), max_batch=4, name="crash_t")
+    try:
+        boom = RuntimeError("worker exploded")
+
+        def bad_next_batch(timeout):
+            raise boom
+
+        eng._next_batch = bad_next_batch  # crash OUTSIDE the per-batch guard
+        eng._thread.join(timeout=30)
+        assert not eng._thread.is_alive()
+
+        t0 = time.monotonic()
+        with pytest.raises(EngineClosed, match="crashed") as excinfo:
+            eng.submit(np.ones((1, 3), np.float32))
+        assert time.monotonic() - t0 < 5, "must fast-fail, not hang"
+        assert excinfo.value.__cause__ is boom
+
+        with pytest.raises(EngineClosed, match="crashed"):
+            eng.update_params(jnp.float32(2.0))
+    finally:
+        eng.close()
+
+
 def test_engine_bf16_compute_dtype():
     """compute_dtype='bfloat16' casts floating params/inputs once (the bf16
     serving path); results track f32 at bf16 tolerance."""

@@ -138,6 +138,8 @@ def _run_spawn_hosts(tmp_path, extra_args, max_steps=3,
     return proc, tail, losses
 
 
+@pytest.mark.slow  # launcher UX variant; real 2-process jax.distributed
+# coverage stays tier-1 via the reports-fixture tests above
 def test_spawn_hosts_single_command_launch(tmp_path):
     """--spawn_hosts 2: ONE command forks both ranks with coordinator flags
     (the reference's one-command DDP UX, train_mlm.py:102-103). The launcher
@@ -152,6 +154,8 @@ def test_spawn_hosts_single_command_launch(tmp_path):
     assert losses and np.isfinite(losses).all(), tail
 
 
+@pytest.mark.slow  # deep spawn variant (slow, like all spawn tests);
+# real 2-process coverage stays tier-1 via the reports-fixture tests
 def test_spawn_hosts_buckets_and_multi_step_dispatch(tmp_path):
     """The r3 exclusivity is gone: --bucket_widths x --steps_per_dispatch 2 x
     2 real processes trains end to end (loader-decided global widths keep
@@ -169,6 +173,8 @@ def test_spawn_hosts_buckets_and_multi_step_dispatch(tmp_path):
     assert losses and np.isfinite(losses).all(), tail
 
 
+@pytest.mark.slow  # deep spawn variant (slow, like all spawn tests);
+# real 2-process coverage stays tier-1 via the reports-fixture tests
 def test_spawn_hosts_sequence_parallel_kernel_path(tmp_path):
     """2 real processes x --sp 2 --shard_seq --attn_impl pallas_sp: the
     distributed-flash route (shard_map'd kernel, S/n KV per device) trains

@@ -644,6 +644,8 @@ class TestRandomGeometryFuzz:
         val = base * p
         return int(min(max(val, lo), hi))
 
+    @pytest.mark.slow  # fuzz sweep: the deterministic fwd/grad parity
+    # cases above cover the guard boundaries in tier-1
     def test_fuzz_forward_and_grads_match_xla(self, lane_aligned):
         import perceiver_io_tpu.ops.pallas_attention as pa
 
@@ -767,6 +769,8 @@ class TestSeqParallelGeometryFuzz:
 
     N_GEOMETRIES = 12
 
+    @pytest.mark.slow  # fuzz sweep: tests/test_sharding.py::
+    # test_pallas_sp_step_matches_xla_and_shards_kv stays tier-1
     def test_fuzz_sp_matches_single_device(self, lane_aligned):
         from perceiver_io_tpu.parallel import make_mesh
 

@@ -121,6 +121,8 @@ class TestPallasLinearCE:
 
 
 class TestMLMFusedHeadPallas:
+    @pytest.mark.slow  # near-duplicate of tests/test_train_steps.py::
+    # test_mlm_step_fused_head_matches_unfused, which stays tier-1
     def test_train_step_matches_unfused(self, rng):
         """fused_head='pallas' must reproduce the unfused loss trajectory
         (gradient equivalence through Adam updates)."""
@@ -201,6 +203,8 @@ class TestRandomGeometryFuzz:
         yield
         pc._TEST_ALIGNMENT = None
 
+    @pytest.mark.slow  # fuzz sweep: deterministic fused-CE parity stays
+    # in TestMLMFusedHeadPallas + tests/test_train_steps.py (tier-1)
     def test_fuzz_matches_unfused(self, sublane_aligned):
         import perceiver_io_tpu.ops.pallas_ce as pc
 

@@ -165,6 +165,8 @@ def test_int8w_engine_parity_vs_f32_oracle(tiny_setup):
         assert float(np.max(np.abs(got - oracle))) / peak <= 0.05
 
 
+@pytest.mark.slow  # the same int8w-top-k==f32 assertion runs at CLI
+# level in tests/test_cli.py::test_serve_cli_end_to_end (tier-1)
 def test_mlm_server_int8w_top_k_matches_f32(tiny_setup):
     """MLMServer(quantize='int8') serves fill-mask through ONE shared
     quantized tree; its top-k token picks on the tiny preset match the f32

@@ -4,6 +4,7 @@ freezing semantics, loss masking."""
 import numpy as np
 import jax
 import jax.numpy as jnp
+import pytest
 
 from perceiver_io_tpu.models.adapters import (
     ClassificationOutputAdapter,
@@ -81,6 +82,8 @@ def build_mlm():
     return PerceiverMLM(encoder=enc, decoder=dec, masking=masking)
 
 
+@pytest.mark.slow  # convergence smoke duplicated by the trainer fit
+# tests, which train the same tiny classifier to a falling loss
 def test_image_classifier_learns(rng):
     model = build_image_classifier()
     # learnable synthetic task: class = brightest quadrant
