@@ -29,7 +29,10 @@ traffic; 0 = reference-shaped full decode). PIT_BENCH_HEAD selects the vocab
 head ('pallas' default on TPU — the fused flash-CE kernel, device-measured
 10.42 → 9.82 ms/step; 'none' = unfused; 'xla' = chunked-scan variant).
 PIT_BENCH_HOST_ONLY=1 skips the device trace (host clock becomes the
-headline). PIT_BENCH_BACKEND_DEADLINE_S (default 120) bounds the first
+headline). PIT_COMPILE_CACHE=DIR persists XLA compiles across sessions
+(opt-in cold-start amortization; compile time never enters the device-trace
+headline — PERF.md §Cold start). PIT_BENCH_BACKEND_DEADLINE_S (default 120)
+bounds the first
 backend probe: when the tunnel is dark the probe times out and the script
 prints a single ``{"error": "tpu_unavailable", ...}`` JSON record and exits
 nonzero instead of hanging or dumping a raw traceback (BENCH_r05).
@@ -97,6 +100,14 @@ def main() -> None:
     import numpy as np
 
     backend = _probe_backend()
+
+    # opt-in compile persistence (PIT_COMPILE_CACHE=DIR): repeat sessions
+    # skip the remote recompiles. Compile time never enters the headline —
+    # the device-trace lower-quartile step time is measured after warmup —
+    # so the cache cannot perturb the metric (PERF.md §Cold start).
+    from perceiver_io_tpu.aot import maybe_enable_cache_from_env
+
+    maybe_enable_cache_from_env()
 
     from perceiver_io_tpu.training import (
         OptimizerConfig,

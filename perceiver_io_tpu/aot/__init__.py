@@ -1,0 +1,43 @@
+"""Persistent ahead-of-time compilation: zero-recompile cold starts.
+
+Perceiver IO's serving efficiency comes from a *family* of small specialized
+XLA programs — one executable per (signature, batch-bucket) — and every
+process start used to re-pay the full compile family through the tunneled
+remote compiler before the first request could be answered. This subsystem
+makes cold start near-zero:
+
+- :class:`ExecutableCache` — tier 1: compiled executables serialized to disk
+  (``jax.experimental.serialize_executable``), keyed by a content fingerprint
+  (package/source identity of the traced callable, jax/jaxlib + PJRT
+  platform/topology, abstract input shapes/dtypes, donation/static config).
+  A warm start deserializes the executable directly — no trace, no lower,
+  no compile. Corrupt entries and fingerprint mismatches fall back to a
+  normal compile; a cache problem NEVER refuses traffic.
+- :func:`enable_persistent_compilation_cache` — tier 2: jax's own persistent
+  compilation cache (``jax_compilation_cache_dir``), for paths the AOT tier
+  cannot cover (the trainer step, ad-hoc tools): tracing and lowering still
+  run, but the expensive backend compile becomes a disk hit.
+
+Both tiers are fail-soft by construction and export hit/miss/error counters
+through the obs registry.
+"""
+
+from perceiver_io_tpu.aot.cache import (
+    ExecutableCache,
+    callable_sources,
+    enable_persistent_compilation_cache,
+    environment_fingerprint,
+    fingerprint,
+    maybe_enable_cache_from_env,
+    resolve_cache,
+)
+
+__all__ = [
+    "ExecutableCache",
+    "callable_sources",
+    "enable_persistent_compilation_cache",
+    "environment_fingerprint",
+    "fingerprint",
+    "maybe_enable_cache_from_env",
+    "resolve_cache",
+]

@@ -127,6 +127,12 @@ def add_trainer_args(parser: argparse.ArgumentParser) -> None:
                         "failure that escapes the per-step retries, "
                         "auto-resume from the newest checkpoint "
                         "(fit_with_recovery supervisor). 1 = no supervisor")
+    g.add_argument("--compile_cache", default=None, metavar="DIR",
+                   help="cold start: persist XLA compilations here (jax's "
+                        "persistent compilation cache, min compile time 0) "
+                        "so restarts/resumes skip the remote compile of an "
+                        "unchanged step. Fail-soft: an unusable dir warns "
+                        "and trains uncached (PERF.md §Cold start)")
 
 
 def add_mesh_args(parser: argparse.ArgumentParser) -> None:
@@ -293,6 +299,7 @@ def trainer_config(args) -> TrainerConfig:
         rollback_after_bad_steps=getattr(args, "rollback_after_bad_steps", 3),
         dispatch_error_retries=getattr(args, "dispatch_error_retries", 0),
         fit_attempts=getattr(args, "fit_attempts", 1),
+        compile_cache=getattr(args, "compile_cache", None),
     )
 
 
@@ -777,7 +784,8 @@ def parse_with_resume(parser: argparse.ArgumentParser, argv):
     # training recipe — never inherit them from the original run (store_true
     # flags have no --no_* spelling to override with)
     env_flags = {"resume", "multihost", "coordinator_address", "num_processes",
-                 "process_id", "dp", "tp", "sp", "shard_seq", "zero_opt"}
+                 "process_id", "dp", "tp", "sp", "shard_seq", "zero_opt",
+                 "compile_cache"}  # a local path: never inherit across hosts
     defaults = {
         k: v for k, v in hparams.items() if k in known and k not in env_flags
     }

@@ -27,6 +27,20 @@ each micro-batch streams int8 weight bytes from HBM. The checkpoint stays
 f32 on disk; parity error vs the f32 oracle is bounded and measured
 (`tools/quant_bench.py`, PERF.md §Quantization).
 
+``--compile_cache DIR`` is the zero-recompile cold start
+(``perceiver_io_tpu.aot``, PERF.md §Cold start): every compiled bucket
+program is serialized to DIR keyed by a content fingerprint, and a warm
+restart deserializes the family instead of recompiling it — warmup then
+performs zero XLA compiles. (The serving process runs the AOT tier alone;
+jax's persistent compilation cache is the TRAINER/tools tier — running both
+on the same compile double-serializes the executable and destabilizes this
+jaxlib, a measured negative recorded in PERF.md §Cold start.)
+Warmup itself runs in the BACKGROUND by default
+(priority-ordered, smallest buckets first): the first request is answered as
+soon as its program is ready, not after the whole family is warm
+(``--blocking_warmup`` restores the old wait). A missing/unusable cache dir
+warns and serves uncached — a cache problem never refuses traffic.
+
 ``--metrics_port`` starts the localhost observability sidecar
 (``/metrics`` Prometheus text, ``/healthz``, ``/statz`` JSON snapshot);
 ``--heartbeat_deadline_s`` arms the wedged-tunnel dispatch heartbeat;
@@ -96,6 +110,19 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--no_warmup", action="store_true",
                    help="skip ahead-of-time bucket compilation (first "
                         "requests then pay the compiles)")
+    g.add_argument("--compile_cache", default=None, metavar="DIR",
+                   help="zero-recompile cold start: persist every compiled "
+                        "bucket program here (serialized executables, "
+                        "perceiver_io_tpu.aot) — a warm restart deserializes "
+                        "instead of recompiling, and warmup performs zero "
+                        "XLA compiles. Fail-soft: a missing/unusable dir "
+                        "warns and serves uncached — never refuses traffic")
+    g.add_argument("--blocking_warmup", action="store_true",
+                   help="wait for the FULL bucket-program family before "
+                        "serving (the pre-r10 behavior). Default: warmup "
+                        "runs in the background, priority-ordered, and "
+                        "serving starts immediately — a request is answered "
+                        "as soon as its program is ready")
     g.add_argument("--stats", action="store_true",
                    help="print engine stats to stderr on exit")
     r = parser.add_argument_group(
@@ -190,6 +217,13 @@ def main(argv: Optional[Sequence[str]] = None):
 
 
 def _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint):
+    # Deliberately tier 1 ONLY in the serve process: the AOT executable
+    # cache covers every compile serving performs (the bucket programs), and
+    # enabling jax's persistent compilation cache IN ADDITION measurably
+    # destabilizes this jaxlib — both tiers serialize the same executable,
+    # and the double serialization intermittently corrupts the CPU runtime
+    # (PERF.md §Cold start records the negative result). Trainers/tools,
+    # which have no AOT tier, use tier 2 via --compile_cache there.
     tokenizer = load_tokenizer(args.tokenizer)
     model, params, max_seq_len = load_mlm_checkpoint(
         args.checkpoint, tokenizer, step=args.step,
@@ -211,10 +245,18 @@ def _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint):
         dispatch_retries=args.dispatch_retries,
         breaker_failures=args.breaker_failures,
         breaker_cooldown_s=args.breaker_cooldown_s,
+        compile_cache=args.compile_cache,
     ) as server:
+        warmup_handle = None
         if not args.no_warmup:
-            n = server.warmup()
-            print(f"serve: warmed {n} bucket programs", file=sys.stderr)
+            if args.blocking_warmup:
+                n = server.warmup()
+                print(f"serve: warmed {n} bucket programs", file=sys.stderr)
+            else:
+                warmup_handle = server.warmup(background=True)
+                print("serve: warming bucket programs in the background; "
+                      "serving immediately (--blocking_warmup restores the "
+                      "wait)", file=sys.stderr)
 
         def emit(text: str, fills) -> None:
             line = {"text": text, "fills": fills}
@@ -250,6 +292,15 @@ def _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint):
                         pending.append((text, server.submit(text, k=args.k)))
                 for text, fut in pending:
                     emit(text, fut.result())
+        if warmup_handle is not None and warmup_handle.done():
+            try:
+                n = warmup_handle.wait(0)
+                print(f"serve: warmed {n} bucket programs (background)",
+                      file=sys.stderr)
+            except Exception as e:  # warmup failed; requests self-compiled
+                print(f"serve: background warmup failed "
+                      f"({type(e).__name__}: {e}) — programs were built "
+                      "on demand", file=sys.stderr)
         if args.stats:
             print(f"serve: stats {json.dumps(server.stats())}", file=sys.stderr)
     return results

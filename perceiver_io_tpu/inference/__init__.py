@@ -14,6 +14,7 @@ from perceiver_io_tpu.inference.engine import (
     EngineClosed,
     MLMServer,
     ServingEngine,
+    WarmupHandle,
 )
 from perceiver_io_tpu.resilience import (
     BreakerOpen,
@@ -37,4 +38,5 @@ __all__ = [
     "MLMServer",
     "RejectedError",
     "ServingEngine",
+    "WarmupHandle",
 ]

@@ -76,6 +76,24 @@ device can misbehave the way the tunneled backend actually does —
 
 Shed/retry/breaker counts export as ``serving_shed_total{reason=...}`` /
 ``serving_dispatch_retries_total`` / ``breaker_*``.
+
+Zero-recompile cold start (``perceiver_io_tpu.aot``): ``compile_cache=DIR``
+persists every compiled bucket program to disk
+(``jax.experimental.serialize_executable``), keyed by a content fingerprint
+(apply-fn source/model identity, jax+PJRT platform/topology, abstract
+shapes/dtypes, donation/quantize/dtype config). A warm restart deserializes
+each program instead of tracing+lowering+compiling it — ``warmup()`` then
+performs ZERO XLA compiles (pinned by test via ``jax_compilations_total``).
+Corrupt entries and fingerprint drift fall back to a normal compile; a cache
+problem never refuses traffic. ``warmup(background=True)`` turns the
+blocking compile-everything call into a cache-first, priority-ordered
+(smallest bucket first) BACKGROUND warmup: the engine serves traffic as soon
+as the first needed bucket is ready — a request for a not-yet-warm program
+either rides the warmup thread's in-flight build (cache mode dedups via a
+per-program claim) or compiles on demand — and the remaining family keeps
+warming off the hot path. Warmth is observable: per-engine ``engine_ready``
+gauge (0 = warming, 1 = last requested family fully warm, surfaced on
+``/statz``) and ``serving_warmup_seconds``.
 """
 
 from __future__ import annotations
@@ -89,6 +107,12 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 import perceiver_io_tpu.obs as obs
+from perceiver_io_tpu.aot import (
+    callable_sources,
+    environment_fingerprint,
+    fingerprint as aot_fingerprint,
+    resolve_cache,
+)
 from perceiver_io_tpu.inference.predictor import bucket_size
 from perceiver_io_tpu.resilience import (
     BreakerOpen,
@@ -151,6 +175,59 @@ def prepare_param_tree(params, compute_dtype, quantize: Optional[str]):
 
 class EngineClosed(RuntimeError):
     """submit() after close()."""
+
+
+class WarmupHandle:
+    """Tracks one (possibly background) warmup run.
+
+    ``wait()`` blocks until the warmup finishes and returns its result (the
+    warmed bucket list for an engine, the warmed program count for an
+    ``MLMServer``), re-raising any warmup error. ``cancel()`` asks the
+    warming thread(s) to stop at the next bucket boundary (an in-flight
+    compile cannot be interrupted); ``close()`` cancels automatically.
+    """
+
+    def __init__(self):
+        self._done_event = threading.Event()
+        self._cancel_event = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._threads: List[threading.Thread] = []
+        self.result: Any = None
+
+    def done(self) -> bool:
+        return self._done_event.is_set()
+
+    def cancelled(self) -> bool:
+        return self._cancel_event.is_set()
+
+    def cancel(self) -> None:
+        self._cancel_event.set()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Wait for the warming thread(s) to actually exit (bounded).
+
+        ``cancel()`` only asks; a thread mid-compile finishes that build
+        first. Owners call this from ``close()`` so no warmup thread keeps
+        driving the jax runtime concurrently with whatever the process does
+        next — a leftover compile racing later work is a real crash, not a
+        hygiene nit. A wedged build past ``timeout`` is abandoned (daemon)."""
+        for t in self._threads:
+            t.join(timeout)
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._done_event.wait(timeout):
+            raise TimeoutError("warmup not finished within timeout")
+        if self._error is not None:
+            raise self._error
+        return self.result
+
+    def _finish(self, result) -> None:
+        self.result = result
+        self._done_event.set()
+
+    def _fail(self, error: BaseException) -> None:
+        self._error = error
+        self._done_event.set()
 
 
 class _Future:
@@ -287,6 +364,8 @@ class ServingEngine:
         retry_policy: Optional[RetryPolicy] = None,
         breaker_failures: int = 0,
         breaker_cooldown_s: float = 5.0,
+        compile_cache=None,
+        cache_salt: str = "",
     ):
         import jax
         import jax.numpy as jnp
@@ -384,8 +463,9 @@ class ServingEngine:
             "padded filler rows (bucket waste)", labels)
         self._m_compiles = reg.counter(
             "serving_compile_events_total",
-            "new (signature, batch-bucket) programs entered (each is one XLA "
-            "compile unless warmed)", labels)
+            "new (signature, batch-bucket) programs entered (one XLA compile "
+            "unless warmed — or a zero-compile disk deserialize when the AOT "
+            "cache hits; aot_cache_hits_total tells the two apart)", labels)
         self._m_queue = reg.gauge(
             "serving_queue_depth", "parts awaiting batch formation", labels)
         self._m_inflight = reg.gauge(
@@ -411,6 +491,30 @@ class ServingEngine:
             "transient micro-batch re-dispatch cycles", labels)
         self._backlog = 0  # parts admitted but not yet dispatched/shed
                            # (written under _stats_lock)
+
+        # zero-recompile cold start (perceiver_io_tpu.aot): when a cache is
+        # attached, every bucket program dispatches through an AOT-compiled
+        # executable — loaded from disk on a fingerprint hit, compiled (and
+        # persisted) otherwise. _aot_claims dedups concurrent builds of the
+        # same program (background warmup racing the worker's on-demand path).
+        self._cache = resolve_cache(compile_cache, registry=reg)
+        self._cache_salt = cache_salt
+        self._aot_lock = threading.Lock()
+        self._aot_programs: Dict[Any, Any] = {}
+        self._aot_claims: Dict[Any, threading.Event] = {}
+        self._fp_base = None  # lazy: needs the backend up
+        # every live warmup's handle (one per warmup() call — e.g. one per
+        # signature): close() must cancel+join ALL of them, not just the
+        # newest, or an earlier signature's thread outlives the engine
+        self._warmup_handles: List[WarmupHandle] = []
+        self._m_ready = reg.gauge(
+            "engine_ready",
+            "1 once the last requested warmup family is fully "
+            "compiled/loaded; 0 while cold or warming", labels)
+        self._m_warmup_s = reg.gauge(
+            "serving_warmup_seconds",
+            "wall seconds the last warmup took (cache hits make this "
+            "near-zero)", labels)
 
         self.breaker: Optional[CircuitBreaker] = None
         if breaker_failures > 0:
@@ -626,13 +730,22 @@ class ServingEngine:
     # -- warmup --------------------------------------------------------------
 
     def warmup(self, *example_inputs,
-               buckets: Optional[Sequence[int]] = None) -> List[int]:
-        """Ahead-of-time compile every batch bucket for this input signature
-        (row 0 of ``example_inputs``, tiled). One call per distinct signature
-        — e.g. per serving width bucket — and steady state never compiles.
-        Returns the bucket sizes warmed."""
-        import jax
+               buckets: Optional[Sequence[int]] = None,
+               background: bool = False):
+        """Ready every batch bucket for this input signature (row 0 of
+        ``example_inputs``, tiled) ahead of traffic — from the AOT cache when
+        one is attached (deserialize, zero compiles), compiling otherwise.
+        One call per distinct signature — e.g. per serving width bucket —
+        and steady state never compiles.
 
+        Blocking (default): returns the warmed bucket list, raising on
+        error — the historical contract. ``background=True`` returns a
+        :class:`WarmupHandle` immediately and warms on a daemon thread in
+        PRIORITY order (smallest bucket first, so a lone request is
+        servable as soon as bucket 1 lands); traffic may be submitted right
+        away — a request whose program is mid-build rides the warmup
+        thread's build (cache mode) or compiles on demand.
+        """
         arrays = [np.asarray(x) for x in example_inputs]
         if any(a.shape[0] < 1 for a in arrays):
             raise ValueError("warmup needs at least one example row")
@@ -642,19 +755,66 @@ class ServingEngine:
                 buckets.append(b)
                 b *= 2
             buckets.append(self.max_batch)
+        # ascending = priority order: the small buckets unblock first traffic
         buckets = sorted({bucket_size(int(b), self.max_batch) for b in buckets})
-        key = self._key([a[:1] for a in arrays])
-        for b in buckets:
-            cols = tuple(
-                self._cast(np.ascontiguousarray(
-                    np.broadcast_to(a[:1], (b, *a.shape[1:]))
-                ))
-                for a in arrays
+        handle = WarmupHandle()
+        # prune finished handles so a long-lived engine's list stays flat
+        self._warmup_handles = [
+            h for h in self._warmup_handles if not h.done()
+        ] + [handle]
+        self._m_ready.set(0.0)
+        if background:
+            thread = threading.Thread(
+                target=self._warm_buckets, args=(arrays, buckets, handle),
+                name=f"{self.name}-warmup", daemon=True,
             )
-            out = self._execute(cols, b, key)
-            jax.block_until_ready(out)
-        obs.event("serving_warmup", engine=self.name, buckets=list(buckets))
-        return list(buckets)
+            handle._threads.append(thread)
+            thread.start()
+            return handle
+        self._warm_buckets(arrays, buckets, handle)
+        return handle.wait()
+
+    def _warm_buckets(self, arrays: List[np.ndarray], buckets: List[int],
+                      handle: WarmupHandle) -> None:
+        """Warm ``buckets`` for one signature, smallest first; finishes (or
+        fails) ``handle`` and publishes readiness + duration gauges."""
+        import jax
+
+        t0 = time.monotonic()
+        key = self._key([a[:1] for a in arrays])
+        warmed: List[int] = []
+        try:
+            for b in buckets:
+                if self._crash is not None:
+                    # a crashed engine must FAIL the warmup, not report a
+                    # truncated bucket list as success (blocking callers
+                    # treat the return as 'warm')
+                    raise self._closed_error("warmup()")
+                if handle.cancelled():
+                    break
+                cols = tuple(
+                    self._cast(np.ascontiguousarray(
+                        np.broadcast_to(a[:1], (b, *a.shape[1:]))
+                    ))
+                    for a in arrays
+                )
+                out = self._execute(cols, b, key)
+                jax.block_until_ready(out)
+                warmed.append(b)
+        except BaseException as e:
+            self._m_warmup_s.set(time.monotonic() - t0)
+            obs.event("serving_warmup_failed", engine=self.name,
+                      error=type(e).__name__, warmed=warmed)
+            handle._fail(e)
+            return
+        elapsed = time.monotonic() - t0
+        self._m_warmup_s.set(elapsed)
+        if warmed == buckets:
+            self._m_ready.set(1.0)
+        obs.event("serving_warmup", engine=self.name, buckets=warmed,
+                  seconds=round(elapsed, 3),
+                  cached=self._cache is not None)
+        handle._finish(warmed)
 
     # -- worker --------------------------------------------------------------
 
@@ -867,10 +1027,95 @@ class ServingEngine:
             self._m_programs.set(len(self._programs))
             obs.event("serving_compile", engine=self.name, bucket=bucket,
                       programs=len(self._programs))
+        fn = (
+            self._jitted if self._cache is None
+            else self._aot_program(program, cols)
+        )
         with jax.profiler.StepTraceAnnotation(
             self.name, step_num=step_num
         ):
-            return self._jitted(self.params, cols)
+            try:
+                return fn(self.params, cols)
+            except ValueError as e:
+                # an update_params() that changed the param PLACEMENT (not
+                # the avals — those are validated) invalidates an AOT
+                # executable lowered for the old shardings: rebuild against
+                # the current placement (new fingerprint → correct entry)
+                if (self._cache is None
+                        or "Compiled object called with input" not in str(e)):
+                    raise
+                with self._aot_lock:
+                    self._aot_programs.pop(program, None)
+                return self._aot_program(program, cols)(self.params, cols)
+
+    # -- AOT program cache (perceiver_io_tpu.aot) ----------------------------
+
+    def _aot_program(self, program, cols: Tuple[np.ndarray, ...]):
+        """The compiled executable for ``program`` — from memory, the disk
+        cache, or a fresh compile (which is then persisted). Concurrent
+        requests for the same program (background warmup vs the worker's
+        on-demand path, or two warmup threads) build it ONCE: the first
+        caller claims the build, the rest wait on its event."""
+        while True:
+            with self._aot_lock:
+                compiled = self._aot_programs.get(program)
+                if compiled is not None:
+                    return compiled
+                claim = self._aot_claims.get(program)
+                if claim is None:
+                    claim = threading.Event()
+                    self._aot_claims[program] = claim
+                    break  # this thread owns the build
+            claim.wait()  # owner finished (or failed) — re-check / re-claim
+        try:
+            compiled = self._build_aot_program(cols)
+            with self._aot_lock:
+                self._aot_programs[program] = compiled
+            return compiled
+        finally:
+            # on failure the claim is simply released: the error propagates
+            # to this caller, and waiters re-claim (retrying the build)
+            with self._aot_lock:
+                self._aot_claims.pop(program, None)
+            claim.set()
+
+    def _build_aot_program(self, cols: Tuple[np.ndarray, ...]):
+        import jax
+
+        def sds(x):
+            # committed params (e.g. NamedSharding from a mesh-restored
+            # checkpoint) must compile AND fingerprint with their placement:
+            # a Compiled object rejects inputs whose sharding differs from
+            # what it was lowered for
+            return jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+            )
+
+        avals = jax.tree.map(sds, (self.params, tuple(cols)))
+        fp = aot_fingerprint(self._fingerprint_base(), avals=avals,
+                             extra=self._fp_sources)
+        compiled = self._cache.load(fp)
+        if compiled is None:
+            compiled = self._jitted.lower(*avals).compile()
+            self._cache.store(fp, compiled)
+        return compiled
+
+    def _fingerprint_base(self) -> Dict[str, Any]:
+        """Static (per-engine) half of every program fingerprint; computed
+        once, after the backend is up."""
+        if self._fp_base is None:
+            base = dict(environment_fingerprint())
+            base.update(
+                donate=self.donate_inputs,
+                quantize=str(self.quantize),
+                compute_dtype=str(self._compute_dtype),
+                salt=self._cache_salt,
+            )
+            # apply_fn identity: source text + closure reprs (model
+            # hyperparameters ride the flax module repr)
+            self._fp_sources = tuple(callable_sources(self._apply_fn))
+            self._fp_base = base
+        return self._fp_base
 
     def _dispatch(self, parts: List[_Part]):
         faults.inject("engine.dispatch")  # chaos hook: no-op unless installed
@@ -990,11 +1235,21 @@ class ServingEngine:
             "breaker": (self.breaker.state if self.breaker is not None
                         else "absent"),
             "programs": len(self._programs),
+            "warming": any(not h.done() for h in self._warmup_handles),
             "stats": snap,
         }
 
     def close(self, timeout: Optional[float] = None) -> None:
         """Stop accepting requests, drain everything queued, join the worker."""
+        # EVERY background warmup stops at its next bucket boundary, and we
+        # WAIT for the threads to exit (bounded): a leftover warmup compile
+        # racing whatever the process runs next corrupts the jax runtime.
+        # A build wedged past the bound is abandoned (daemon thread) rather
+        # than hanging close().
+        for h in self._warmup_handles:
+            h.cancel()
+        for h in self._warmup_handles:
+            h.join(timeout if timeout is not None else 60.0)
         self._stop.set()
         self._thread.join(timeout)
         self.heartbeat.close()
@@ -1071,6 +1326,7 @@ class MLMServer:
         dispatch_retries: int = 2,
         breaker_failures: int = 0,
         breaker_cooldown_s: float = 5.0,
+        compile_cache=None,
     ):
         import jax
 
@@ -1101,6 +1357,7 @@ class MLMServer:
         compute_dtype, quantize = resolve_params_mode(compute_dtype, quantize)
         self._compute_dtype, self._quantize = compute_dtype, quantize
         self._update_lock = threading.Lock()
+        self._warmup_handles: List[WarmupHandle] = []
         params = jax.device_put(
             prepare_param_tree(params, compute_dtype, quantize)
         )
@@ -1135,6 +1392,10 @@ class MLMServer:
             dispatch_retries=dispatch_retries,
             breaker_failures=breaker_failures,
             breaker_cooldown_s=breaker_cooldown_s,
+            # ONE ExecutableCache (resolved here so a fail-soft warning
+            # prints once, not three times) shared by all three program
+            # families; their fingerprints differ by apply-fn source/avals
+            compile_cache=resolve_cache(compile_cache, registry=registry),
         )
         # fused single-pass path (one-shot requests) + the split pair
         # (latent-cache workloads); each engine owns one program family
@@ -1308,34 +1569,108 @@ class MLMServer:
                 eng.update_params(prepared)
 
     def warmup(self, batch_buckets: Optional[Sequence[int]] = None,
-               query_buckets: Sequence[int] = (1, 2, 4)) -> int:
-        """Compile the serving programs ahead of time: for every width bucket
-        × batch bucket (× K bucket for the decode paths). Returns the number
-        of programs warmed — after this, steady-state serving never compiles
-        (the compile-count test pins it)."""
-        warmed = 0
-        for width in self.widths:
+               query_buckets: Sequence[int] = (1, 2, 4),
+               background: bool = False):
+        """Ready the serving programs ahead of traffic: every width bucket ×
+        batch bucket (× K bucket for the decode paths), cache-first when a
+        ``compile_cache`` is attached. The three program families (fused /
+        encoder / decoder) warm CONCURRENTLY on their own threads, each in
+        priority order (smallest width and bucket first).
+
+        Blocking (default): returns the number of programs warmed — after
+        this, steady-state serving never compiles (the compile-count test
+        pins it). ``background=True`` returns a :class:`WarmupHandle`
+        immediately; requests may be submitted right away and are answered
+        as soon as their program is ready (not-yet-warm programs build on
+        demand, deduped against the warmup threads in cache mode).
+        """
+        handle = WarmupHandle()
+        self._warmup_handles = [
+            h for h in self._warmup_handles if not h.done()
+        ] + [handle]
+        counts = [0, 0, 0]
+        errors: List[BaseException] = []
+
+        def example(width: int):
             # pad NOTHING in the warmup example: a fully-padded row would
             # feed the cross-attention an all-masked KV stream (NaN softmax)
-            ids = np.zeros((1, width), np.int32)
-            pad = np.zeros((1, width), bool)
-            for kb in sorted({bucket_size(int(q), width) for q in query_buckets}):
-                positions = np.zeros((1, kb), np.int32)
-                warmed += len(self.engine.warmup(
-                    ids, pad, positions, buckets=batch_buckets
+            return (np.zeros((1, width), np.int32),
+                    np.zeros((1, width), bool))
+
+        def warm_fused():
+            for width in self.widths:
+                ids, pad = example(width)
+                for kb in sorted({bucket_size(int(q), width)
+                                  for q in query_buckets}):
+                    if handle.cancelled():
+                        return
+                    positions = np.zeros((1, kb), np.int32)
+                    counts[0] += len(self.engine.warmup(
+                        ids, pad, positions, buckets=batch_buckets
+                    ))
+
+        def warm_encoder():
+            for width in self.widths:
+                if handle.cancelled():
+                    return
+                counts[1] += len(self.encoder.warmup(
+                    *example(width), buckets=batch_buckets
                 ))
-            warmed += len(self.encoder.warmup(ids, pad, buckets=batch_buckets))
-        latent_row = self.encoder.predict(
-            np.zeros((1, self.widths[0]), np.int32),
-            np.zeros((1, self.widths[0]), bool),
-        )
-        for kb in sorted({bucket_size(int(q), self.max_seq_len)
-                          for q in query_buckets}):
-            positions = np.zeros((1, kb), np.int32)
-            warmed += len(self.decoder.warmup(
-                latent_row, positions, buckets=batch_buckets
-            ))
-        return warmed
+
+        def warm_decoder():
+            # needs one latent row; the encoder dispatch dedups against
+            # warm_encoder's in-flight build of the same program
+            latent_row = self.encoder.predict(*example(self.widths[0]))
+            for kb in sorted({bucket_size(int(q), self.max_seq_len)
+                              for q in query_buckets}):
+                if handle.cancelled():
+                    return
+                positions = np.zeros((1, kb), np.int32)
+                counts[2] += len(self.decoder.warmup(
+                    latent_row, positions, buckets=batch_buckets
+                ))
+
+        def guarded(fn):
+            def run():
+                try:
+                    fn()
+                except BaseException as e:
+                    errors.append(e)
+                    # fail FAST: stop the sibling families at their next
+                    # boundary instead of paying their full compile wall
+                    # before the caller sees the first error
+                    handle.cancel()
+            return run
+
+        def supervise():
+            t0 = time.monotonic()
+            threads = [
+                threading.Thread(target=guarded(fn), name=f"mlm-warm-{i}",
+                                 daemon=True)
+                for i, fn in enumerate(
+                    (warm_fused, warm_encoder, warm_decoder))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            obs.event("mlm_server_warmup", programs=sum(counts),
+                      seconds=round(time.monotonic() - t0, 3),
+                      cancelled=handle.cancelled(), errors=len(errors))
+            if errors:
+                handle._fail(errors[0])
+            else:
+                handle._finish(sum(counts))
+
+        if background:
+            supervisor = threading.Thread(
+                target=supervise, name="mlm-warmup", daemon=True
+            )
+            handle._threads.append(supervisor)
+            supervisor.start()
+            return handle
+        supervise()
+        return handle.wait()
 
     def stats(self) -> Dict[str, Any]:
         """Locked, deep-copied snapshot across the three engines (the
@@ -1350,6 +1685,13 @@ class MLMServer:
         }
 
     def close(self) -> None:
+        # ask every warm run's threads to stop, then WAIT for the
+        # supervisors (which join them) — no warmup compile may outlive
+        # the server (see ServingEngine.close)
+        for h in self._warmup_handles:
+            h.cancel()
+        for h in self._warmup_handles:
+            h.join(60.0)
         self.engine.close()
         self.encoder.close()
         self.decoder.close()

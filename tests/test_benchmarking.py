@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from perceiver_io_tpu.models.presets import flagship_mlm
 from perceiver_io_tpu.training import (
@@ -34,6 +35,9 @@ def _tiny_setup():
     return train_step, state, batch
 
 
+@pytest.mark.slow  # tier-1 budget (r10): the chained-window timing harness
+# stays tier-1 via test_time_train_step_accepts_prebuilt_jit (same loop,
+# prebuilt-jit path) and the bench contract tests
 def test_time_train_step_returns_positive_and_advances_state():
     train_step, state, batch = _tiny_setup()
     seconds, final_state = time_train_step(train_step, state, batch, steps=2)

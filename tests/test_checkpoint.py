@@ -238,6 +238,9 @@ def test_save_last_and_prefer_latest(tmp_path, state_and_batch):
     assert int(best.step) == int(champion.step)
 
 
+@pytest.mark.slow  # tier-1 budget (r10): prefer_latest semantics stay
+# tier-1 in test_save_last_and_prefer_latest; the corrupted-newest fallback
+# in tests/test_resilience.py
 def test_prefer_latest_without_last_slot(tmp_path, state_and_batch):
     """prefer_latest with no last/ dir falls back to the ranked slot."""
     model, state, batch, schedule = state_and_batch

@@ -25,6 +25,9 @@ def _common(tmp_path, name):
     ]
 
 
+@pytest.mark.slow  # tier-1 budget (r10): the image-classifier CLI e2e stays
+# tier-1 via test_train_imagenet (imagefolder task); MNIST data/adapters in
+# tests/test_data.py and tests/test_adapters.py
 def test_train_img_clf(tmp_path):
     run_dir = train_img_clf.main(
         _common(tmp_path, "img") + TINY_MODEL + [
@@ -55,6 +58,9 @@ def test_train_mlm_hybrid_dcn_mesh(tmp_path):
     assert losses and np.isfinite(losses).all()
 
 
+@pytest.mark.slow  # tier-1 budget (r10): fused-head numerics stay tier-1 in
+# tests/test_train_steps.py::test_mlm_step_fused_head_matches_unfused; flag
+# parsing in test_all_parsers_build_and_render_help
 def test_train_mlm_fused_head_flag(tmp_path):
     """--fused_head pallas trains end to end (interpret mode off-TPU) and
     --fused_head pallas under --tp vocab sharding is rejected with the
@@ -178,6 +184,29 @@ def test_serve_cli_end_to_end(tmp_path):
                 "--texts", "a [MASK] b"]
     )
     assert int8w[0]["fills"] == fused[0]["fills"]
+
+    # zero-recompile cold start: --compile_cache serves identical fills and
+    # persists the on-demand programs as .pitx entries (the zero-compile
+    # warm-family assertion lives in test_engine.py / test_aot_cache.py;
+    # --no_warmup keeps this run inside the tier-1 budget)
+    cache_dir = tmp_path / "ccache"
+    cached_serve = serve.main(
+        base + ["--compile_cache", str(cache_dir), "--no_warmup",
+                "--texts", "a [MASK] b"]
+    )
+    assert cached_serve[0]["fills"] == fused[0]["fills"]
+    assert any(f.endswith(".pitx") for f in os.listdir(cache_dir))
+
+    # fail-soft (satellite): a cache path that cannot exist (nested under a
+    # regular file) must WARN and serve uncached — never refuse traffic
+    blocker = tmp_path / "a_file"
+    blocker.write_text("x")
+    with pytest.warns(UserWarning, match="unusable"):
+        soft = serve.main(
+            base + ["--compile_cache", str(blocker / "cache"), "--no_warmup",
+                    "--texts", "a [MASK] b"]
+        )
+    assert soft[0]["fills"] == fused[0]["fills"]
 
     with pytest.raises(SystemExit, match="nothing to serve"):
         serve.main(base)
@@ -340,6 +369,10 @@ def test_json_emitters_keep_one_line_stdout_contract(tmp_path):
         assert json.loads(f.read()) == report
 
 
+@pytest.mark.slow  # tier-1 budget (r10): the int8w parity bounds stay
+# tier-1 in tests/test_quant.py (engine parity vs the f32 oracle) and the
+# serve --quantize int8 e2e; the one-JSON-line stdout contract shape is
+# asserted tier-1 by the inference_bench/coldstart_bench contract tests
 def test_quant_bench_cpu_emits_one_json_line(tmp_path):
     """tools/quant_bench.py --cpu runs the interleaved bf16-vs-int8w engine
     A/B offline and emits EXACTLY one JSON line on stdout (the driver's
@@ -368,6 +401,64 @@ def test_quant_bench_cpu_emits_one_json_line(tmp_path):
     # the documented tiny-preset parity bound (PERF.md §Quantization)
     assert result["parity_int8w_rel_err"] <= 0.05, result
     assert 0 < result["predicted_weight_stream_ratio"] < 1, result
+
+
+def test_train_cli_compile_cache_persists_step_compiles(tmp_path):
+    """--compile_cache on a train CLI (tier 2: jax's persistent compilation
+    cache) populates the directory with the step's compiled entries and the
+    run stays green. Subprocess on purpose: the recorded negative result
+    (PERF.md §Cold start) forbids flipping the process-global cache config
+    inside the tier-1 process, where later tests serialize AOT executables."""
+    import subprocess
+    import sys
+
+    cache = tmp_path / "tcache"
+    proc = subprocess.run(
+        [sys.executable, "-m", "perceiver_io_tpu.cli.train_mlm",
+         "--synthetic", "--synthetic_size", "32", "--batch_size", "16",
+         "--max_seq_len", "32", "--vocab_size", "90",
+         "--num_latents", "4", "--num_latent_channels", "16",
+         "--num_encoder_layers", "1",
+         "--num_self_attention_layers_per_block", "1",
+         "--num_cross_attention_heads", "2", "--num_self_attention_heads", "2",
+         "--dtype", "float32", "--max_steps", "1", "--log_every_n_steps", "1",
+         "--logdir", str(tmp_path / "logs"), "--root", str(tmp_path / "cache"),
+         "--compile_cache", str(cache)],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert f"persistent compilation cache: {cache}" in proc.stderr
+    assert any(n.endswith("-cache") for n in os.listdir(cache)), (
+        "no compiled entries persisted")
+
+
+def test_coldstart_bench_cpu_emits_one_json_line(tmp_path):
+    """tools/coldstart_bench.py --cpu runs the same-process cold-vs-warm
+    warmup A/B over the AOT executable cache and emits EXACTLY one JSON line
+    on stdout. The acceptance bars ride the record: the warm pass performs
+    ZERO XLA compiles and is >= 5x faster than the cold pass, and the
+    background arm answers its first request before the family is warm."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "coldstart_bench.py"),
+         "--cpu", "--max_batch", "4", "--widths", "32",
+         "--cache_dir", str(tmp_path / "cache")],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    result = json.loads(lines[0])
+    assert result["metric"] == "coldstart_warmup_speedup"
+    assert result["backend"] == "cpu"
+    assert result["compiles_warm"] == 0, result
+    assert result["compiles_cold"] == result["programs"] > 0, result
+    assert result["speedup"] >= 5, result
+    assert result["bg_first_result_s"] <= result["bg_family_warm_s"], result
 
 
 def test_bench_backend_probe_emits_json_error_record():
@@ -450,6 +541,8 @@ def test_train_multimodal(tmp_path):
     assert os.path.isdir(os.path.join(run_dir, "checkpoints"))
 
 
+@pytest.mark.slow  # tier-1 budget (r10): near-duplicate of the flow CLI e2e
+# in tests/test_flow_data.py::test_train_flow_cli (tier-1)
 def test_train_flow(tmp_path):
     from perceiver_io_tpu.cli import train_flow
 
@@ -533,6 +626,9 @@ def test_mlm_preset_flagship_tpu_defaults():
     assert args.attn_impl == "auto"
 
 
+@pytest.mark.slow  # tier-1 budget (r10): zero3 rule correctness stays
+# tier-1 in tests/test_sharding.py::test_zero3_param_sharding and the
+# checkpoint path in test_zero3_sharded_state_round_trip
 def test_train_mlm_zero3(tmp_path):
     """--zero3 (ZeRO-3/FSDP flavor: params AND opt-state over the data
     axis, GSPMD all-gather-on-use) trains end to end on the 8-device mesh

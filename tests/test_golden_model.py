@@ -335,6 +335,9 @@ def test_training_trajectory_matches_torch(rng):
         )
 
 
+@pytest.mark.slow  # tier-1 budget (r10): the torch-trajectory oracle stays
+# tier-1 at base scale in test_training_trajectory_matches_torch; this is
+# the schedule-scale variant of the same assertion
 def test_training_trajectory_matches_torch_at_schedule_scale(rng):
     """Trajectory parity over 80 steps with the PRODUCTION training recipe:
     AdamW + decoupled weight decay + OneCycle LR (pct_start 0.25 → a full

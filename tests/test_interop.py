@@ -340,6 +340,9 @@ def test_export_orbax_roundtrip(tmp_path):
     jax.tree.map(np.testing.assert_array_equal, enc, params["encoder"])
 
 
+@pytest.mark.slow  # tier-1 budget (r10): torch-checkpoint import parity
+# stays tier-1 in the import_reference tests here; encoder-transfer
+# semantics in tests/test_train_steps.py::test_frozen_encoder_transfer
 def test_seq_clf_cli_accepts_torch_ckpt(tmp_path):
     """The reference's pretrained-weights entry (README.md:46-48): hand a
     Lightning .ckpt straight to --mlm_checkpoint."""

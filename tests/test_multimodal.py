@@ -175,6 +175,9 @@ def test_autoencoder_forward_shapes(rng):
         assert np.isfinite(np.asarray(v)).all()
 
 
+@pytest.mark.slow  # tier-1 budget (r10): multimodal forward/loss parity
+# stays tier-1 (test_video_patch_loss_matches_pixel_loss, sharded variant
+# in tests/test_sharding.py) and the CLI e2e in test_cli.py runs the loop
 def test_autoencoder_learns(rng):
     model = _tiny_autoencoder()
     batch = {

@@ -141,6 +141,8 @@ def test_dp_tp_sp_matches_single_device(mlm_setup):
     np.testing.assert_allclose(sharded, ref, atol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 budget (r10): pure-dp semantics are a subset of
+# the composite test_dp_tp_sp_matches_single_device parity gate (tier-1)
 def test_pure_dp_matches_single_device(mlm_setup):
     model, state, batch, train_step = mlm_setup
     _, ref = _run(jax.jit(train_step), state, batch)
@@ -649,6 +651,9 @@ def test_pallas_sp_indivisible_batch_falls_back(mlm_parts):
     np.testing.assert_allclose(got, ref, atol=2e-5)
 
 
+@pytest.mark.slow  # tier-1 budget (r10): the sp-kernel parity gate stays
+# tier-1 in test_pallas_sp_step_matches_xla_and_shards_kv; the fallback
+# routing in test_pallas_sp_indivisible_batch_falls_back
 def test_pallas_sp_without_mesh_degrades_to_pallas(mlm_parts):
     """attn_impl='pallas_sp' on a single device (no active regime) must be
     exactly the plain kernel path — same trajectory, no mesh required."""

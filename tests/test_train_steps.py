@@ -113,6 +113,9 @@ def test_image_classifier_learns(rng):
     np.testing.assert_allclose(float(metrics["lr"]), 3e-3, rtol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 budget (r10): convergence coverage retained by
+# tests/test_inference.py::test_mlm_fill_masks_learns_pattern (end-to-end
+# learning) and the trainer fit tests (tests/test_trainer.py)
 def test_mlm_learns(rng):
     model = build_mlm()
     # strongly structured data: token depends on position
@@ -356,6 +359,9 @@ def test_mlm_step_fused_head_matches_unfused(rng):
     )
 
 
+@pytest.mark.slow  # tier-1 budget (r10): fused-head parity stays tier-1 in
+# test_mlm_step_fused_head_matches_unfused; padded-vocab head behavior in
+# tests/test_sharding.py::test_padded_vocab_projection_shards_under_tp
 def test_fused_head_with_padded_vocab(rng):
     """pad_classes_to: padded columns must not leak into the fused lse."""
     import jax

@@ -330,6 +330,9 @@ def test_sigterm_saves_last_and_resumes(tmp_path):
     assert _signal.getsignal(_signal.SIGTERM) == _signal.SIG_DFL
 
 
+@pytest.mark.slow  # tier-1 budget (r10): trainer-level resume stays tier-1
+# in test_fit_max_steps_and_resume; the stricter CLI resume contract in
+# tests/test_cli.py::test_bucketed_stacked_resume_is_bit_for_bit
 def test_cli_resume_continues_run(tmp_path):
     """--resume picks up the newest checkpoint and logs into the same dir."""
     from perceiver_io_tpu.cli import train_img_clf
@@ -357,7 +360,14 @@ def test_cli_resume_continues_run(tmp_path):
     assert max(steps2) == 6 and steps1 < steps2
 
 
-@pytest.mark.parametrize("mesh", [None, "dp"])
+@pytest.mark.parametrize("mesh", [
+    None,
+    # tier-1 budget (r10): the dp x scan composition also rides
+    # test_eval_shardings_unstacked_with_multistep_dispatch and the
+    # bucketed+stacked CLI resume test; the K-step arithmetic itself
+    # stays tier-1 via the mesh-free variant
+    pytest.param("dp", marks=pytest.mark.slow),
+])
 def test_steps_per_dispatch_matches_per_step(tmp_path, mesh):
     """Multi-step dispatch (lax.scan over K stacked batches) must reproduce
     the per-step loop: same step count, same final loss trajectory, eval

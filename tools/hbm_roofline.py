@@ -295,6 +295,10 @@ def main() -> None:
     parser.add_argument("--trace-dir", default=None,
                         help="analyze an existing trace instead of capturing")
     args = parser.parse_args()
+
+    from perceiver_io_tpu.aot import maybe_enable_cache_from_env
+
+    maybe_enable_cache_from_env()  # PIT_COMPILE_CACHE opt-in (stderr only)
     os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
 
     config = args.config

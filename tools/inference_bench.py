@@ -359,6 +359,9 @@ def main() -> None:
         from perceiver_io_tpu.utils.platform import ensure_cpu_only
 
         ensure_cpu_only()
+    from perceiver_io_tpu.aot import maybe_enable_cache_from_env
+
+    maybe_enable_cache_from_env()  # PIT_COMPILE_CACHE opt-in (stderr only)
     import jax
 
     if args.engine:

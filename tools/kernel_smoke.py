@@ -227,6 +227,9 @@ def run(out_path: str | None, dry: bool = False) -> int:
                 f.write(line + "\n")
         return 0
 
+    from perceiver_io_tpu.aot import maybe_enable_cache_from_env
+
+    maybe_enable_cache_from_env()  # PIT_COMPILE_CACHE opt-in (stderr only)
     import jax
 
     results, failures = [], {}
