@@ -41,8 +41,18 @@ soon as its program is ready, not after the whole family is warm
 (``--blocking_warmup`` restores the old wait). A missing/unusable cache dir
 warns and serves uncached — a cache problem never refuses traffic.
 
+``--slo_p99_ms`` declares a serving SLO (``perceiver_io_tpu.obs.slo``):
+every answered/shed request classifies against the latency target, the
+windowed error-budget burn rate rides ``/metrics``+``/statz`` as ``slo_*``
+gauges, and ``/healthz`` degrades when the burn rate crosses
+``--slo_burn_alert``. Per-request phase tracing
+(``serving_phase_seconds{phase=...}``) attributes tail latency to
+admission/queue/assembly/dispatch/device/complete; sweep offered load and
+fit the capacity model with ``tools/load_bench.py`` (PERF.md §SLO).
+
 ``--metrics_port`` starts the localhost observability sidecar
-(``/metrics`` Prometheus text, ``/healthz``, ``/statz`` JSON snapshot);
+(``/metrics`` Prometheus text, ``/healthz``, ``/statz`` JSON snapshot, now
+including process self-metrics RSS/uptime/threads/GC at every scrape);
 ``--heartbeat_deadline_s`` arms the wedged-tunnel dispatch heartbeat;
 ``--selfprofile_every`` turns on the in-loop device-trace watchdog. All
 telemetry output rides stderr/HTTP — stdout stays one JSON line per text.
@@ -169,8 +179,33 @@ def build_parser() -> argparse.ArgumentParser:
                         "in-process, and publish device-clock step time "
                         "gauges. Default: off")
     o.add_argument("--events_jsonl", default=None,
-                   help="append runtime events (compiles, warmups, stalls) "
-                        "as JSON lines to this file")
+                   help="append runtime events (compiles, warmups, stalls, "
+                        "per-request phase spans) as JSON lines to this file "
+                        "(size-capped rotation: see --events_max_mb)")
+    o.add_argument("--events_max_mb", type=float, default=64.0,
+                   help="rotate the events file past this size, keeping 3 "
+                        "numbered segments (a week of serving cannot grow "
+                        "it unboundedly); 0 disables rotation")
+    o.add_argument("--span_every", type=int, default=1,
+                   help="emit a request_phases span for every Nth completed "
+                        "request part (each span is a synchronous JSONL "
+                        "write — sample at high request rates; the "
+                        "serving_phase_seconds histograms keep the "
+                        "full-rate view regardless)")
+    o.add_argument("--slo_p99_ms", type=float, default=None,
+                   help="serving SLO latency target: a request answered "
+                        "within this many ms counts good, sheds/errors and "
+                        "slower answers burn the error budget. Enables the "
+                        "slo_* burn-rate gauges on /metrics and /statz and "
+                        "wires the burn alert into /healthz "
+                        "(obs/slo.py; sweep with tools/load_bench.py)")
+    o.add_argument("--slo_availability", type=float, default=0.999,
+                   help="fraction of requests that must meet the SLO "
+                        "(error budget = 1 - this)")
+    o.add_argument("--slo_burn_alert", type=float, default=2.0,
+                   help="/healthz degrades when the windowed error-budget "
+                        "burn rate exceeds this (1.0 = spending the budget "
+                        "exactly as it accrues); 0 disables the health wire")
     parser.add_argument("--cpu", action="store_true",
                         help="pin to the CPU backend (ensure_cpu_only before "
                              "jax initializes) — the offline/tier-1 mode")
@@ -192,12 +227,19 @@ def main(argv: Optional[Sequence[str]] = None):
     from perceiver_io_tpu.inference import MLMServer, load_mlm_checkpoint
 
     if args.events_jsonl:
-        obs.configure_event_log(args.events_jsonl)
+        obs.configure_event_log(
+            args.events_jsonl,
+            max_bytes=(int(args.events_max_mb * 1024 * 1024)
+                       if args.events_max_mb > 0 else None),
+        )
     obs_server = None
     if args.metrics_port is not None:
         # started BEFORE the checkpoint load / warmup so probes can watch a
         # slow bring-up; counters stay zero until requests arrive. stdout is
-        # the result stream — the sidecar address goes to stderr.
+        # the result stream — the sidecar address goes to stderr. Process
+        # self-metrics (RSS/uptime/threads/GC) refresh at every scrape so
+        # saturation correlates with host pressure.
+        obs.install_process_metrics()
         obs_server = obs.ObsServer(port=args.metrics_port)
         url = obs_server.start()
         if url is not None:
@@ -230,6 +272,16 @@ def _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint):
         dtype="bfloat16" if args.dtype == "bfloat16" else None,
     )
 
+    import perceiver_io_tpu.obs as obs
+
+    slo = None
+    if args.slo_p99_ms is not None:
+        slo = obs.SLO(
+            latency_target_s=args.slo_p99_ms / 1e3,
+            availability_target=args.slo_availability,
+            burn_alert=args.slo_burn_alert if args.slo_burn_alert > 0 else None,
+        )
+
     results = []
     with MLMServer(
         model, params, tokenizer, max_seq_len,
@@ -246,6 +298,8 @@ def _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint):
         breaker_failures=args.breaker_failures,
         breaker_cooldown_s=args.breaker_cooldown_s,
         compile_cache=args.compile_cache,
+        slo=slo,
+        span_every=args.span_every,
     ) as server:
         warmup_handle = None
         if not args.no_warmup:

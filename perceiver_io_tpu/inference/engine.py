@@ -77,6 +77,23 @@ device can misbehave the way the tunneled backend actually does —
 Shed/retry/breaker counts export as ``serving_shed_total{reason=...}`` /
 ``serving_dispatch_retries_total`` / ``breaker_*``.
 
+SLO observability (``perceiver_io_tpu.obs.slo``, ``tools/load_bench.py``):
+every request part carries phase timestamps through its whole lifecycle —
+submit → queue → batch assembly → dispatch → device compute → completion —
+exported per phase as ``serving_phase_seconds{phase=...}`` histograms, as
+JSONL ``request_phases`` spans when an event log is configured
+(``span_every=N`` samples them — each span is a synchronous write), and on
+the caller's future (``fut.phases``). The phases are consecutive timestamp
+diffs, so their sum reconciles with the end-to-end
+``serving_latency_seconds`` by construction (``serving_phase_sum_ratio`` is
+the live self-check; the test suite pins the p50 reconciliation within 5%).
+Tail latency therefore ATTRIBUTES: "p99 is high" becomes "p99 is high
+because admission wait, not device time". Passing ``slo=obs.SLO(...)``
+additionally classifies every completion/shed against a declarative
+objective — error-budget burn-rate gauges ride ``/statz`` and ``healthz()``,
+and ``tools/load_bench.py`` fits the measured capacity model
+(requests/s/chip at the SLO) from an open-loop offered-load sweep.
+
 Zero-recompile cold start (``perceiver_io_tpu.aot``): ``compile_cache=DIR``
 persists every compiled bucket program to disk
 (``jax.experimental.serialize_executable``), keyed by a content fingerprint
@@ -125,6 +142,19 @@ from perceiver_io_tpu.resilience import (
 )
 
 _IDLE_POLL_S = 0.05  # worker wake-up cadence while idle (checks shutdown)
+
+# per-request lifecycle phases, in order; consecutive timestamp diffs, so the
+# sum reconciles with the end-to-end latency by construction (the self-check
+# rides serving_phase_sum_ratio and the test suite):
+#   admission — submit() entry → part enqueued (validation, chunking, bounds)
+#   queue     — enqueued → sealed into a micro-batch by the worker
+#   assembly  — sealed → padded/cast columns built (host batch formation)
+#   dispatch  — columns → the program call returned (host dispatch; a cold
+#               program pays its compile/deserialize here)
+#   device    — dispatch returned → outputs fetched to host (device compute
+#               plus any wait behind earlier in-flight dispatches)
+#   complete  — fetched → this part's future delivered (slicing, fan-out)
+PHASES = ("admission", "queue", "assembly", "dispatch", "device", "complete")
 
 
 def resolve_params_mode(
@@ -250,6 +280,19 @@ class _Future:
         self._transform = transform
         self._assembled = None
         self._has_result = False
+        self._phases: List[Dict[str, float]] = []
+
+    def _note_phases(self, phases: Dict[str, float]) -> None:
+        with self._lock:
+            self._phases.append(phases)
+
+    @property
+    def phases(self) -> List[Dict[str, float]]:
+        """Per-part phase timings (seconds, :data:`PHASES` keys) recorded at
+        completion — one dict per dispatched part, the caller-side view the
+        load harness consumes without scraping the registry."""
+        with self._lock:
+            return [dict(p) for p in self._phases]
 
     def _deliver(self, index: int, result) -> None:
         with self._lock:
@@ -295,21 +338,31 @@ class _Part:
     ``deadline`` (monotonic, or None) is checked at batch assembly — expired
     parts are shed, never dispatched. ``retries`` counts transient
     re-dispatch cycles this part has ridden (worker-thread-only writes).
+
+    Phase timestamps (monotonic): ``t_entry`` (submit() entry),
+    ``t_submit`` (enqueued), then worker-written ``t_sealed`` / ``t_built`` /
+    ``t_sent`` — a retried part overwrites them on its final dispatch, so the
+    queue phase absorbs the retry wait and the sum still partitions
+    [t_entry, delivery].
     """
 
     __slots__ = ("inputs", "n", "key", "future", "index", "t_submit",
-                 "deadline", "retries")
+                 "deadline", "retries", "t_entry", "t_sealed", "t_built",
+                 "t_sent")
 
     def __init__(self, inputs: List[np.ndarray], key, future: _Future,
-                 index: int, deadline: Optional[float] = None):
+                 index: int, deadline: Optional[float] = None,
+                 t_entry: Optional[float] = None):
         self.inputs = inputs
         self.n = inputs[0].shape[0]
         self.key = key
         self.future = future
         self.index = index
         self.t_submit = time.monotonic()
+        self.t_entry = self.t_submit if t_entry is None else t_entry
         self.deadline = deadline
         self.retries = 0
+        self.t_sealed = self.t_built = self.t_sent = self.t_submit
 
 
 class ServingEngine:
@@ -366,6 +419,8 @@ class ServingEngine:
         breaker_cooldown_s: float = 5.0,
         compile_cache=None,
         cache_salt: str = "",
+        slo: Optional[obs.SLO] = None,
+        span_every: int = 1,
     ):
         import jax
         import jax.numpy as jnp
@@ -445,6 +500,10 @@ class ServingEngine:
         self._stats: Dict[str, Any] = {
             "requests": 0, "rows": 0, "batches": 0, "padded_rows": 0,
             "latency_s_by_bucket": {},
+            # per-phase latency windows, written at completion under this
+            # same lock so stats() snapshots latency AND its attribution in
+            # one consistent read (external pollers never see torn phases)
+            "phase_s": {},
         }
         self._dispatch_seq = 0  # StepTraceAnnotation ids (under _stats_lock)
         self._inflight_count = 0  # worker-written, racily read by diagnostics
@@ -480,6 +539,23 @@ class ServingEngine:
             "serving_admission_wait_seconds",
             "submit → dispatch wait per request part", labels)
         self._latency_hists: Dict[int, obs.Histogram] = {}
+        # per-request phase attribution: "p99 is high" becomes "p99 is high
+        # because admission wait, not device time" — one histogram per
+        # lifecycle phase, observed at completion from the part's timestamps
+        self._m_phase = {
+            phase: reg.histogram(
+                "serving_phase_seconds",
+                "per-request-part time in each lifecycle phase "
+                "(admission|queue|assembly|dispatch|device|complete; the "
+                "phase sum reconciles with serving_latency_seconds)",
+                {**labels, "phase": phase})
+            for phase in PHASES
+        }
+        self._m_phase_ratio = reg.gauge(
+            "serving_phase_sum_ratio",
+            "phase-sum / end-to-end latency of the last completed part "
+            "(the tracing self-check: ~1.0 when the phases partition the "
+            "request lifetime)", labels)
         shed_help = "requests/parts shed instead of served, by reason"
         self._m_shed = {
             reason: reg.counter("serving_shed_total", shed_help,
@@ -522,6 +598,19 @@ class ServingEngine:
                 name=name, failure_threshold=breaker_failures,
                 cooldown_s=breaker_cooldown_s, registry=reg,
             )
+
+        # declarative objective: every completion/shed classifies against it,
+        # burn-rate gauges ride the registry and healthz() (obs/slo.py)
+        self.slo_tracker: Optional[obs.SLOTracker] = None
+        if slo is not None:
+            self.slo_tracker = obs.SLOTracker(slo, registry=reg, labels=labels)
+
+        # JSONL request_phases spans are a locked write+flush per emission —
+        # at thousands of req/s that synchronous disk touch sits on the
+        # completion path, so high-rate serving samples every Nth part
+        # (the registry histograms keep the full-rate view regardless)
+        self._span_every = max(1, int(span_every))
+        self._span_seq = 0  # worker-thread-only
 
         self.heartbeat = obs.Heartbeat(
             f"{name}-dispatch", deadline_s=heartbeat_deadline_s,
@@ -634,6 +723,15 @@ class ServingEngine:
             return err
         return EngineClosed(f"{verb} on a closed engine")
 
+    def _slo_bad(self, n: int = 1) -> None:
+        """Shed/failed work counts against the SLO's error budget. The unit
+        is the PART (what completions record); admission-time refusals that
+        happen before the request is chunked (breaker open, pre-expired
+        deadline) record one sample — their part count does not exist yet."""
+        if self.slo_tracker is not None:
+            for _ in range(n):
+                self.slo_tracker.record(ok=False)
+
     def submit(self, *inputs, transform: Optional[Callable] = None,
                deadline_s: Optional[float] = None) -> _Future:
         """Enqueue one request (arrays sharing a leading batch axis); returns
@@ -647,10 +745,12 @@ class ServingEngine:
         fast-fail with :class:`RejectedError` (queue full) or
         :class:`BreakerOpen` (device presumed down).
         """
+        t_entry = time.monotonic()
         if self._stop.is_set():
             raise self._closed_error()
         if self.breaker is not None and not self.breaker.allow():
             self._m_shed["breaker_open"].inc()
+            self._slo_bad()
             raise BreakerOpen(
                 f"engine {self.name!r}: circuit breaker open "
                 f"(device presumed down; cooldown {self.breaker.cooldown_s:g}s)"
@@ -659,6 +759,7 @@ class ServingEngine:
             deadline_s = self.request_deadline_s
         if deadline_s is not None and deadline_s <= 0:
             self._m_shed["deadline"].inc()
+            self._slo_bad()
             raise DeadlineExceeded(
                 f"request deadline {deadline_s}s already expired at admission"
             )
@@ -685,6 +786,9 @@ class ServingEngine:
                 admitted = True
         if not admitted:
             self._m_shed["queue_full"].inc()
+            # per PART, the same unit completions record at — a shed 4-part
+            # request must weigh as much in the burn rate as a served one
+            self._slo_bad(len(starts))
             raise RejectedError(
                 f"engine {self.name!r}: queue full ({backlog} parts "
                 f"backlogged, limit {self.queue_limit}) — request shed"
@@ -699,7 +803,8 @@ class ServingEngine:
         for index, start in enumerate(starts):
             chunk = [a[start: start + self.max_batch] for a in arrays]
             self._queue.put(
-                _Part(chunk, self._key(chunk), fut, index, deadline=deadline)
+                _Part(chunk, self._key(chunk), fut, index, deadline=deadline,
+                      t_entry=t_entry)
             )
         self._m_queue.set(self._queue.qsize())
         if self._stop.is_set() and not self._thread.is_alive():
@@ -910,6 +1015,7 @@ class ServingEngine:
         for p in parts:
             if p.deadline is not None and now >= p.deadline:
                 self._m_shed["deadline"].inc()
+                self._slo_bad()
                 obs.event("engine_request_shed", engine=self.name,
                           reason="deadline",
                           waited_s=round(now - p.t_submit, 4))
@@ -953,6 +1059,7 @@ class ServingEngine:
             return
         obs.event("engine_batch_failed", engine=self.name, where=where,
                   error=type(error).__name__, retries=retries)
+        self._slo_bad(len(parts))
         for p in parts:
             p.future._fail(error)
 
@@ -1118,6 +1225,9 @@ class ServingEngine:
         return self._fp_base
 
     def _dispatch(self, parts: List[_Part]):
+        t_sealed = time.monotonic()  # the micro-batch is decided: queue ends
+        for p in parts:
+            p.t_sealed = t_sealed
         faults.inject("engine.dispatch")  # chaos hook: no-op unless installed
         n = sum(p.n for p in parts)
         bucket = bucket_size(n, self.max_batch)
@@ -1137,7 +1247,11 @@ class ServingEngine:
         now = time.monotonic()
         for p in parts:
             self._m_wait.observe(now - p.t_submit)
+            p.t_built = now
         out = self._execute(tuple(cols), bucket, parts[0].key)
+        t_sent = time.monotonic()
+        for p in parts:
+            p.t_sent = t_sent
         with self._stats_lock:
             self._stats["batches"] += 1
             self._stats["rows"] += n
@@ -1172,26 +1286,64 @@ class ServingEngine:
             return
         if self.breaker is not None:
             self.breaker.record_success()
-        now = time.monotonic()
+        t_fetched = time.monotonic()  # device phase ends: outputs on host
         hist = self._latency_hist(bucket)
-        latencies = []
+        emit_spans = obs.get_event_log() is not None
+        latencies, phase_rows = [], []
         offset = 0
         for p in parts:
+            now = time.monotonic()
+            # consecutive diffs over the part's timestamps: the phases
+            # PARTITION [t_entry, now], so their sum reconciles with the
+            # end-to-end latency by construction (self-check below; the sum
+            # exceeds e2e by exactly the admission phase, since the latency
+            # metric's clock starts at enqueue)
+            phases = {
+                "admission": p.t_submit - p.t_entry,
+                "queue": p.t_sealed - p.t_submit,
+                "assembly": p.t_built - p.t_sealed,
+                "dispatch": p.t_sent - p.t_built,
+                "device": t_fetched - p.t_sent,
+                "complete": now - t_fetched,
+            }
+            e2e = now - p.t_submit
+            for k, v in phases.items():
+                self._m_phase[k].observe(v)
+            if e2e > 0:
+                self._m_phase_ratio.set(sum(phases.values()) / e2e)
+            # record BEFORE delivering: result() waking the caller is the
+            # publication point — a caller reading fut.phases right after
+            # result() must find this part's record already there
+            p.future._note_phases(phases)
+            if self.slo_tracker is not None:
+                self.slo_tracker.record(latency_s=e2e, ok=True)
+            self._span_seq += 1
+            if emit_spans and self._span_seq % self._span_every == 0:
+                obs.event("request_phases", engine=self.name, bucket=bucket,
+                          rows=p.n, total_s=round(e2e, 6),
+                          **{k: round(v, 6) for k, v in phases.items()})
+            latencies.append(e2e)
+            hist.observe(e2e)
+            phase_rows.append(phases)
             o = offset
             p.future._deliver(
                 p.index, jax.tree.map(lambda a: a[o: o + p.n], host)
             )
-            latencies.append(now - p.t_submit)
-            hist.observe(latencies[-1])
             offset += p.n
         with self._stats_lock:
             # bounded: an engine serves indefinitely — unbounded per-request
             # float lists would grow without limit; the window is plenty for
-            # p50/p95 reporting
+            # p50/p95 reporting. Phase rows land under the SAME lock (and in
+            # the same order) as the latencies they attribute, so stats()
+            # reads a consistent latency+attribution pair.
             lat = self._stats["latency_s_by_bucket"].setdefault(
                 bucket, deque(maxlen=4096)
             )
             lat.extend(latencies)
+            ph = self._stats["phase_s"]
+            for row in phase_rows:
+                for k, v in row.items():
+                    ph.setdefault(k, deque(maxlen=4096)).append(v)
 
     # -- introspection / lifecycle -------------------------------------------
 
@@ -1211,11 +1363,16 @@ class ServingEngine:
         with self._stats_lock:
             snap: Dict[str, Any] = {
                 k: v for k, v in self._stats.items()
-                if k != "latency_s_by_bucket"
+                if k not in ("latency_s_by_bucket", "phase_s")
             }
             snap["latency_s_by_bucket"] = {
                 b: list(d)
                 for b, d in self._stats["latency_s_by_bucket"].items()
+            }
+            # same locked deep-copy as the latencies: external pollers (the
+            # future router tier) never read torn phase attribution
+            snap["phase_s"] = {
+                k: list(d) for k, d in self._stats["phase_s"].items()
             }
         return snap
 
@@ -1225,6 +1382,7 @@ class ServingEngine:
         a wedged worker cannot be asked to cooperate)."""
         snap = self.stats()
         snap.pop("latency_s_by_bucket", None)
+        snap.pop("phase_s", None)
         with self._stats_lock:
             backlog = self._backlog
         return {
@@ -1255,6 +1413,8 @@ class ServingEngine:
         self.heartbeat.close()
         if self.breaker is not None:
             self.breaker.close()
+        if self.slo_tracker is not None:
+            self.slo_tracker.close()
         if self._profiler is not None:
             self._profiler.close()
         # a submit() racing close() can slip a part in after the worker
@@ -1327,6 +1487,8 @@ class MLMServer:
         breaker_failures: int = 0,
         breaker_cooldown_s: float = 5.0,
         compile_cache=None,
+        slo: Optional[obs.SLO] = None,
+        span_every: int = 1,
     ):
         import jax
 
@@ -1392,6 +1554,11 @@ class MLMServer:
             dispatch_retries=dispatch_retries,
             breaker_failures=breaker_failures,
             breaker_cooldown_s=breaker_cooldown_s,
+            # one SLO spec, one tracker per engine (labeled by engine name):
+            # the fused path's burn rate and the latent-cache halves' stay
+            # separately attributable on /statz and healthz()
+            slo=slo,
+            span_every=span_every,
             # ONE ExecutableCache (resolved here so a fail-soft warning
             # prints once, not three times) shared by all three program
             # families; their fingerprints differ by apply-fn source/avals

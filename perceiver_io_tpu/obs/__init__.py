@@ -14,6 +14,11 @@ One subsystem, four pieces, every layer wired through it:
   live device-step-time / MFU / recompile gauges.
 - :mod:`http` — the localhost sidecar serving ``/metrics`` (Prometheus text),
   ``/healthz``, and ``/statz``.
+- :mod:`slo` — declarative serving objectives: per-request accounting into
+  error-budget burn-rate gauges (wired into ``healthz()``), and the capacity
+  model fitted from an offered-load sweep (``tools/load_bench.py``).
+- :mod:`process` — process self-metrics (RSS, uptime, threads, GC) refreshed
+  at scrape time via the registry's collector hook.
 
 Importing this package never initializes a jax backend — entry points stay
 free to pick their platform (``ensure_cpu_only``) first.
@@ -27,6 +32,7 @@ from perceiver_io_tpu.obs.health import (
     unregister_health_source,
 )
 from perceiver_io_tpu.obs.http import ObsServer
+from perceiver_io_tpu.obs.process import install_process_metrics
 from perceiver_io_tpu.obs.registry import (
     Counter,
     Gauge,
@@ -36,6 +42,7 @@ from perceiver_io_tpu.obs.registry import (
     is_export_process,
     sanitize_metric_name,
 )
+from perceiver_io_tpu.obs.slo import SLO, SLOTracker, fit_capacity
 from perceiver_io_tpu.obs.tracing import (
     EventLog,
     configure_event_log,
@@ -53,13 +60,17 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "ObsServer",
+    "SLO",
+    "SLOTracker",
     "SelfProfiler",
     "configure_event_log",
     "event",
+    "fit_capacity",
     "get_event_log",
     "get_registry",
     "healthz",
     "install_compile_counter",
+    "install_process_metrics",
     "is_export_process",
     "register_health_source",
     "sanitize_metric_name",

@@ -1,0 +1,113 @@
+"""Process self-metrics: RSS, uptime, thread count, GC activity.
+
+Host-pressure context for the serving metrics: when an offered-load sweep
+saturates, these gauges tell whether the knee is the model (device/compute
+bound, RSS flat) or the host (memory growth, thread pile-up, GC churn). No
+psutil in this container — everything reads ``/proc`` with stdlib fallbacks,
+and every value refreshes at scrape time via the registry's collector hook,
+so ``/metrics`` and ``/statz`` always report the current process, not the
+last producer write.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import threading
+import time
+from typing import Optional
+
+from perceiver_io_tpu.obs.registry import MetricsRegistry, get_registry
+
+__all__ = ["install_process_metrics", "process_rss_bytes", "process_start_time"]
+
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def process_rss_bytes() -> Optional[float]:
+    """Resident set size in bytes (``/proc/self/statm``; falls back to
+    ``resource`` peak-RSS — still useful for trend-free platforms); None when
+    neither source exists."""
+    try:
+        with open("/proc/self/statm") as f:
+            return float(f.read().split()[1]) * _PAGE_SIZE
+    except (OSError, IndexError, ValueError):
+        pass
+    try:
+        import resource
+
+        # ru_maxrss is KiB on Linux (peak, not current — documented caveat)
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024.0
+    except Exception:
+        return None
+
+
+def process_start_time() -> float:
+    """Epoch seconds this process started (``/proc`` btime + starttime
+    ticks; falls back to this module's import time, which is within the
+    interpreter's first imports for every entry point here)."""
+    try:
+        with open("/proc/self/stat") as f:
+            # field 22 (1-indexed) is starttime in clock ticks since boot;
+            # split after the parenthesized comm, which can contain spaces
+            stat = f.read()
+        start_ticks = float(stat.rsplit(")", 1)[1].split()[19])
+        with open("/proc/uptime") as f:
+            uptime_s = float(f.read().split()[0])
+        ticks = os.sysconf("SC_CLK_TCK")
+        return time.time() - uptime_s + start_ticks / ticks
+    except (OSError, IndexError, ValueError):
+        return _IMPORT_TIME
+
+
+_IMPORT_TIME = time.time()
+_INSTALL_LOCK = threading.Lock()
+
+
+def install_process_metrics(registry: Optional[MetricsRegistry] = None):
+    """Register the process self-metric gauges on ``registry`` (default: the
+    process-wide one) and the collector that refreshes them at every export:
+
+    - ``process_rss_bytes`` — current resident set size;
+    - ``process_uptime_seconds`` — seconds since process start;
+    - ``process_threads`` — live Python threads;
+    - ``process_gc_collections`` — cumulative GC collections (all
+      generations; a gauge resampled at scrape, so no ``_total`` suffix —
+      that suffix is reserved for Counter semantics) — churn here during a
+      load sweep is host pressure, not device time;
+    - ``process_open_fds`` — open file descriptors (0 when unreadable).
+
+    Idempotent per registry; returns the collector for direct invocation in
+    tests."""
+    reg = registry if registry is not None else get_registry()
+    g_rss = reg.gauge("process_rss_bytes",
+                      "resident set size of this process")
+    g_up = reg.gauge("process_uptime_seconds", "seconds since process start")
+    g_thr = reg.gauge("process_threads", "live Python threads")
+    g_gc = reg.gauge("process_gc_collections",
+                     "cumulative garbage collections across generations "
+                     "(resampled at scrape)")
+    g_fds = reg.gauge("process_open_fds", "open file descriptors")
+    start = process_start_time()
+
+    def collect() -> None:
+        rss = process_rss_bytes()
+        if rss is not None:
+            g_rss.set(rss)
+        g_up.set(time.time() - start)
+        g_thr.set(threading.active_count())
+        g_gc.set(sum(s.get("collections", 0) for s in gc.get_stats()))
+        try:
+            g_fds.set(len(os.listdir("/proc/self/fd")))
+        except OSError:
+            g_fds.set(0)
+
+    with _INSTALL_LOCK:
+        # marker on the registry itself (not an id() set — reused addresses
+        # after GC would make a fresh registry look already-installed)
+        if getattr(reg, "_process_metrics_installed", False):
+            return collect
+        reg._process_metrics_installed = True
+    collect()
+    reg.register_collector(collect)
+    return collect

@@ -171,6 +171,32 @@ class MetricsRegistry:
         self._instruments: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
                                 _Instrument] = {}
         self._kinds: Dict[str, str] = {}
+        self._collectors: List = []
+
+    def register_collector(self, fn) -> None:
+        """Add a zero-arg callable invoked at every export (``snapshot`` /
+        ``prometheus_text``) BEFORE instruments are read — the pull-model
+        hook for sampled values (process RSS, thread count) that would be
+        stale if only written on some producer's cadence. Collectors must be
+        cheap and must not raise; a raising collector is dropped from
+        subsequent exports (telemetry never breaks the scrape)."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def _run_collectors(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        dead = []
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:
+                dead.append(fn)
+        if dead:
+            with self._lock:
+                self._collectors = [
+                    c for c in self._collectors if c not in dead
+                ]
 
     def _get(self, cls, name: str, help: str,
              labels: Optional[Dict[str, str]], **kwargs):
@@ -217,6 +243,7 @@ class MetricsRegistry:
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-able view of every instrument (the ``/statz`` body)."""
+        self._run_collectors()
         out: Dict[str, Any] = {"counters": {}, "gauges": {}, "histograms": {}}
         for inst in self._sorted_instruments():
             key = inst.name + _label_suffix(inst.labels)
@@ -236,6 +263,7 @@ class MetricsRegistry:
     def prometheus_text(self) -> str:
         """Prometheus text exposition (version 0.0.4). Histograms export as
         summaries — window quantiles plus exact _sum/_count."""
+        self._run_collectors()
         lines: List[str] = []
         seen_header = set()
         for inst in self._sorted_instruments():

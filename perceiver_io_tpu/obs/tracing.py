@@ -10,36 +10,72 @@ Thread-safe (one lock around write+flush); timestamps are wall-clock epoch
 seconds so lines correlate with external logs. Multi-host: configure the sink
 on process 0 only (the helpers never check — the caller owns that policy,
 mirroring ``MetricsLogger``).
+
+Bounded by construction: the sink rotates at ``max_bytes`` (keeping
+``backups`` numbered segments, newest first: ``events.jsonl.1`` is the most
+recent full segment) so a week of serving — or an open-loop load sweep
+emitting one span per request — can never grow the log unboundedly. Pass
+``max_bytes=None`` to disable rotation (the pre-r11 behavior).
 """
 
 from __future__ import annotations
 
 import contextlib
 import json
+import os
 import threading
 import time
 from typing import Any, Dict, Iterator, Optional
 
 __all__ = ["EventLog", "configure_event_log", "event", "get_event_log", "span"]
 
+# rotation defaults: ~64 MB live segment + 3 rotated = a ~256 MB hard ceiling
+# per process, weeks of serving events at typical rates
+DEFAULT_MAX_BYTES = 64 * 1024 * 1024
+DEFAULT_BACKUPS = 3
+
 
 class EventLog:
-    """Append-only JSONL event sink."""
+    """Append-only JSONL event sink with size-capped rotation."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, max_bytes: Optional[int] = DEFAULT_MAX_BYTES,
+                 backups: int = DEFAULT_BACKUPS):
+        if max_bytes is not None and max_bytes < 1:
+            raise ValueError(f"max_bytes must be positive, got {max_bytes}")
+        if backups < 0:
+            raise ValueError(f"backups must be >= 0, got {backups}")
         self.path = path
+        self.max_bytes = max_bytes
+        self.backups = backups
         self._lock = threading.Lock()
         self._f = open(path, "a")
+        self._size = self._f.tell()  # append mode: tell() is the file size
+        self._closed = False
         self._write_error_reported = False
 
     def write(self, record: Dict[str, Any]) -> None:
-        line = json.dumps({"t": time.time(), **record}, default=str)
+        line = json.dumps({"t": time.time(), **record}, default=str) + "\n"
         with self._lock:
             if self._f is None:
-                return
+                if self._closed:
+                    return
+                # a FAILED rotation left the log fileless (not closed):
+                # retry the reopen so a transient disk condition degrades
+                # the log only while it lasts, symmetric with plain write
+                # failures which also self-recover
+                try:
+                    self._f = open(self.path, "a")
+                    self._size = self._f.tell()
+                except OSError:
+                    return
             try:
-                self._f.write(line + "\n")
+                if (self.max_bytes is not None
+                        and self._size + len(line) > self.max_bytes
+                        and self._size > 0):
+                    self._rotate_locked()
+                self._f.write(line)
                 self._f.flush()
+                self._size += len(line)
             except OSError as e:
                 # telemetry must never crash the loop it observes (events
                 # are emitted from the engine worker / trainer hot paths);
@@ -52,8 +88,29 @@ class EventLog:
                           f"events to {self.path!r} may be dropped",
                           file=sys.stderr)
 
+    def _rotate_locked(self) -> None:
+        """Shift ``path.(N-1)`` → ``path.N`` … ``path`` → ``path.1`` and
+        reopen a fresh live segment. With ``backups == 0`` the live segment
+        is simply truncated (still bounded)."""
+        self._f.close()
+        self._f = None  # a failure below leaves the log closed, not torn
+        if self.backups > 0:
+            oldest = f"{self.path}.{self.backups}"
+            if os.path.exists(oldest):
+                os.remove(oldest)
+            for i in range(self.backups - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            os.replace(self.path, f"{self.path}.1")
+        else:
+            os.remove(self.path)
+        self._f = open(self.path, "a")
+        self._size = 0
+
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             if self._f is not None:
                 self._f.close()
                 self._f = None
@@ -63,13 +120,16 @@ _LOG: Optional[EventLog] = None
 _LOG_LOCK = threading.Lock()
 
 
-def configure_event_log(path: Optional[str]) -> Optional[EventLog]:
+def configure_event_log(path: Optional[str],
+                        max_bytes: Optional[int] = DEFAULT_MAX_BYTES,
+                        backups: int = DEFAULT_BACKUPS) -> Optional[EventLog]:
     """Install (or, with None, remove) the process-wide event sink."""
     global _LOG
     with _LOG_LOCK:
         if _LOG is not None:
             _LOG.close()
-        _LOG = EventLog(path) if path else None
+        _LOG = EventLog(path, max_bytes=max_bytes, backups=backups) \
+            if path else None
         return _LOG
 
 

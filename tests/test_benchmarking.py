@@ -90,7 +90,10 @@ def test_scanned_step_cost_analysis_is_per_step():
     single = compiled_flops(jax.jit(train_step), state, batch)
 
     scanned = make_scanned_step(train_step)
-    for k in (2, 4):
+    # one K suffices to pin the once-not-K-times contract (tier-1 budget,
+    # r11: the k=4 point only re-proved the same scan-body invariance at
+    # another trip count for an extra compile)
+    for k in (2,):
         stacked = {key: jnp.stack([v] * k) for key, v in batch.items()}
         k_flops = compiled_flops(jax.jit(scanned), state, stacked)
         assert single is not None and k_flops is not None

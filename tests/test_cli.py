@@ -154,12 +154,14 @@ def test_serve_cli_end_to_end(tmp_path):
     base = ["--checkpoint", ckpt, "--tokenizer", tok, "--max_batch", "4",
             "--k", "3"]
 
-    # the resilience flags ride the happy path too: generous deadline/queue
-    # bound and an armed breaker must not perturb results
+    # the resilience AND SLO flags ride the happy path too: generous
+    # deadline/queue bound, an armed breaker, and a declared SLO must not
+    # perturb results
     fused = serve.main(
         base + ["--bucket_widths", "16",
                 "--request_deadline_s", "60", "--queue_limit", "256",
                 "--breaker_failures", "3", "--breaker_cooldown_s", "1",
+                "--slo_p99_ms", "60000", "--slo_availability", "0.99",
                 "--texts", "a [MASK] b", "no mask here"]
     )
     assert len(fused) == 2
@@ -461,6 +463,89 @@ def test_coldstart_bench_cpu_emits_one_json_line(tmp_path):
     assert result["bg_first_result_s"] <= result["bg_family_warm_s"], result
 
 
+def test_load_bench_dry_emits_schema_json_line():
+    """tools/load_bench.py --dry emits EXACTLY one JSON line describing the
+    record shape (point + phase keys) without touching any backend."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "load_bench.py"),
+         "--dry"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    record = json.loads(lines[0])
+    assert record["metric"] == "load_bench" and record["dry"] is True
+    assert record["sweep"] == [] and record["capacity"] is None
+    for key in ("offered_rps", "achieved_rps", "p99_ms", "shed_rate",
+                "phase_p50_ms", "breaker"):
+        assert key in record["point_keys"], record
+    assert record["phase_keys"] == [
+        "admission", "queue", "assembly", "dispatch", "device", "complete"]
+
+
+def test_load_bench_cpu_sweep_shows_saturation_signature(tmp_path):
+    """The SLO-observability acceptance drill: tools/load_bench.py --cpu
+    emits ONE JSON line whose open-loop sweep shows the saturation
+    signature — achieved throughput plateaus below the top offered rate,
+    p99 inflects away from its floor, shed rate becomes nonzero past the
+    knee — plus a fitted capacity estimate and per-phase attribution whose
+    sum reconciles with the end-to-end latency."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "load_bench.py"),
+         "--cpu", "--duration_s", "1.5", "--calibration_waves", "2",
+         "--calibration_wave_size", "16",
+         "--rate_factors", "0.3,0.8,1.5,3.0"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    record = json.loads(lines[0])
+    assert record["metric"] == "load_bench" and record["backend"] == "cpu"
+    assert record["preset"] == "tiny" and record["dry"] is False
+    sweep = record["sweep"]
+    assert len(sweep) == 4
+
+    # the saturation signature: shedding appears past the knee ...
+    assert sweep[-1]["shed_rate"] > 0, sweep
+    # ... p99 inflects away from its light-load floor ...
+    p99s = [p["p99_ms"] for p in sweep]
+    assert max(p99s) > 1.5 * min(p99s), p99s
+    # ... and achieved throughput plateaus below the top offered rate
+    assert sweep[-1]["achieved_rps"] < 0.9 * sweep[-1]["offered_rps"], sweep
+    # saturation is QUEUEING, attributed: the queue phase grows from the
+    # first point to the last far more than the device phase does
+    q_growth = (sweep[-1]["phase_p50_ms"]["queue"]
+                - sweep[0]["phase_p50_ms"]["queue"])
+    d_growth = (sweep[-1]["phase_p50_ms"]["device"]
+                - sweep[0]["phase_p50_ms"]["device"])
+    assert q_growth > d_growth, (q_growth, d_growth)
+
+    # the fitted capacity model rides the record
+    cap = record["capacity"]
+    assert cap["capacity_rps"] > 0
+    assert cap["service_floor_ms"] > 0
+    assert "knee_rps" in cap and "slo_sustainable_rps" in cap
+    assert cap["slo"]["availability_target"] == 0.999
+
+    # per-phase attribution present on every point, and the phase sum
+    # self-check reconciles with end-to-end latency
+    for point in sweep:
+        assert set(point["phase_p50_ms"]) == {
+            "admission", "queue", "assembly", "dispatch", "device",
+            "complete"}
+    assert 0.9 <= record["phase_sum_ratio"] <= 1.1, record["phase_sum_ratio"]
+
+
 def test_bench_backend_probe_emits_json_error_record():
     """BENCH_r05 regression: with the backend probe unable to answer inside
     its deadline (deadline 0 simulates the dark-tunnel hang), bench.py must
@@ -520,6 +605,12 @@ def test_train_imagenet(tmp_path):
     assert os.path.isdir(os.path.join(run_dir, "checkpoints"))
 
 
+@pytest.mark.slow  # tier-1 budget (r11): multimodal adapter/model/loss
+# numerics stay tier-1 in tests/test_multimodal.py (incl. the
+# make_multimodal_steps train step), the sharded end-to-end in
+# tests/test_sharding.py::test_multimodal_autoencoder_sharded, flag parsing
+# in test_all_parsers_build_and_render_help, and the Trainer-CLI plumbing
+# via the train_mlm e2es in this file
 def test_train_multimodal(tmp_path):
     from perceiver_io_tpu.cli import train_multimodal
 
@@ -590,9 +681,11 @@ def test_all_parsers_build_and_render_help():
     for flag in ("--checkpoint", "--tokenizer", "--bucket_widths", "--dtype",
                  "--quantize", "--cached", "--max_delay_ms", "--metrics_port",
                  "--heartbeat_deadline_s", "--selfprofile_every",
-                 "--events_jsonl", "--cpu", "--request_deadline_s",
-                 "--queue_limit", "--dispatch_retries", "--breaker_failures",
-                 "--breaker_cooldown_s"):
+                 "--events_jsonl", "--events_max_mb", "--cpu",
+                 "--request_deadline_s", "--queue_limit",
+                 "--dispatch_retries", "--breaker_failures",
+                 "--breaker_cooldown_s", "--slo_p99_ms",
+                 "--slo_availability", "--slo_burn_alert", "--span_every"):
         assert flag in help_text, f"serve missing {flag}"
 
 

@@ -142,6 +142,11 @@ def _tiny_mlm(vocab_size, max_seq_len=8):
     )
 
 
+@pytest.mark.slow  # tier-1 budget (r11): a convergence smoke — fill-mask
+# DECODE correctness stays tier-1 in test_fill_masks_gathered_matches_full_
+# decode and test_mlm_predictor_from_checkpoint below; that training learns
+# stays tier-1 in test_golden_model.py::test_training_trajectory_matches_
+# torch and the train-CLI e2es' finite-loss assertions
 def test_mlm_fill_masks_learns_pattern():
     tok = _word_tokenizer()
     vocab = tok.get_vocab_size()

@@ -142,6 +142,79 @@ def test_event_log_span_and_event(tmp_path):
     assert rows[2]["ok"] is False and rows[2]["error"] == "RuntimeError"
 
 
+def test_event_log_size_capped_rotation(tmp_path):
+    """A long load run cannot grow events.jsonl unboundedly: the sink
+    rotates at max_bytes keeping N numbered segments, every surviving line
+    stays valid JSONL, and the oldest segment is dropped."""
+    path = str(tmp_path / "events.jsonl")
+    log = obs.EventLog(path, max_bytes=2000, backups=2)
+    try:
+        for i in range(200):
+            log.write({"event": "spam", "i": i})
+    finally:
+        log.close()
+    import os
+
+    segments = sorted(f for f in os.listdir(tmp_path)
+                      if f.startswith("events.jsonl"))
+    assert segments == ["events.jsonl", "events.jsonl.1", "events.jsonl.2"]
+    seen = []
+    for name in segments:
+        p = tmp_path / name
+        assert p.stat().st_size <= 2000
+        for line in open(p):
+            seen.append(json.loads(line)["i"])
+    # newest records survive contiguously; the oldest rolled off the end
+    assert max(seen) == 199
+    assert sorted(seen) == list(range(min(seen), 200))
+    assert min(seen) > 0  # something WAS dropped — the cap is real
+
+    # rotation disabled: one unbounded file, nothing dropped
+    path2 = str(tmp_path / "nocap.jsonl")
+    log = obs.EventLog(path2, max_bytes=None)
+    try:
+        for i in range(50):
+            log.write({"event": "spam", "i": i})
+    finally:
+        log.close()
+    assert len(open(path2).readlines()) == 50
+
+
+def test_process_metrics_refresh_at_scrape(tmp_path):
+    """install_process_metrics registers RSS/uptime/threads/GC gauges that
+    refresh via the registry's collector hook at every export."""
+    reg = obs.MetricsRegistry()
+    obs.install_process_metrics(reg)
+    snap = reg.snapshot()
+    g = snap["gauges"]
+    assert g["process_rss_bytes"] > 1e6  # a python + jax process is > 1 MB
+    assert g["process_uptime_seconds"] > 0
+    assert g["process_threads"] >= 1
+    assert g["process_gc_collections"] >= 0
+    text = reg.prometheus_text()
+    assert "# TYPE process_rss_bytes gauge" in text
+    # the collector refreshes: uptime strictly advances between scrapes
+    time.sleep(0.05)
+    assert (reg.snapshot()["gauges"]["process_uptime_seconds"]
+            > g["process_uptime_seconds"])
+
+
+def test_registry_collector_errors_never_break_the_scrape():
+    reg = obs.MetricsRegistry()
+    reg.counter("ok_total").inc()
+    calls = []
+    reg.register_collector(lambda: calls.append(1))
+
+    def broken():
+        raise RuntimeError("collector bug")
+
+    reg.register_collector(broken)
+    snap = reg.snapshot()  # must not raise
+    assert snap["counters"]["ok_total"] == 1 and calls
+    reg.snapshot()  # the broken collector was dropped, the good one stays
+    assert len(calls) == 2
+
+
 # -- health / heartbeat ------------------------------------------------------
 
 
@@ -395,3 +468,250 @@ def test_trainer_smoke_publishes_step_time_and_mfu_gauges(tmp_path, monkeypatch)
     # the logger mirrored every jsonl scalar into the same registry
     train_rows = [r for r in rows if "train_loss" in r]
     assert reg.gauge("train_loss").value == train_rows[-1]["train_loss"]
+
+
+# -- per-request phase tracing (SLO observability) ---------------------------
+
+
+def test_phase_tracing_reconciles_with_end_to_end_latency(tmp_path):
+    """The tentpole self-check: every served part records all six lifecycle
+    phases; the per-part phase SUM reconciles with the end-to-end latency
+    within 5% at p50 (acceptance bar); the phases export as
+    serving_phase_seconds{phase=...} histograms AND as JSONL request_phases
+    spans; stats() carries the per-phase windows in the same locked deep-copy
+    as latency_s_by_bucket."""
+    import statistics
+
+    from perceiver_io_tpu.inference import ServingEngine
+    from perceiver_io_tpu.inference.engine import PHASES
+
+    events = str(tmp_path / "events.jsonl")
+    obs.configure_event_log(events)
+    reg = obs.MetricsRegistry()
+    eng = ServingEngine(
+        lambda p, x: x * p, jnp.float32(2.0), max_batch=1,
+        name="phase_t", registry=reg,
+    )
+    try:
+        futs = [eng.submit(np.ones((1, 4), np.float32)) for _ in range(24)]
+        for f in futs:
+            np.testing.assert_allclose(f.result(timeout=60), 2.0)
+    finally:
+        eng_stats = eng.stats()
+        eng.close()
+        obs.configure_event_log(None)
+
+    # every future exposes its part's phase record, covering all phases
+    recs = futs[0].phases
+    assert len(recs) == 1 and set(recs[0]) == set(PHASES)
+    assert all(v >= 0 for v in recs[0].values())
+
+    # stats(): phase windows ride the same locked deep-copied snapshot, and
+    # (max_batch=1 ⇒ one bucket, appended in completion order) align with
+    # the latency window part-for-part — sum reconciles within 5% at p50
+    lat = eng_stats["latency_s_by_bucket"][1]
+    ph = eng_stats["phase_s"]
+    assert set(ph) == set(PHASES)
+    assert all(len(ph[k]) == len(lat) for k in PHASES)
+    sums = [sum(vals) for vals in zip(*(ph[k] for k in PHASES))]
+    ratio = statistics.median(sums) / statistics.median(lat)
+    assert 0.95 <= ratio <= 1.05, ratio
+    # elementwise too: each part's phase sum brackets its own latency
+    for s, l in zip(sums, lat):
+        assert s >= l > 0
+
+    # mutating the snapshot never touches live state (deep copy)
+    ph["device"].append(1e9)
+    assert 1e9 not in eng.stats().get("phase_s", {}).get("device", [])
+
+    # registry: one histogram per phase, observed once per part
+    for phase in PHASES:
+        h = reg.histogram("serving_phase_seconds",
+                          labels={"engine": "phase_t", "phase": phase})
+        assert h.count == 24, (phase, h.count)
+    assert 0.95 <= reg.gauge(
+        "serving_phase_sum_ratio", labels={"engine": "phase_t"}).value <= 1.05
+
+    # JSONL spans: one request_phases event per part with the phase fields
+    rows = [json.loads(l) for l in open(events)]
+    spans = [r for r in rows if r.get("event") == "request_phases"]
+    assert len(spans) == 24
+    assert spans[0]["engine"] == "phase_t"
+    for phase in PHASES:
+        assert phase in spans[0], spans[0]
+    assert spans[0]["total_s"] > 0
+
+
+def test_phase_attribution_separates_queueing_from_dispatch():
+    """The attribution claim itself: hold the FIRST dispatch on a gate while
+    five more requests queue behind it — the held request's time lands in
+    its DISPATCH phase, the queued requests' time lands in their QUEUE
+    phase, and device time stays tiny for all. 'p99 is high' is now 'p99 is
+    high because queueing', not a guess."""
+    from perceiver_io_tpu.inference import ServingEngine
+
+    reg = obs.MetricsRegistry()
+    release = threading.Event()
+    eng = ServingEngine(lambda p, x: x + p, jnp.float32(1.0), max_batch=1,
+                        name="attr_t", registry=reg)
+    real_jitted = eng._jitted
+
+    def gated_jitted(p, cols):
+        release.wait(30)  # blocks the first dispatch; no-op once released
+        return real_jitted(p, cols)
+
+    eng._jitted = gated_jitted
+    try:
+        futs = [eng.submit(np.zeros((1, 2), np.float32)) for _ in range(6)]
+        time.sleep(0.3)  # the gate holds dispatch 1; parts 2..6 queue
+        release.set()
+        for f in futs:
+            f.result(timeout=60)
+        first, last = futs[0].phases[0], futs[-1].phases[0]
+        assert first["dispatch"] >= 0.25, first
+        assert last["queue"] >= 0.25, last
+        assert last["queue"] > 10 * max(last["device"], 1e-6), last
+    finally:
+        release.set()
+        eng.close()
+
+
+# -- SLO: burn rate + capacity model -----------------------------------------
+
+
+def test_slo_tracker_burn_rate_math_and_health_wire():
+    reg = obs.MetricsRegistry()
+    # 10% error budget, alert at burn 2.0, health live after 10 samples
+    slo = obs.SLO(latency_target_s=0.1, availability_target=0.9,
+                  name="unit", burn_alert=2.0, min_samples=10)
+    assert slo.error_budget == pytest.approx(0.1)
+    tracker = obs.SLOTracker(slo, registry=reg)
+    try:
+        for _ in range(8):
+            tracker.record(latency_s=0.05, ok=True)   # good
+        tracker.record(latency_s=0.5, ok=True)        # latency breach
+        tracker.record(ok=False)                      # shed/error breach
+        assert tracker.good_fraction() == pytest.approx(0.8)
+        # bad fraction 0.2 over budget 0.1 = burning 2x
+        assert tracker.burn_rate() == pytest.approx(2.0)
+        labels = {"slo": "unit"}
+        assert reg.gauge("slo_error_budget_burn_rate",
+                         labels=labels).value == pytest.approx(2.0)
+        assert reg.counter("slo_breaches_total",
+                           labels={**labels, "reason": "latency"}).value == 1
+        assert reg.counter("slo_breaches_total",
+                           labels={**labels, "reason": "error"}).value == 1
+        # at burn exactly 2.0 (== alert) health holds; one more bad breaches
+        ok, _ = obs.healthz()
+        assert ok
+        tracker.record(ok=False)
+        ok, detail = obs.healthz()
+        assert not ok
+        assert detail["sources"]["slo:unit"]["burn_rate"] > 2.0
+    finally:
+        tracker.close()
+    ok, _ = obs.healthz()
+    assert ok  # closed trackers leave the aggregate
+
+
+def test_slo_tracker_health_quiet_below_min_samples():
+    slo = obs.SLO(latency_target_s=0.1, availability_target=0.9,
+                  burn_alert=1.0, min_samples=5, name="quiet")
+    tracker = obs.SLOTracker(slo, registry=obs.MetricsRegistry())
+    try:
+        tracker.record(ok=False)  # 100% bad, but only 1 sample
+        name, ok, detail = tracker.health_status()
+        assert ok and detail["samples"] == 1
+        for _ in range(5):
+            tracker.record(ok=False)
+        _, ok, _ = tracker.health_status()
+        assert not ok
+    finally:
+        tracker.close()
+
+
+def test_slo_validation():
+    with pytest.raises(ValueError, match="latency_target_s"):
+        obs.SLO(latency_target_s=0.0)
+    with pytest.raises(ValueError, match="availability_target"):
+        obs.SLO(latency_target_s=0.1, availability_target=1.0)
+
+
+def test_fit_capacity_knee_and_slo_sustainable():
+    """The capacity model over a synthetic textbook sweep: p50 floor at
+    light load, p99 departing the floor past the knee, shedding at
+    overload, achieved plateauing at capacity."""
+    floor = 0.010
+    points = [
+        dict(offered_rps=100, achieved_rps=99, p50_s=floor, p99_s=0.015,
+             shed_rate=0.0),
+        dict(offered_rps=200, achieved_rps=198, p50_s=0.011, p99_s=0.020,
+             shed_rate=0.0),
+        dict(offered_rps=400, achieved_rps=390, p50_s=0.014, p99_s=0.040,
+             shed_rate=0.0),
+        dict(offered_rps=800, achieved_rps=610, p50_s=0.080, p99_s=0.400,
+             shed_rate=0.05),   # past the knee: p99 departed, shedding
+        dict(offered_rps=1600, achieved_rps=600, p50_s=0.120, p99_s=0.900,
+             shed_rate=0.5),    # plateau
+    ]
+    slo = obs.SLO(latency_target_s=0.050, availability_target=0.99,
+                  name="cap")
+    fit = obs.fit_capacity(points, slo=slo)
+    assert fit["service_floor_s"] == pytest.approx(floor)
+    assert fit["p99_floor_s"] == pytest.approx(0.015)
+    # 400 sustains (p99 0.040 < 3x floor 0.045, no shed, achieved tracks);
+    # 800 does not (shedding, p99 departed)
+    assert fit["knee_rps"] == 400
+    assert fit["capacity_rps"] == 610
+    # SLO: p99 <= 50ms and shed within the 1% budget — 400 qualifies
+    assert fit["slo_sustainable_rps"] == 400
+    assert fit["slo"]["name"] == "cap"
+
+    # a sweep that starts past saturation: knee/sustainable report 0.0
+    fit2 = obs.fit_capacity(points[-1:], slo=slo)
+    assert fit2["knee_rps"] == 0.0 and fit2["slo_sustainable_rps"] == 0.0
+    with pytest.raises(ValueError):
+        obs.fit_capacity([])
+
+
+def test_engine_slo_wiring_records_completions_and_sheds():
+    """ServingEngine(slo=...): completions classify against the latency
+    target, queue-full sheds burn the error budget, and the tracker's
+    burn-rate gauge rides the engine's registry."""
+    from perceiver_io_tpu.inference import ServingEngine
+    from perceiver_io_tpu.resilience import RejectedError
+
+    reg = obs.MetricsRegistry()
+    release = threading.Event()
+
+    slo = obs.SLO(latency_target_s=60.0, availability_target=0.9,
+                  name="wire", burn_alert=None)
+    eng = ServingEngine(lambda p, x: x + p, jnp.float32(1.0), max_batch=1,
+                        name="slo_t", registry=reg, queue_limit=2, slo=slo)
+    real_jitted = eng._jitted
+
+    def gated_jitted(p, cols):
+        release.wait(30)  # holds the worker so the backlog bound trips
+        return real_jitted(p, cols)
+
+    eng._jitted = gated_jitted
+    try:
+        futs = [eng.submit(np.zeros((1, 2), np.float32)) for _ in range(2)]
+        # queue full (2 parts backlogged; the worker may have pulled one —
+        # keep submitting until the bound trips)
+        with pytest.raises(RejectedError):
+            for _ in range(4):
+                futs.append(eng.submit(np.zeros((1, 2), np.float32)))
+        release.set()
+        for f in futs:
+            f.result(timeout=60)
+        labels = {"slo": "wire", "engine": "slo_t"}
+        good = reg.counter("slo_requests_total", labels=labels).value
+        assert good >= 3  # completions + the shed all classified
+        assert reg.counter(
+            "slo_breaches_total",
+            labels={**labels, "reason": "error"}).value >= 1
+        assert eng.slo_tracker.good_fraction() < 1.0
+    finally:
+        release.set()
+        eng.close()

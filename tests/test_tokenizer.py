@@ -148,6 +148,11 @@ def test_hash_heavy_corpus_native_parity():
         assert ids_native == t2.encode_ids(text)
 
 
+@pytest.mark.slow  # tier-1 budget (r11): a scaling smoke over a 3000-doc
+# corpus — tokenizer merge/encode correctness stays tier-1 in the roundtrip
+# and special-token tests in this file, and every serving/bench path trains
+# a real tokenizer tier-1 via test_cli.py::test_serve_cli_end_to_end and
+# the inference_bench contract test
 def test_training_scales_to_real_vocab_sizes():
     """Incremental trainer: a few thousand docs -> vocab 2000 in seconds."""
     import time

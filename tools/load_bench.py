@@ -1,0 +1,385 @@
+"""Open-loop offered-load sweep over the serving engine: traffic curves,
+per-phase tail attribution, and the measured capacity model.
+
+The closed-loop A/B in ``tools/inference_bench.py`` answers "how much faster
+is the engine than naive dispatch" — but a closed loop can never measure
+*saturation*: its arrival rate self-throttles to whatever the system serves
+(coordinated omission). This harness is OPEN-loop: requests arrive on a
+Poisson (or bursty) schedule at a configured offered rate whether or not the
+engine keeps up, which is what real traffic does. Sweeping offered rates
+produces the curves every SLO claim needs:
+
+- achieved throughput vs offered (the plateau IS the capacity);
+- p50/p95/p99 end-to-end latency per point, attributed per lifecycle phase
+  (``inference/engine.py`` phase tracing — past the knee, p99 grows in the
+  QUEUE phase while the device phase stays flat: the signature of
+  saturation, as opposed to a slowing device);
+- shed rate (bounded-queue fast-fail) and breaker state;
+- the fitted capacity model (``obs/slo.py fit_capacity``): service-time
+  floor, the knee where p99 departs it, max sustainable requests/s at the
+  SLO.
+
+Offered rates default to fractions of a calibrated closed-loop capacity
+estimate, so the same sweep spans the knee on any backend. Emits exactly ONE
+JSON line on stdout (progress on stderr). ``--cpu`` pins the CPU backend
+before jax initializes (tier-1 offline mode, tiny preset); ``--dry`` emits
+the record schema without touching a backend. Real-TPU runs ride the PERF.md
+§r10 pending queue: the capacity model composes with the device-trace
+discipline because the per-phase DEVICE number can be cross-checked against
+the lower-quartile trace statistic while queue/admission phases are
+host-side and tunnel-insensitive.
+
+Usage::
+
+    timeout 1800 python tools/load_bench.py --cpu [--arrival poisson|bursty]
+        [--duration_s 4] [--rate_factors 0.25,0.5,1.0,1.5,2.5]
+        [--rates RPS,RPS,...] [--queue_limit 64] [--slo_p99_ms MS]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# NOTE: jax is imported inside the run path AFTER --cpu is handled —
+# utils.platform.ensure_cpu_only must run before any backend initializes.
+import numpy as np
+
+POINT_KEYS = (
+    "offered_rps", "submitted", "completed", "shed", "failed", "shed_rate",
+    "achieved_rps", "p50_ms", "p95_ms", "p99_ms", "phase_p50_ms",
+    "phase_p99_ms", "breaker",
+)
+# mirrors inference.engine.PHASES (asserted on the run path) so --dry stays
+# backend-free: importing the engine module pulls jax
+PHASE_KEYS = ("admission", "queue", "assembly", "dispatch", "device",
+              "complete")
+
+
+def _pct(values: List[float], q: float) -> Optional[float]:
+    """Sorted-index percentile; None when nothing was observed (a fully-shed
+    sweep point) — the record carries null, never NaN (invalid JSON)."""
+    v = sorted(values)
+    return v[min(len(v) - 1, int(q * len(v)))] if v else None
+
+
+def _log(*a) -> None:
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _build_requests(max_seq_len: int, vocab: int, n: int, seed: int):
+    """Synthetic batch-1 fill-mask-shaped requests (ids, pad, positions) —
+    identical signature so the sweep isolates load behavior, not width
+    bucketing (which has its own bench)."""
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for _ in range(n):
+        ids = rng.integers(
+            3, vocab, size=(1, max_seq_len), dtype=np.int64).astype(np.int32)
+        pad = np.zeros((1, max_seq_len), bool)
+        positions = np.array([[1, 2]], np.int32)
+        reqs.append((ids, pad, positions))
+    return reqs
+
+
+def _calibrate(engine, reqs, waves: int, wave_size: int):
+    """Closed-loop capacity estimate: submit ``wave_size`` requests, wait for
+    all, repeat — the engine batches each wave, so the measured rate is the
+    batched service capacity the open-loop sweep should straddle. Also
+    returns the median end-to-end latency (the service-time scale for the
+    default SLO target)."""
+    rates, lats = [], []
+    for w in range(waves):
+        t0 = time.monotonic()
+        futs = [engine.submit(*reqs[i % len(reqs)]) for i in range(wave_size)]
+        for f in futs:
+            f.result(timeout=300)
+        dt = time.monotonic() - t0
+        rates.append(wave_size / dt)
+        for f in futs:
+            for rec in f.phases:
+                lats.append(sum(rec.values()))
+    rates.sort()
+    lat = _pct(lats, 0.5)
+    return rates[len(rates) // 2], lat if lat is not None else 0.01
+
+
+def _arrival_gaps(arrival: str, rate: float, duration: float, burst: int,
+                  rng) -> List[float]:
+    """Arrival offsets (seconds from point start) over the offered window."""
+    times, t = [], 0.0
+    i = 0
+    while t < duration:
+        times.append(t)
+        i += 1
+        if arrival == "poisson":
+            t += float(rng.exponential(1.0 / rate))
+        else:  # bursty: `burst` back-to-back arrivals, then one long gap
+            t += 0.0 if i % burst else burst / rate
+    return times
+
+
+def _run_point(engine, reqs, rate: float, duration: float, arrival: str,
+               burst: int, rng, drain_timeout_s: float) -> Dict:
+    from perceiver_io_tpu.resilience import (
+        BreakerOpen,
+        DeadlineExceeded,
+        RejectedError,
+    )
+
+    arrivals = _arrival_gaps(arrival, rate, duration, burst, rng)
+    t0 = time.monotonic()
+    futures = []
+    shed = 0
+    for i, at in enumerate(arrivals):
+        delay = t0 + at - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            futures.append(engine.submit(*reqs[i % len(reqs)]))
+        except (RejectedError, DeadlineExceeded, BreakerOpen):
+            shed += 1  # open loop: an arrival the engine refuses is SHED
+    submitted = len(arrivals)
+
+    completed = failed = 0
+    lats: List[float] = []
+    phases: Dict[str, List[float]] = defaultdict(list)
+    for fut in futures:
+        try:
+            fut.result(timeout=drain_timeout_s)
+        except (RejectedError, DeadlineExceeded):
+            shed += 1
+            continue
+        except Exception:
+            failed += 1
+            continue
+        completed += 1
+        for rec in fut.phases:
+            lats.append(sum(rec.values()))
+            for k, v in rec.items():
+                phases[k].append(v)
+    elapsed = time.monotonic() - t0  # offered window + drain: under
+    # overload the drain serves at capacity, so achieved ≈ the plateau
+    point = {
+        "offered_rps": round(rate, 3),
+        "submitted": submitted,
+        "completed": completed,
+        "shed": shed,
+        "failed": failed,
+        "shed_rate": round((shed + failed) / max(submitted, 1), 4),
+        "achieved_rps": round(completed / elapsed, 3),
+        "p50_s": _pct(lats, 0.50),
+        "p95_s": _pct(lats, 0.95),
+        "p99_s": _pct(lats, 0.99),
+        "phase_p50_s": {k: _pct(v, 0.50) for k, v in sorted(phases.items())},
+        "phase_p99_s": {k: _pct(v, 0.99) for k, v in sorted(phases.items())},
+        "breaker": (engine.breaker.state if engine.breaker is not None
+                    else "absent"),
+    }
+    return point
+
+
+def _point_for_record(p: Dict) -> Dict:
+    """Seconds → ms for the emitted record (fit_capacity reads the _s keys)."""
+    out = {k: p[k] for k in ("offered_rps", "submitted", "completed", "shed",
+                             "failed", "shed_rate", "achieved_rps", "breaker")}
+    for q in ("p50", "p95", "p99"):
+        v = p[f"{q}_s"]
+        out[f"{q}_ms"] = None if v is None else round(v * 1e3, 3)
+    for q in ("p50", "p99"):
+        out[f"phase_{q}_ms"] = {
+            k: round(v * 1e3, 4) for k, v in p[f"phase_{q}_s"].items()
+        }
+    return out
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description="open-loop offered-load sweep + capacity model")
+    parser.add_argument("--cpu", action="store_true",
+                        help="pin to the CPU backend (ensure_cpu_only before "
+                             "jax initializes) — the offline/tier-1 mode")
+    parser.add_argument("--dry", action="store_true",
+                        help="emit the record schema (one JSON line) without "
+                             "touching any backend")
+    parser.add_argument("--preset", choices=["auto", "tiny", "flagship"],
+                        default="auto",
+                        help="model size: auto = flagship on TPU, tiny "
+                             "elsewhere (models/presets.py)")
+    parser.add_argument("--arrival", choices=["poisson", "bursty"],
+                        default="poisson",
+                        help="arrival process: poisson = exponential gaps at "
+                             "the offered rate; bursty = back-to-back bursts "
+                             "of --burst at the same mean rate")
+    parser.add_argument("--burst", type=int, default=8,
+                        help="bursty mode: arrivals per burst")
+    parser.add_argument("--duration_s", type=float, default=4.0,
+                        help="offered-traffic window per sweep point")
+    parser.add_argument("--rate_factors", default="0.25,0.5,0.75,1.0,1.5,2.5",
+                        help="offered rates as fractions of the calibrated "
+                             "closed-loop capacity (spans the knee on any "
+                             "backend)")
+    parser.add_argument("--rates", default=None,
+                        help="explicit offered rates (requests/s, comma-"
+                             "separated) — overrides --rate_factors")
+    parser.add_argument("--max_batch", type=int, default=8,
+                        help="engine micro-batch cap")
+    parser.add_argument("--queue_limit", type=int, default=64,
+                        help="bounded queue (parts) — the load-shedding "
+                             "mechanism the sweep provokes past the knee; "
+                             "0 = unbounded (latency grows without shedding)")
+    parser.add_argument("--deadline_s", type=float, default=None,
+                        help="per-request deadline (optional second shedding "
+                             "mechanism)")
+    parser.add_argument("--slo_p99_ms", type=float, default=None,
+                        help="SLO latency target for the capacity fit; "
+                             "default: 5x the calibrated median latency")
+    parser.add_argument("--slo_availability", type=float, default=0.999,
+                        help="SLO availability target")
+    parser.add_argument("--calibration_waves", type=int, default=3)
+    parser.add_argument("--calibration_wave_size", type=int, default=24)
+    parser.add_argument("--drain_timeout_s", type=float, default=120.0)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    if args.dry:
+        record = {
+            "metric": "load_bench", "dry": True, "backend": None,
+            "preset": args.preset, "arrival": args.arrival,
+            "duration_s": args.duration_s,
+            "point_keys": list(POINT_KEYS), "phase_keys": list(PHASE_KEYS),
+            "sweep": [], "capacity": None,
+        }
+        print(json.dumps(record))
+        return
+
+    if args.cpu:
+        from perceiver_io_tpu.utils.platform import ensure_cpu_only
+
+        ensure_cpu_only()
+    from perceiver_io_tpu.aot import maybe_enable_cache_from_env
+
+    maybe_enable_cache_from_env()  # PIT_COMPILE_CACHE opt-in (stderr only)
+    import jax
+
+    import perceiver_io_tpu.obs as obs
+    from perceiver_io_tpu.inference import ServingEngine
+    from perceiver_io_tpu.inference.engine import PHASES
+    from perceiver_io_tpu.models.presets import flagship_mlm, tiny_mlm
+
+    assert tuple(PHASES) == PHASE_KEYS, "load_bench PHASE_KEYS drifted"
+
+    backend = jax.default_backend()
+    tiny = args.preset == "tiny" or (args.preset == "auto" and backend != "tpu")
+    _log(f"backend: {backend}; preset {'tiny' if tiny else 'flagship'}; "
+         f"arrival {args.arrival}; duration {args.duration_s}s/point")
+
+    build = tiny_mlm if tiny else flagship_mlm
+    vocab = 503 if tiny else 10003
+    max_seq_len = 64 if tiny else 512
+    model = build(vocab_size=vocab, max_seq_len=max_seq_len)
+    ids0 = np.zeros((1, max_seq_len), np.int32)
+    variables = model.init(
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        ids0, ids0 == 0,
+    )
+
+    def gathered_apply(p, token_ids, pad_mask, pos):
+        logits, _ = model.apply(
+            {"params": p}, token_ids, pad_mask, masking=False,
+            deterministic=True, positions=pos,
+        )
+        return logits
+
+    reqs = _build_requests(max_seq_len, vocab, n=64, seed=args.seed)
+    registry = obs.get_registry()
+    engine = ServingEngine(
+        gathered_apply, variables["params"], max_batch=args.max_batch,
+        name="load_bench", registry=registry,
+        queue_limit=args.queue_limit if args.queue_limit > 0 else None,
+        request_deadline_s=args.deadline_s,
+    )
+    engine.warmup(*reqs[0])
+    _log(f"warmed {engine.num_programs} bucket programs")
+
+    cal_rps, cal_lat_s = _calibrate(
+        engine, reqs, args.calibration_waves, args.calibration_wave_size)
+    _log(f"calibrated closed-loop capacity ~{cal_rps:.1f} req/s, "
+         f"median latency {cal_lat_s * 1e3:.2f} ms")
+
+    slo = obs.SLO(
+        latency_target_s=(args.slo_p99_ms / 1e3 if args.slo_p99_ms
+                          else max(5.0 * cal_lat_s, 1e-3)),
+        availability_target=args.slo_availability,
+        name="load_bench",
+    )
+
+    if args.rates:
+        rates = [float(r) for r in args.rates.split(",")]
+    else:
+        rates = [float(f) * cal_rps
+                 for f in args.rate_factors.split(",")]
+    rng = np.random.default_rng(args.seed)
+    points = []
+    for rate in rates:
+        point = _run_point(engine, reqs, rate, args.duration_s, args.arrival,
+                           args.burst, rng, args.drain_timeout_s)
+        points.append(point)
+        ms = lambda v: f"{v * 1e3:8.2f}" if v is not None else "       —"
+        _log(f"offered {point['offered_rps']:8.1f} req/s -> achieved "
+             f"{point['achieved_rps']:8.1f}, p50 {ms(point['p50_s'])} "
+             f"ms, p99 {ms(point['p99_s'])} ms, shed "
+             f"{point['shed_rate']:.3f}, breaker {point['breaker']}")
+
+    # a fully-shed point has no latency observations: it enters the fit as
+    # an infinitely-slow (never-sustaining, never-SLO-meeting) point; a
+    # sweep with NO completions anywhere has nothing to fit
+    if any(p["p50_s"] is not None for p in points):
+        inf = float("inf")
+        capacity = obs.fit_capacity(
+            [{"offered_rps": p["offered_rps"],
+              "achieved_rps": p["achieved_rps"],
+              "p50_s": inf if p["p50_s"] is None else p["p50_s"],
+              "p99_s": inf if p["p99_s"] is None else p["p99_s"],
+              "shed_rate": p["shed_rate"]} for p in points],
+            slo=slo,
+        )
+        for k in ("service_floor_s", "p99_floor_s"):
+            capacity[f"{k[:-2]}_ms"] = round(capacity.pop(k) * 1e3, 3)
+        capacity["knee_rps"] = round(capacity["knee_rps"], 3)
+        capacity["capacity_rps"] = round(capacity["capacity_rps"], 3)
+        capacity["slo_sustainable_rps"] = round(
+            capacity["slo_sustainable_rps"], 3)
+        _log(f"capacity model: {json.dumps(capacity)}")
+    else:
+        capacity = None
+        _log("capacity model: no point completed any request — nothing to fit")
+
+    ratio = registry.gauge(
+        "serving_phase_sum_ratio", labels={"engine": "load_bench"}).value
+    record = {
+        "metric": "load_bench", "dry": False, "backend": backend,
+        "preset": "tiny" if tiny else "flagship",
+        "arrival": args.arrival, "burst": args.burst,
+        "duration_s": args.duration_s, "max_batch": args.max_batch,
+        "queue_limit": args.queue_limit, "seed": args.seed,
+        "seq_len": max_seq_len,
+        "calibrated_rps": round(cal_rps, 3),
+        "calibrated_latency_ms": round(cal_lat_s * 1e3, 3),
+        "phase_sum_ratio": round(ratio, 5),
+        "sweep": [_point_for_record(p) for p in points],
+        "capacity": capacity,
+    }
+    engine.close()
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()
