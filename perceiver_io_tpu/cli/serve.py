@@ -50,6 +50,22 @@ gauges, and ``/healthz`` degrades when the burn rate crosses
 admission/queue/assembly/dispatch/device/complete; sweep offered load and
 fit the capacity model with ``tools/load_bench.py`` (PERF.md §SLO).
 
+``--replicas N`` serves through the multi-replica fabric
+(``perceiver_io_tpu.serving``, PERF.md §Fabric): a supervisor spawns N
+replica processes (each loads the checkpoint and warms its own AOT pool;
+crashes restart with backoff and rejoin only once ``engine_ready``), and a
+router does least-loaded health-aware dispatch with transparent failover —
+``kill -9`` on a replica re-routes its in-flight requests instead of failing
+them. ``--cached`` composes: sessions pin to the replica holding their
+latents, and a dead pin surfaces as a re-encode. ``--rolling_swap_step``
+rolls the fleet to another checkpoint step one replica at a time with
+auto-rollback on post-swap SLO burn/breaker regression.
+
+Graceful drain: SIGTERM/SIGINT stop admission, finish every accepted
+request, flush the event log, and exit 0 (``--drain_timeout_s`` bounds the
+wait) — in both single-process and fleet modes, so a supervisor rotation
+never drops the queue.
+
 ``--metrics_port`` starts the localhost observability sidecar
 (``/metrics`` Prometheus text, ``/healthz``, ``/statz`` JSON snapshot, now
 including process self-metrics RSS/uptime/threads/GC at every scrape);
@@ -70,8 +86,47 @@ from __future__ import annotations
 
 import argparse
 import json
+import signal
 import sys
 from typing import Optional, Sequence
+
+
+class _DrainRequested(BaseException):
+    """Raised (once) by the SIGTERM/SIGINT handler to unwind the admission
+    loop. A BaseException so no library except-Exception swallows it."""
+
+
+def _install_drain_handlers():
+    """Graceful-drain signal handling: the FIRST SIGTERM/SIGINT raises
+    :class:`_DrainRequested` in the main thread (stops admission — even out
+    of a blocked stdin read, since a raising handler interrupts the retry
+    loop PEP 475 would otherwise continue); later signals are ignored so the
+    finish-in-flight phase cannot be aborted into dropping the queue.
+    Returns ``(state, restore)`` — call ``restore()`` when done (serve.main
+    also runs in-process under pytest; a leaked handler would break the
+    host's Ctrl-C)."""
+    state = {"draining": False}
+
+    def handler(signum, frame):
+        if state["draining"]:
+            print(f"serve: signal {signum} during drain — still finishing "
+                  "in-flight work", file=sys.stderr, flush=True)
+            return
+        state["draining"] = True
+        raise _DrainRequested()
+
+    previous = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            previous[sig] = signal.signal(sig, handler)
+        except ValueError:  # not the main thread (programmatic use)
+            pass
+
+    def restore():
+        for sig, h in previous.items():
+            signal.signal(sig, h)
+
+    return state, restore
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -135,6 +190,33 @@ def build_parser() -> argparse.ArgumentParser:
                         "as soon as its program is ready")
     g.add_argument("--stats", action="store_true",
                    help="print engine stats to stderr on exit")
+    f = parser.add_argument_group(
+        "multi-replica fabric (perceiver_io_tpu.serving; PERF.md §Fabric)")
+    f.add_argument("--replicas", type=int, default=0, metavar="N",
+                   help="serve through a router tier over N replica "
+                        "PROCESSES (each loads the checkpoint, warms its "
+                        "own AOT pool, and is babysat by a supervisor that "
+                        "restarts crashes with backoff): least-loaded "
+                        "health-aware dispatch, transparent failover when a "
+                        "replica dies, latent-cache affinity under --cached. "
+                        "0 (default) = the single-process engine")
+    f.add_argument("--drain_timeout_s", type=float, default=60.0,
+                   help="graceful-drain bound: on SIGTERM/SIGINT (and fleet "
+                        "shutdown) stop admission and wait up to this long "
+                        "for accepted work to finish before exiting 0")
+    f.add_argument("--rolling_swap_step", type=int, default=None,
+                   metavar="STEP",
+                   help="with --replicas: after serving, roll the fleet to "
+                        "this checkpoint step ONE REPLICA AT A TIME "
+                        "(update_params hot-swap; warm pools carry over), "
+                        "baking each swap against its SLO burn / breaker "
+                        "and auto-rolling the whole fleet back on "
+                        "regression; the report prints to stderr")
+    f.add_argument("--rolling_bake_s", type=float, default=2.0,
+                   help="post-swap observation window per replica")
+    f.add_argument("--rolling_burn_threshold", type=float, default=2.0,
+                   help="post-swap SLO burn rate above which the rollout "
+                        "rolls back")
     r = parser.add_argument_group(
         "resilience (PERF.md §Reliability: retry/shed/breaker semantics)")
     r.add_argument("--request_deadline_s", type=float, default=None,
@@ -217,6 +299,10 @@ def main(argv: Optional[Sequence[str]] = None):
     if not args.texts and not args.stdin:  # catches omitted AND empty --texts
         raise SystemExit("nothing to serve: pass --texts ... or --stdin")
 
+    # drain handlers go in FIRST: a SIGTERM during the checkpoint load /
+    # warmup must already mean "graceful exit 0", not the default kill
+    drain_state, restore_handlers = _install_drain_handlers()
+
     if args.cpu:
         from perceiver_io_tpu.utils.platform import ensure_cpu_only
 
@@ -247,18 +333,31 @@ def main(argv: Optional[Sequence[str]] = None):
                   file=sys.stderr, flush=True)
 
     try:
-        return _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint)
+        if args.replicas > 0:
+            return _serve_fleet(args, drain_state)
+        return _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint,
+                      drain_state)
+    except _DrainRequested:
+        # the signal landed during startup (load/warmup), before any request
+        # was admitted: nothing is in flight, exit 0 with nothing served
+        print("serve: drain requested during startup — exiting with no "
+              "requests admitted", file=sys.stderr, flush=True)
+        return []
     finally:
-        # an exception mid-serve must not leak the sidecar thread or leave
-        # the process-global event log bound to this run's file (serve.main
-        # is also called in-process by tests/other tools)
+        # an exception mid-serve must not leak the sidecar thread, the
+        # drain signal handlers, or leave the process-global event log
+        # bound to this run's file (serve.main is also called in-process by
+        # tests/other tools). configure_event_log(None) FLUSHES and closes
+        # the JSONL stream — the drain contract's "flush the event log".
+        restore_handlers()
         if obs_server is not None:
             obs_server.close()
         if args.events_jsonl:
             obs.configure_event_log(None)
 
 
-def _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint):
+def _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint,
+           drain_state=None):
     # Deliberately tier 1 ONLY in the serve process: the AOT executable
     # cache covers every compile serving performs (the bucket programs), and
     # enabling jax's persistent compilation cache IN ADDITION measurably
@@ -317,35 +416,63 @@ def _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint):
             results.append(line)
             print(json.dumps(line))
 
-        if args.texts:
-            if args.cached:
-                cached = server.encode(args.texts)
-                fills = server.fill_masks_cached(cached, k=args.k)
-            else:
-                fills = server.fill_masks(args.texts, k=args.k)
-            for text, f in zip(args.texts, fills):
-                emit(text, f)
-        if args.stdin:
-            if args.cached:
-                # cached mode batches the whole pipe: one encode sweep, one
-                # decode sweep — per-line sync round-trips would serialize
-                # into exactly the naive dispatch the engine exists to beat
-                lines = [l.rstrip("\n") for l in sys.stdin]
-                lines = [l for l in lines if l]
-                cached = server.encode(lines)
-                for text, f in zip(lines, server.fill_masks_cached(
-                        cached, k=args.k)):
-                    emit(text, f)
-            else:
-                # a line-per-request stream: submit as lines arrive, resolve
-                # in order — arrivals batch up behind the in-flight dispatch
-                pending = []
-                for line in sys.stdin:
-                    text = line.rstrip("\n")
-                    if text:
+        # pending futures in emission order — tracked OUTSIDE the admission
+        # loops so a drain signal that unwinds them still finds (and
+        # finishes) every accepted request
+        pending = []
+        try:
+            if args.texts:
+                if args.cached:
+                    cached = server.encode(args.texts)
+                    for text, f in zip(args.texts, server.fill_masks_cached(
+                            cached, k=args.k)):
+                        emit(text, f)
+                else:
+                    for text in args.texts:
                         pending.append((text, server.submit(text, k=args.k)))
-                for text, fut in pending:
-                    emit(text, fut.result())
+            if args.stdin:
+                if args.cached:
+                    # cached mode batches the whole pipe: one encode sweep,
+                    # one decode sweep — per-line sync round-trips would
+                    # serialize into exactly the naive dispatch the engine
+                    # exists to beat
+                    lines = [l.rstrip("\n") for l in sys.stdin]
+                    lines = [l for l in lines if l]
+                    cached = server.encode(lines)
+                    for text, f in zip(lines, server.fill_masks_cached(
+                            cached, k=args.k)):
+                        emit(text, f)
+                else:
+                    # a line-per-request stream: submit as lines arrive,
+                    # resolve in order — arrivals batch up behind the
+                    # in-flight dispatch. The marker line tells a supervisor
+                    # (and the drain test) admission is live.
+                    print("serve: admitting stdin", file=sys.stderr,
+                          flush=True)
+                    for line in sys.stdin:
+                        text = line.rstrip("\n")
+                        if text:
+                            pending.append(
+                                (text, server.submit(text, k=args.k)))
+        except _DrainRequested:
+            # graceful drain: admission stopped (the raise unwound the
+            # loops); everything already accepted below still finishes and
+            # the process exits 0 — a supervisor rotation never drops the
+            # queue. Later signals are absorbed by the handler.
+            print("serve: drain requested (signal) — admission stopped, "
+                  f"finishing {len(pending)} in-flight request(s)",
+                  file=sys.stderr, flush=True)
+        # admission is over either way: mark draining so a FIRST signal
+        # landing during the resolve loop below is absorbed by the handler
+        # (printed, not raised) — finish-in-flight can never be unwound
+        # into dropping accepted results
+        signaled = drain_state is not None and drain_state.get("draining")
+        if drain_state is not None:
+            drain_state["draining"] = True
+        for text, fut in pending:
+            emit(text, fut.result())
+        if signaled:
+            server.drain(args.drain_timeout_s)
         if warmup_handle is not None and warmup_handle.done():
             try:
                 n = warmup_handle.wait(0)
@@ -357,6 +484,179 @@ def _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint):
                       "on demand", file=sys.stderr)
         if args.stats:
             print(f"serve: stats {json.dumps(server.stats())}", file=sys.stderr)
+    return results
+
+
+def _serve_fleet(args, drain_state):
+    """``--replicas N``: the router-tier serving path. N replica processes
+    each load the checkpoint and warm their own pools; the router does the
+    tokenize/top-k host work and least-loaded dispatch; ``--cached`` runs
+    encode-once/decode-many with session affinity (the latents stay on the
+    replica that encoded them)."""
+    import numpy as np
+
+    from perceiver_io_tpu.data.tokenizer import (
+        MASK_TOKEN,
+        PAD_TOKEN,
+        load_tokenizer,
+    )
+    from perceiver_io_tpu.inference.mlm import (
+        masked_token_ids,
+        pad_token_rows,
+    )
+    from perceiver_io_tpu.inference.predictor import bucket_size
+    from perceiver_io_tpu.resilience import AffinityLost
+    from perceiver_io_tpu.serving import ReplicaSupervisor, Router
+    from perceiver_io_tpu.training.checkpoint import load_hparams
+
+    tokenizer = load_tokenizer(args.tokenizer)
+    max_seq_len = load_hparams(args.checkpoint)["max_seq_len"]
+    mask_id = tokenizer.token_to_id(MASK_TOKEN)
+    pad_id = tokenizer.token_to_id(PAD_TOKEN)
+
+    extra = ["--checkpoint", args.checkpoint, "--tokenizer", args.tokenizer,
+             "--max_batch", str(args.max_batch), "--dtype", args.dtype,
+             "--max_delay_ms", str(args.max_delay_ms),
+             "--drain_timeout_s", str(args.drain_timeout_s)]
+    if args.bucket_widths is not None:
+        # width bucketing is an MLMServer concern; replicas serve the
+        # full-width rows the router prepares
+        print("serve: --bucket_widths has no effect with --replicas "
+              "(fleet requests are prepared at max_seq_len width)",
+              file=sys.stderr, flush=True)
+    if args.cpu:
+        extra.append("--cpu")
+    if args.step is not None:
+        extra += ["--step", str(args.step)]
+    if args.quantize != "none":
+        extra += ["--quantize", args.quantize]
+    if args.compile_cache:
+        extra += ["--compile_cache", args.compile_cache]
+    if args.no_warmup:
+        extra.append("--no_warmup")
+    if args.queue_limit is not None:
+        extra += ["--queue_limit", str(args.queue_limit)]
+    if args.request_deadline_s is not None:
+        extra += ["--request_deadline_s", str(args.request_deadline_s)]
+    extra += ["--dispatch_retries", str(args.dispatch_retries)]
+    if args.breaker_failures:
+        extra += ["--breaker_failures", str(args.breaker_failures),
+                  "--breaker_cooldown_s", str(args.breaker_cooldown_s)]
+    if args.heartbeat_deadline_s is not None:
+        extra += ["--heartbeat_deadline_s", str(args.heartbeat_deadline_s)]
+    if args.slo_p99_ms is not None:
+        extra += ["--slo_p99_ms", str(args.slo_p99_ms),
+                  "--slo_availability", str(args.slo_availability)]
+
+    def prepare(text):
+        row = masked_token_ids(tokenizer, text)[:max_seq_len]
+        ids, pad = pad_token_rows([row], max_seq_len, pad_id)
+        mask_pos = np.nonzero(ids[0] == mask_id)[0]
+        kb = bucket_size(max(len(mask_pos), 1), max_seq_len)
+        positions = np.zeros((1, kb), np.int32)
+        positions[0, : len(mask_pos)] = mask_pos
+        return ids, pad, mask_pos, positions
+
+    def topk(logits, n_masks):
+        out = []
+        for slot in range(n_masks):
+            top = np.argsort(-np.asarray(logits[0, slot], np.float32))[:args.k]
+            out.append([tokenizer.id_to_token(int(t)) for t in top])
+        return out
+
+    results = []
+
+    def emit(text, fills):
+        line = {"text": text, "fills": fills}
+        results.append(line)
+        print(json.dumps(line))
+
+    with ReplicaSupervisor(count=args.replicas, extra_args=extra,
+                           cpu=args.cpu) as sup:
+        clients = sup.start()
+        print(f"serve: spawned {args.replicas} replicas; waiting for warm "
+              "pools (engine_ready)", file=sys.stderr, flush=True)
+        sup.wait_ready(timeout_s=600.0)
+        with Router(clients, name="serve",
+                    queue_limit=args.queue_limit) as router:
+            router.refresh()
+            pending = []  # (text, future-or-None, n_masks)
+
+            def submit(text):
+                ids, pad, mask_pos, positions = prepare(text)
+                if len(mask_pos) == 0:
+                    pending.append((text, None, 0))
+                    return
+                if args.cached:
+                    # encode-once: the encode is ASYNC so successive lines
+                    # overlap and micro-batch on the replicas (a per-line
+                    # sync round-trip would serialize admission into naive
+                    # dispatch). The decode is submitted at RESOLVE time,
+                    # after its encode established the pin — submitting it
+                    # now would race the pin and land on a replica without
+                    # the latents.
+                    session = f"t{len(pending)}"
+                    enc = router.submit(ids, pad, kind="encode",
+                                        session=session)
+                    fut = (session, ids, pad, positions, enc)
+                else:
+                    fut = router.submit(ids, pad, positions)
+                pending.append((text, fut, len(mask_pos)))
+
+            def resolve(fut, n_masks):
+                if not isinstance(fut, tuple):
+                    return topk(fut.result(timeout=600), n_masks)
+                session, ids, pad, positions, enc = fut
+                enc.result(timeout=600)  # pin established
+                try:
+                    logits = router.decode(positions, session=session,
+                                           timeout=600)
+                except AffinityLost:
+                    # the pinned replica (and its latents) died:
+                    # re-encode on a live replica — which re-pins —
+                    # and decode there (spill-on-death)
+                    router.encode(ids, pad, session=session, timeout=600)
+                    logits = router.decode(positions, session=session,
+                                           timeout=600)
+                return topk(logits, n_masks)
+
+            try:
+                for text in (args.texts or []):
+                    submit(text)
+                if args.stdin:
+                    print("serve: admitting stdin", file=sys.stderr,
+                          flush=True)
+                    for line in sys.stdin:
+                        text = line.rstrip("\n")
+                        if text:
+                            submit(text)
+            except _DrainRequested:
+                print("serve: drain requested (signal) — admission stopped, "
+                      f"finishing {len(pending)} in-flight request(s)",
+                      file=sys.stderr, flush=True)
+            # admission is over either way: mark draining so a FIRST signal
+            # landing during the resolve loop is absorbed by the handler
+            # (printed, not raised) — finish-in-flight can never be unwound
+            # into dropping accepted results
+            signaled = drain_state.get("draining")
+            drain_state["draining"] = True
+            for text, fut, n_masks in pending:
+                emit(text, [] if fut is None else resolve(fut, n_masks))
+            if args.rolling_swap_step is not None and not signaled:
+                report = router.rolling_update(
+                    {"kind": "checkpoint", "path": args.checkpoint,
+                     "step": args.rolling_swap_step},
+                    bake_s=args.rolling_bake_s,
+                    burn_threshold=args.rolling_burn_threshold,
+                )
+                print(f"serve: rolling swap {json.dumps(report)}",
+                      file=sys.stderr, flush=True)
+            if args.stats:
+                print(f"serve: fleet stats {json.dumps(router.stats())}",
+                      file=sys.stderr)
+            # graceful fleet teardown: replicas finish accepted work before
+            # the supervisor's quit/terminate sequence
+            router.drain(args.drain_timeout_s)
     return results
 
 

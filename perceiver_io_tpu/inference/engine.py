@@ -560,13 +560,18 @@ class ServingEngine:
         self._m_shed = {
             reason: reg.counter("serving_shed_total", shed_help,
                                 {**labels, "reason": reason})
-            for reason in ("queue_full", "breaker_open", "deadline")
+            for reason in ("queue_full", "breaker_open", "deadline", "draining")
         }
         self._m_retries = reg.counter(
             "serving_dispatch_retries_total",
             "transient micro-batch re-dispatch cycles", labels)
         self._backlog = 0  # parts admitted but not yet dispatched/shed
                            # (written under _stats_lock)
+        self._assembling = 0  # parts the worker has popped from the backlog
+                              # but not yet dispatched/shed/failed — closes
+                              # the drain() window between the backlog
+                              # decrement and the in-flight increment
+                              # (written under _stats_lock)
 
         # zero-recompile cold start (perceiver_io_tpu.aot): when a cache is
         # attached, every bucket program dispatches through an AOT-compiled
@@ -631,6 +636,7 @@ class ServingEngine:
 
         self._crash: Optional[BaseException] = None
         self._stop = threading.Event()
+        self._draining = threading.Event()
         self._thread = threading.Thread(
             target=self._run, name=f"{name}-engine", daemon=True
         )
@@ -748,6 +754,16 @@ class ServingEngine:
         t_entry = time.monotonic()
         if self._stop.is_set():
             raise self._closed_error()
+        if self._draining.is_set():
+            # graceful drain: already-admitted work keeps flowing, NEW work
+            # is refused with the shed-fast semantics of a full queue. The
+            # refusal is deliberately NOT an SLO breach: the tier above (the
+            # serving router, a supervisor restart) re-routes it — the
+            # request is displaced, not lost.
+            self._m_shed["draining"].inc()
+            raise RejectedError(
+                f"engine {self.name!r} is draining — not admitting new work"
+            )
         if self.breaker is not None and not self.breaker.allow():
             self._m_shed["breaker_open"].inc()
             self._slo_bad()
@@ -955,20 +971,29 @@ class ServingEngine:
                 if parts is not None:
                     with self._stats_lock:
                         self._backlog -= len(parts)
-                    # assembly-side deadline enforcement: a part whose caller
-                    # already gave up must not burn a dispatch
-                    parts = self._shed_expired(parts)
-                    if not parts:
-                        continue
-                    # armed BEFORE the dispatch call: a wedged tunnel can
-                    # hang the dispatch itself, not just the completion
-                    self.heartbeat.arm()
+                        self._assembling += len(parts)
                     try:
-                        inflight.append((self._dispatch(parts), parts))
-                    except BaseException as e:  # bad batch: retry or fail it
-                        self._batch_failed(parts, e, where="dispatch")
-                    _note_inflight()
-                    if self._profiler is not None:
+                        # assembly-side deadline enforcement: a part whose
+                        # caller already gave up must not burn a dispatch
+                        live = self._shed_expired(parts)
+                        if live:
+                            # armed BEFORE the dispatch call: a wedged tunnel
+                            # can hang the dispatch itself, not just the
+                            # completion
+                            self.heartbeat.arm()
+                            try:
+                                inflight.append((self._dispatch(live), live))
+                            except BaseException as e:  # bad batch
+                                self._batch_failed(live, e, where="dispatch")
+                            _note_inflight()
+                    finally:
+                        # only AFTER the parts are accounted elsewhere
+                        # (in-flight, shed, failed, or re-queued) — a
+                        # concurrent drain() poll never sees a false-empty
+                        # window mid-assembly
+                        with self._stats_lock:
+                            self._assembling -= len(parts)
+                    if live and self._profiler is not None:
                         self._profiler.tick(sync=_sync_inflight)
                     continue
                 if inflight:
@@ -1003,6 +1028,7 @@ class ServingEngine:
                     break
             with self._stats_lock:
                 self._backlog = 0
+                self._assembling = 0
             raise
 
     def _shed_expired(self, parts: List[_Part]) -> List[_Part]:
@@ -1229,6 +1255,10 @@ class ServingEngine:
         for p in parts:
             p.t_sealed = t_sealed
         faults.inject("engine.dispatch")  # chaos hook: no-op unless installed
+        # per-engine site: multi-replica chaos drills target ONE replica's
+        # dispatch path (`engine.dispatch.<name>`) without perturbing the
+        # generic site's call counts
+        faults.inject(f"engine.dispatch.{self.name}")
         n = sum(p.n for p in parts)
         bucket = bucket_size(n, self.max_batch)
         num_inputs = len(parts[0].inputs)
@@ -1280,6 +1310,7 @@ class ServingEngine:
         out, bucket = out_bucket
         try:
             faults.inject("engine.complete")  # chaos hook
+            faults.inject(f"engine.complete.{self.name}")  # per-engine site
             host = jax.tree.map(np.asarray, jax.device_get(out))
         except BaseException as e:
             self._batch_failed(parts, e, where="complete")
@@ -1344,6 +1375,96 @@ class ServingEngine:
             for row in phase_rows:
                 for k, v in row.items():
                     ph.setdefault(k, deque(maxlen=4096)).append(v)
+
+    # -- replica-facing surface (perceiver_io_tpu.serving) -------------------
+    #
+    # The router tier consumes exactly this contract from every replica:
+    # submit()/predict() for traffic, update_params() for rolling rollout,
+    # `ready` for join gating, drain()/resume_admission() for graceful
+    # rotation, stats()/the registry gauges for load-aware dispatch.
+
+    @property
+    def ready(self) -> bool:
+        """True once the last requested warmup family is fully warm (the
+        ``engine_ready`` gauge) — what a router's join gate polls before
+        admitting a (re)started replica."""
+        return self._m_ready.value >= 1.0
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    @property
+    def backlog(self) -> int:
+        """Parts admitted but not yet dispatched/shed — the queue-depth term
+        of a router's least-loaded score."""
+        with self._stats_lock:
+            return self._backlog
+
+    @property
+    def inflight(self) -> int:
+        """Micro-batches currently dispatched (racy read, diagnostics-grade)."""
+        return self._inflight_count
+
+    @property
+    def params_pending(self) -> bool:
+        """True while a staged ``update_params`` tree awaits the worker's
+        between-batches install (the replica shim's swap RPC answers only
+        once this clears, so a rollout's bake window never watches a
+        replica that is still serving the OLD tree)."""
+        return self._pending_params is not None
+
+    @property
+    def requests_served(self) -> int:
+        """Requests admitted over this engine's lifetime (the rollout bake's
+        did-traffic-actually-flow check)."""
+        with self._stats_lock:
+            return self._stats["requests"]
+
+    def drain(self, timeout: Optional[float] = None,
+              poll_s: float = 0.01) -> bool:
+        """Graceful drain: stop admitting, finish everything already accepted.
+
+        New ``submit()`` calls fail fast with :class:`RejectedError`
+        immediately; queued parts and in-flight micro-batches complete
+        normally (accepted work is never dropped). Returns True once nothing
+        admitted remains un-served, False if ``timeout`` elapsed first (work
+        is still in flight — the engine stays draining either way). The
+        engine itself stays alive: ``resume_admission()`` re-opens it (the
+        rolling-rollout path drains, swaps params, resumes), ``close()``
+        detaches it.
+        """
+        self._draining.set()
+        obs.event("engine_drain_begin", engine=self.name)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._stats_lock:
+                backlog = self._backlog + self._assembling
+            if (backlog == 0 and self._inflight_count == 0
+                    and self._queue.empty()):
+                obs.event("engine_drained", engine=self.name)
+                return True
+            if self._stop.is_set():
+                # a closing/crashed engine cannot finish the work; the
+                # worker's own shutdown/crash path fails the futures
+                return False
+            if deadline is not None and time.monotonic() >= deadline:
+                obs.event("engine_drain_timeout", engine=self.name,
+                          backlog=backlog, inflight=self._inflight_count)
+                return False
+            time.sleep(poll_s)
+
+    def stop_admission(self) -> None:
+        """Close admission without waiting (``drain()`` = this + the wait).
+        Multi-engine callers close EVERY door first so a composite request
+        can never slip in behind an already-drained sibling — see
+        :func:`drain_engines`."""
+        self._draining.set()
+
+    def resume_admission(self) -> None:
+        """Re-open a drained engine for traffic (the rollout undrain)."""
+        self._draining.clear()
+        obs.event("engine_drain_end", engine=self.name)
 
     # -- introspection / lifecycle -------------------------------------------
 
@@ -1432,6 +1553,58 @@ class ServingEngine:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+def drain_engines(engines, timeout: Optional[float] = None) -> bool:
+    """Drain several engines as ONE unit: close every door first (a
+    composite request — e.g. an MLM fill that rides encoder AND decoder —
+    can never slip in behind an already-drained sibling), then wait on each
+    under one shared deadline. Returns True only when every engine drained
+    in time. The callers: :meth:`MLMServer.drain` and the replica shim's
+    ``ReplicaApp.drain``."""
+    engines = list(engines)
+    for eng in engines:
+        eng.stop_admission()
+    deadline = None if timeout is None else time.monotonic() + timeout
+    ok = True
+    for eng in engines:
+        left = (None if deadline is None
+                else max(0.0, deadline - time.monotonic()))
+        ok = eng.drain(timeout=left) and ok
+    return ok
+
+
+def mlm_apply_fns(model) -> Dict[str, Callable]:
+    """The three serving program families over one ``PerceiverMLM`` — the
+    fused single-pass path plus the encode/decode latent-cache split — as
+    plain ``apply_fn(params, *arrays)`` callables, keyed by the RPC verb the
+    replica shim serves them under (``infer``/``encode``/``decode``).
+
+    ONE definition shared by :class:`MLMServer` (in-process serving) and
+    ``perceiver_io_tpu.serving.replica`` (a replica process hosting the same
+    engines behind the router tier), so the two surfaces can never drift."""
+
+    def fused_apply(p, token_ids, pad_mask, positions):
+        logits, _ = model.apply(
+            {"params": p}, token_ids, pad_mask, masking=False,
+            deterministic=True, positions=positions,
+        )
+        return logits
+
+    def encode_apply(p, token_ids, pad_mask):
+        return model.apply(
+            {"params": p}, token_ids, pad_mask, deterministic=True,
+            method="encode",
+        )
+
+    def decode_apply(p, latents, positions):
+        return model.apply(
+            {"params": p}, latents, deterministic=True,
+            positions=positions, method="decode",
+        )
+
+    return {"infer": fused_apply, "encode": encode_apply,
+            "decode": decode_apply}
 
 
 class CachedLatents:
@@ -1524,24 +1697,7 @@ class MLMServer:
             prepare_param_tree(params, compute_dtype, quantize)
         )
 
-        def fused_apply(p, token_ids, pad_mask, positions):
-            logits, _ = model.apply(
-                {"params": p}, token_ids, pad_mask, masking=False,
-                deterministic=True, positions=positions,
-            )
-            return logits
-
-        def encode_apply(p, token_ids, pad_mask):
-            return model.apply(
-                {"params": p}, token_ids, pad_mask, deterministic=True,
-                method="encode",
-            )
-
-        def decode_apply(p, latents, positions):
-            return model.apply(
-                {"params": p}, latents, deterministic=True,
-                positions=positions, method="decode",
-            )
+        apply_fns = mlm_apply_fns(model)
 
         common = dict(
             max_batch=max_batch, max_delay_ms=max_delay_ms,
@@ -1566,12 +1722,14 @@ class MLMServer:
         )
         # fused single-pass path (one-shot requests) + the split pair
         # (latent-cache workloads); each engine owns one program family
-        self.engine = ServingEngine(fused_apply, params, name="mlm", **common)
+        self.engine = ServingEngine(
+            apply_fns["infer"], params, name="mlm", **common
+        )
         self.encoder = ServingEngine(
-            encode_apply, params, name="mlm_enc", **common
+            apply_fns["encode"], params, name="mlm_enc", **common
         )
         self.decoder = ServingEngine(
-            decode_apply, params, name="mlm_dec", **common
+            apply_fns["decode"], params, name="mlm_dec", **common
         )
 
         # latent-cache accounting: a "hit" is a fill-mask answered from
@@ -1838,6 +1996,22 @@ class MLMServer:
             return handle
         supervise()
         return handle.wait()
+
+    @property
+    def ready(self) -> bool:
+        """All three program families fully warm (router join gate)."""
+        return all(e.ready for e in (self.engine, self.encoder, self.decoder))
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain across all three engines: stop admitting, finish
+        everything accepted (see :meth:`ServingEngine.drain` and
+        :func:`drain_engines` for the close-every-door-first ordering)."""
+        return drain_engines((self.engine, self.encoder, self.decoder),
+                             timeout)
+
+    def resume_admission(self) -> None:
+        for eng in (self.engine, self.encoder, self.decoder):
+            eng.resume_admission()
 
     def stats(self) -> Dict[str, Any]:
         """Locked, deep-copied snapshot across the three engines (the

@@ -19,6 +19,10 @@ One subsystem, four pieces, every layer wired through it:
   model fitted from an offered-load sweep (``tools/load_bench.py``).
 - :mod:`process` — process self-metrics (RSS, uptime, threads, GC) refreshed
   at scrape time via the registry's collector hook.
+- :mod:`fleet` — multi-replica aggregation: the fleet-aware ``healthz()``
+  source (one replica's open breaker degrades that replica's label, never
+  the router's status code while other replicas serve) and the per-replica
+  labeled gauges the router publishes from its scrape loop.
 
 Importing this package never initializes a jax backend — entry points stay
 free to pick their platform (``ensure_cpu_only``) first.
@@ -31,6 +35,7 @@ from perceiver_io_tpu.obs.health import (
     thread_stacks,
     unregister_health_source,
 )
+from perceiver_io_tpu.obs.fleet import FleetHealth, ReplicaGauges
 from perceiver_io_tpu.obs.http import ObsServer
 from perceiver_io_tpu.obs.process import install_process_metrics
 from perceiver_io_tpu.obs.registry import (
@@ -55,11 +60,13 @@ from perceiver_io_tpu.obs.watchdog import SelfProfiler, install_compile_counter
 __all__ = [
     "Counter",
     "EventLog",
+    "FleetHealth",
     "Gauge",
     "Heartbeat",
     "Histogram",
     "MetricsRegistry",
     "ObsServer",
+    "ReplicaGauges",
     "SLO",
     "SLOTracker",
     "SelfProfiler",

@@ -1,0 +1,182 @@
+"""Fleet-level health + metrics aggregation for the multi-replica router.
+
+The single-process health model (``healthz()``) ANDs every registered source:
+one open breaker → 503. Correct for one engine over one device — the process
+really cannot serve — but wrong for a router over N replicas, where one
+replica's open breaker or burning SLO means *route around it*, not *the
+fleet is down*. :class:`FleetHealth` is the aggregation fix (the fleet-aware
+``healthz()``): per-replica trouble degrades that replica's LABEL in the
+detail body while the router reports healthy as long as at least
+``min_serving`` replicas still serve; only a fleet that cannot serve at all
+flips ``/healthz`` to 503.
+
+Two supporting pieces:
+
+- :func:`adopt_source` — re-scope a process-global health source (a local
+  replica's breaker or SLO tracker, which self-registered into ``healthz()``
+  at construction) UNDER the fleet: it is unregistered from the global
+  aggregate and folded into its replica's detail instead, so in-process
+  replicas get the same degraded-but-serving semantics as subprocess ones
+  (whose sources live behind their own ``/healthz``).
+- :class:`ReplicaGauges` — the per-replica metric surface the router
+  publishes from its scrape loop: ``fleet_replica_up/ready/queue_depth/
+  breaker_open/slo_burn{replica=...}`` gauges plus the fleet rollups
+  (``fleet_size``, ``fleet_replicas_serving``), so one ``/statz`` scrape of
+  the router shows the whole fleet with per-replica labels.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from perceiver_io_tpu.obs import health as _health
+from perceiver_io_tpu.obs.registry import MetricsRegistry, get_registry
+
+# replica lifecycle states as the router reports them; SERVING counts toward
+# the fleet quorum, everything else is visible-but-routed-around
+SERVING = "serving"
+DEGRADED = "degraded"
+JOINING = "joining"
+DRAINING = "draining"
+DOWN = "down"
+
+
+class FleetHealth:
+    """ONE ``healthz()`` source for a whole replica fleet.
+
+    ``statuses`` is a zero-arg callable returning the router's live view:
+    ``{replica_name: {"state": SERVING|DEGRADED|JOINING|DRAINING|DOWN,
+    ...detail}}``. The fleet is healthy while at least ``min_serving``
+    replicas are in ``SERVING`` — per-replica degradation rides the detail
+    body (scrapers see exactly which replica is in trouble and why), never
+    the aggregate status code.
+    """
+
+    def __init__(self, statuses: Callable[[], Dict[str, Dict[str, Any]]],
+                 name: str = "fleet", min_serving: int = 1):
+        if min_serving < 1:
+            raise ValueError(f"min_serving must be >= 1, got {min_serving}")
+        self.name = name
+        self.min_serving = min_serving
+        self._statuses = statuses
+        self._lock = threading.Lock()
+        self._adopted: Dict[str, list] = {}
+        self._registered = True
+        _health.register_health_source(self)
+
+    def adopt_source(self, replica: str, source) -> None:
+        """Re-scope ``source`` (breaker / SLO tracker — anything with the
+        ``health_status()`` contract) from the process-global ``healthz()``
+        aggregate to ``replica``'s detail under this fleet. Without this, an
+        in-process replica's open breaker 503s the ROUTER."""
+        _health.unregister_health_source(source)
+        with self._lock:
+            self._adopted.setdefault(replica, []).append(source)
+
+    def release_sources(self, replica: str) -> None:
+        """Forget a removed replica's adopted sources (they are NOT re-
+        registered globally — the replica is gone)."""
+        with self._lock:
+            self._adopted.pop(replica, None)
+
+    def _fold_adopted(self, replica: str) -> Tuple[bool, Dict[str, Any]]:
+        with self._lock:
+            sources = list(self._adopted.get(replica, ()))
+        ok, detail = True, {}
+        for src in sources:
+            try:
+                name, src_ok, info = src.health_status()
+            except Exception as e:  # a broken source must not break the probe
+                name, src_ok, info = (
+                    type(src).__name__, False,
+                    {"error": f"{type(e).__name__}: {e}"},
+                )
+            detail[name] = info
+            ok = ok and src_ok
+        return ok, detail
+
+    # -- the healthz() source contract ---------------------------------------
+
+    def health_status(self) -> Tuple[str, bool, Dict[str, Any]]:
+        statuses = dict(self._statuses())
+        replicas: Dict[str, Any] = {}
+        serving = 0
+        for name in sorted(statuses):
+            info = dict(statuses[name])
+            src_ok, src_detail = self._fold_adopted(name)
+            if src_detail:
+                info["sources"] = src_detail
+            if not src_ok and info.get("state") == SERVING:
+                info["state"] = DEGRADED
+            if info.get("state") == SERVING:
+                serving += 1
+            replicas[name] = info
+        ok = serving >= self.min_serving
+        return f"fleet:{self.name}", ok, {
+            "status": ("serving" if serving == len(replicas) and replicas
+                       else "degraded" if ok else "down"),
+            "serving": serving,
+            "replicas_total": len(replicas),
+            "min_serving": self.min_serving,
+            "replicas": replicas,
+        }
+
+    def close(self) -> None:
+        if self._registered:
+            _health.unregister_health_source(self)
+            self._registered = False
+
+
+class ReplicaGauges:
+    """Per-replica labeled gauges + fleet rollups, written by the router's
+    scrape loop so one ``/statz`` read shows the whole fleet."""
+
+    def __init__(self, fleet: str = "fleet",
+                 registry: Optional[MetricsRegistry] = None):
+        self._reg = registry if registry is not None else get_registry()
+        self._fleet = fleet
+        self._per: Dict[str, Dict[str, Any]] = {}
+        self._m_size = self._reg.gauge(
+            "fleet_size", "replicas the router knows about",
+            {"fleet": fleet})
+        self._m_serving = self._reg.gauge(
+            "fleet_replicas_serving",
+            "replicas currently eligible for dispatch", {"fleet": fleet})
+
+    def _gauges(self, replica: str) -> Dict[str, Any]:
+        g = self._per.get(replica)
+        if g is None:
+            labels = {"fleet": self._fleet, "replica": replica}
+            g = {
+                "up": self._reg.gauge(
+                    "fleet_replica_up", "1 = process/transport reachable",
+                    labels),
+                "ready": self._reg.gauge(
+                    "fleet_replica_ready",
+                    "1 = warm pool live (engine_ready)", labels),
+                "queue_depth": self._reg.gauge(
+                    "fleet_replica_queue_depth",
+                    "scraped replica queue depth (parts)", labels),
+                "inflight": self._reg.gauge(
+                    "fleet_replica_inflight",
+                    "router-side requests in flight to this replica", labels),
+                "breaker_open": self._reg.gauge(
+                    "fleet_replica_breaker_open",
+                    "1 = any breaker open on the replica", labels),
+                "slo_burn": self._reg.gauge(
+                    "fleet_replica_slo_burn",
+                    "max scraped SLO error-budget burn rate", labels),
+            }
+            self._per[replica] = g
+        return g
+
+    def publish(self, replica: str, **values: float) -> None:
+        g = self._gauges(replica)
+        for key, val in values.items():
+            if key in g and val is not None:
+                g[key].set(float(val))
+
+    def publish_fleet(self, size: int, serving: int) -> None:
+        self._m_size.set(size)
+        self._m_serving.set(serving)

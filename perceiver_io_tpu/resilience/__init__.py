@@ -12,6 +12,10 @@ chaos substrate that proves it works without real hardware failures:
 - :mod:`breaker` — a circuit breaker (closed → open on consecutive failures
   or heartbeat stalls → half-open probe), exported to the metrics registry
   and ``healthz()``.
+- :mod:`failover` — the router-side placement policy: which replica errors
+  displace a request to ANOTHER replica (rejections and dead-replica socket
+  errors re-route, deadline expiry and lost session affinity never do), and
+  how many placements one request may burn.
 
 Consumers: ``inference/engine.py`` (deadline shedding, bounded-queue
 admission, transient re-dispatch, breaker-gated submission),
@@ -22,6 +26,7 @@ Importing this package never initializes a jax backend.
 """
 
 from perceiver_io_tpu.resilience.breaker import BreakerOpen, CircuitBreaker
+from perceiver_io_tpu.resilience.failover import AffinityLost, FailoverPolicy
 from perceiver_io_tpu.resilience.faults import (
     FaultInjector,
     FaultSpec,
@@ -38,9 +43,11 @@ from perceiver_io_tpu.resilience.retry import (
 )
 
 __all__ = [
+    "AffinityLost",
     "BreakerOpen",
     "CircuitBreaker",
     "DeadlineExceeded",
+    "FailoverPolicy",
     "FaultInjector",
     "FaultSpec",
     "InjectedFatalError",

@@ -72,6 +72,14 @@ def classify_error(exc: BaseException) -> str:
         return TRANSIENT
     if isinstance(exc, InjectedFatalError):
         return FATAL
+    # self-declared class: an error that crossed a process boundary (the
+    # replica RPC shim mirrors the REMOTE side's classification as a bool
+    # `transient` attribute) keeps its original verdict — re-deriving it
+    # from the mirrored message text would misread, e.g., a fatal shape
+    # error whose repr happens to contain 'connection'
+    declared = getattr(exc, "transient", None)
+    if isinstance(declared, bool):
+        return TRANSIENT if declared else FATAL
     if isinstance(exc, (ConnectionResetError, ConnectionAbortedError,
                         BrokenPipeError, TimeoutError)):
         return TRANSIENT

@@ -1,0 +1,48 @@
+"""Multi-replica serving fabric: router tier, replica RPC shim, supervisor.
+
+One serving process is a single point of failure no matter how self-healing
+its engine is (r9): a wedged dispatch or a killed process is a full outage.
+This package composes the ingredients r6–r11 built — ``engine_ready``/queue
+/breaker/SLO-burn gauges, ``update_params`` hot-swap, AOT warm pools,
+graceful drain — into redundancy:
+
+- :mod:`replica` — one serving process behind the fleet: engines exposed
+  over a localhost RPC surface (arrays in/out, mirrored error classes,
+  latent-cache sessions resident ON the replica), plus the in-process
+  :class:`LocalReplica` twin for tests and single-host sweeps.
+- :mod:`supervisor` — spawns and babysits the replica processes:
+  restart-with-backoff on crash, rejoin gated on the warm pool
+  (``engine_ready``), crash-loop detachment.
+- :mod:`router` — the traffic tier: least-loaded health-aware dispatch,
+  transparent failover (zero lost accepted requests when a replica dies),
+  latent-cache affinity with spill-on-death, graceful drain, and rolling
+  rollout with fleet-wide auto-rollback.
+
+Importing this package never initializes a jax backend.
+"""
+
+from perceiver_io_tpu.serving.replica import (
+    HttpReplicaClient,
+    LocalReplica,
+    RemoteEngineError,
+    ReplicaApp,
+    ReplicaServer,
+)
+from perceiver_io_tpu.serving.router import Router, RouterClosed, RouterFuture
+from perceiver_io_tpu.serving.supervisor import (
+    ReplicaSupervisor,
+    default_replica_argv,
+)
+
+__all__ = [
+    "HttpReplicaClient",
+    "LocalReplica",
+    "RemoteEngineError",
+    "ReplicaApp",
+    "ReplicaServer",
+    "ReplicaSupervisor",
+    "Router",
+    "RouterClosed",
+    "RouterFuture",
+    "default_replica_argv",
+]

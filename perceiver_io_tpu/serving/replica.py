@@ -1,0 +1,807 @@
+"""Replica-side RPC shim: one serving process behind the router tier.
+
+A *replica* is one process-wide set of serving engines (the fused MLM path
+plus the encode/decode latent-cache split — ``mlm_apply_fns``) exposed over a
+localhost HTTP surface the router consumes. The wire protocol is deliberately
+boring — stdlib HTTP, ``np.savez`` bodies — because the interesting contracts
+are semantic, not syntactic:
+
+- **arrays in, arrays out** (``POST /rpc/infer|encode|decode``): request body
+  is an npz of positional input arrays; a 200 response body is an npz of the
+  output pytree's leaves. Anything else is a JSON error that MIRRORS the
+  replica-side exception class across the process boundary (rejected /
+  breaker_open / deadline / affinity_lost / engine+transient-bool), so the
+  router's failover policy classifies a remote failure exactly as it would a
+  local one.
+- **latent-cache sessions live ON the replica** (``/rpc/encode?session=S``
+  stores the latents; ``/rpc/decode?session=S`` reads them): the whole point
+  of affinity routing is that the encoded state never re-crosses the wire.
+  A replica that died (or restarted) answers a decode for a session it never
+  saw with ``affinity_lost`` — the router drops the pin and the caller
+  re-encodes (spill-on-death).
+- **admin verbs are the rollout surface**: ``/admin/drain`` stops admission
+  and returns once accepted work finished (``ServingEngine.drain``),
+  ``/admin/resume`` re-opens, ``/admin/update_params`` hot-swaps the served
+  tree from a params *spec* (checkpoint path / reinit seed / scale factor /
+  ``rollback`` to the previous tree — kept in memory exactly for the
+  router's auto-rollback), ``/admin/quit`` exits cleanly.
+- **readiness is explicit** (``GET /statz`` → ``replica.ready``): true only
+  once every engine's warm pool is live (the ``engine_ready`` gauges), which
+  is what gates a (re)started replica's join — a replica mid-warmup is
+  scraped as JOINING and receives no traffic.
+
+``python -m perceiver_io_tpu.serving.replica --port P --preset tiny --cpu``
+runs a synthetic-init replica (tests, ``tools/load_bench.py --replicas``);
+``--checkpoint/--tokenizer`` serves a real train run (``cli/serve.py
+--replicas`` spawns exactly this). SIGTERM/SIGINT drain gracefully and exit
+0. ``PIT_FAULTS`` (env) applies inside the replica process, so chaos drills
+target one replica's dispatch path (``engine.dispatch.<engine-name>``)
+without code changes.
+
+:class:`LocalReplica` is the in-process twin of the HTTP client — the same
+call/scrape/drain/update surface over engines in THIS process (tier-1 tests,
+single-host load sweeps) with a ``kill()`` that simulates the dead-replica
+transport signature (connection errors, sessions lost).
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import sys
+import threading
+import time
+from collections import OrderedDict
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import perceiver_io_tpu.obs as obs
+from perceiver_io_tpu.resilience import (
+    AffinityLost,
+    BreakerOpen,
+    DeadlineExceeded,
+    RejectedError,
+    classify_error,
+)
+
+_MAX_SESSIONS = 1024  # FIFO-evicted; a session is one encode's latents
+
+
+class RemoteEngineError(RuntimeError):
+    """A replica-side engine error mirrored across the RPC boundary; carries
+    the remote classification as the ``transient`` attribute the taxonomy
+    honors (``classify_error``), so failover decisions survive the hop."""
+
+    def __init__(self, message: str, transient: bool):
+        super().__init__(message)
+        self.transient = transient
+
+
+# -- wire format -------------------------------------------------------------
+
+
+def pack_arrays(arrays: Sequence[np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **{f"arr{i}": np.asarray(a) for i, a in enumerate(arrays)})
+    return buf.getvalue()
+
+
+def unpack_arrays(data: bytes) -> List[np.ndarray]:
+    with np.load(io.BytesIO(data)) as z:
+        return [z[f"arr{i}"] for i in range(len(z.files))]
+
+
+def _error_body(kind: str, message: str, transient: bool = False) -> bytes:
+    return json.dumps(
+        {"error": kind, "message": message, "transient": transient}
+    ).encode()
+
+
+_ERROR_KINDS = {
+    BreakerOpen: "breaker_open",
+    RejectedError: "rejected",
+    DeadlineExceeded: "deadline",
+    AffinityLost: "affinity_lost",
+}
+
+
+def _wire_error(exc: BaseException) -> bytes:
+    for cls, kind in _ERROR_KINDS.items():
+        if isinstance(exc, cls):
+            return _error_body(kind, str(exc))
+    return _error_body(
+        "engine", f"{type(exc).__name__}: {exc}",
+        transient=classify_error(exc) == "transient",
+    )
+
+
+def raise_wire_error(body: bytes, replica: str) -> None:
+    """Client side: re-raise the replica's mirrored exception."""
+    try:
+        err = json.loads(body.decode())
+    except (ValueError, UnicodeDecodeError):
+        raise RemoteEngineError(
+            f"replica {replica!r}: unparseable error body", transient=False)
+    kind, msg = err.get("error", "engine"), err.get("message", "")
+    prefix = f"replica {replica!r}: "
+    if kind == "breaker_open":
+        raise BreakerOpen(prefix + msg)
+    if kind == "rejected":
+        raise RejectedError(prefix + msg)
+    if kind == "deadline":
+        raise DeadlineExceeded(prefix + msg)
+    if kind == "affinity_lost":
+        raise AffinityLost(prefix + msg)
+    raise RemoteEngineError(prefix + msg, transient=bool(err.get("transient")))
+
+
+# -- the replica application -------------------------------------------------
+
+
+class ReplicaApp:
+    """One replica's serving state: engines keyed by RPC verb, the latent
+    session store, and the params spec machinery (update / in-memory
+    rollback) the rolling rollout drives.
+
+    ``params_factory(spec) -> raw param tree`` realizes ``checkpoint`` /
+    ``reinit`` specs (the process entry point knows how to build its model);
+    ``scale`` and ``rollback`` are handled here. The previous raw tree is
+    kept in memory so a rollback is an instant re-install, never a reload.
+    """
+
+    def __init__(
+        self,
+        engines: Dict[str, Any],
+        params,
+        params_factory: Optional[Callable[[Dict[str, Any]], Any]] = None,
+        name: str = "replica",
+        registry: Optional[obs.MetricsRegistry] = None,
+        assume_ready: bool = False,
+        drain_timeout_s: float = 60.0,
+    ):
+        if not engines:
+            raise ValueError("ReplicaApp needs at least one engine")
+        self.name = name
+        self.engines = dict(engines)
+        self.drain_timeout_s = drain_timeout_s
+        self._params = params
+        self._prev_params = None
+        self._params_factory = params_factory
+        self._update_lock = threading.Lock()
+        self._assume_ready = assume_ready
+        self._sessions: "OrderedDict[str, Any]" = OrderedDict()
+        self._sessions_lock = threading.Lock()
+        self.quit_event = threading.Event()
+        reg = registry if registry is not None else obs.get_registry()
+        self._m_version = reg.gauge(
+            "replica_params_version",
+            "monotonic count of installed param trees (0 = the boot tree)",
+            {"replica": name})
+        self._m_sessions = reg.gauge(
+            "replica_sessions", "latent-cache sessions resident",
+            {"replica": name})
+
+    # -- traffic -------------------------------------------------------------
+
+    def call(self, kind: str, arrays: List[np.ndarray],
+             session: Optional[str] = None,
+             timeout_s: Optional[float] = None) -> List[np.ndarray]:
+        import jax
+
+        engine = self.engines.get(kind)
+        if engine is None:
+            raise ValueError(
+                f"unknown rpc kind {kind!r}; one of {sorted(self.engines)}"
+            )
+        if kind == "decode" and session is not None:
+            with self._sessions_lock:
+                latents = self._sessions.get(session)
+            if latents is None:
+                raise AffinityLost(
+                    f"session {session!r} not resident on replica "
+                    f"{self.name!r} (encoded elsewhere, or lost to a restart)"
+                )
+            arrays = [latents, *arrays]
+        out = engine.submit(*arrays).result(timeout=timeout_s)
+        if kind == "encode" and session is not None:
+            with self._sessions_lock:
+                self._sessions[session] = out
+                while len(self._sessions) > _MAX_SESSIONS:
+                    self._sessions.popitem(last=False)
+                self._m_sessions.set(len(self._sessions))
+            # the latents stay HERE (that is the point of affinity); the
+            # caller gets the batch/latent geometry as its ack
+            return [np.asarray(np.asarray(out).shape, np.int64)]
+        return [np.asarray(leaf) for leaf in jax.tree.leaves(out)]
+
+    # -- rollout surface -----------------------------------------------------
+
+    def update_params(self, spec: Dict[str, Any]) -> int:
+        """Hot-swap from a params spec; returns the new version. The engines
+        keep their compiled programs (same treedef/avals ⇒ no recompile; the
+        AOT warm pool carries over), so a swap is params-preparation time,
+        not a compile family."""
+        kind = spec.get("kind")
+        with self._update_lock:
+            if kind == "rollback":
+                if self._prev_params is None:
+                    raise ValueError("nothing to roll back to")
+                tree = self._prev_params
+            elif kind == "scale":
+                factor = float(spec["factor"])
+                tree = _scale_tree(self._params, factor)
+            elif kind in ("reinit", "checkpoint"):
+                if self._params_factory is None:
+                    raise ValueError(
+                        f"this replica cannot realize {kind!r} specs "
+                        "(no params factory)"
+                    )
+                tree = self._params_factory(spec)
+            else:
+                raise ValueError(
+                    f"unknown params spec kind {kind!r}; one of "
+                    "rollback|scale|reinit|checkpoint"
+                )
+            for engine in self.engines.values():
+                engine.update_params(tree)
+            # the swap RPC answers only once every worker INSTALLED the
+            # staged tree (bounded: a worker wedged in a dispatch must not
+            # hang the admin surface) — the rollout's bake then watches the
+            # new tree from its first poll, never a half-swapped replica
+            deadline = time.monotonic() + 10.0
+            while (any(e.params_pending for e in self.engines.values())
+                   and time.monotonic() < deadline):
+                time.sleep(0.002)
+            self._prev_params, self._params = self._params, tree
+            self._m_version.inc()
+            version = int(self._m_version.value)
+        obs.event("replica_params_update", replica=self.name, kind=kind,
+                  version=version)
+        return version
+
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        timeout_s = self.drain_timeout_s if timeout_s is None else timeout_s
+        from perceiver_io_tpu.inference.engine import drain_engines
+
+        return drain_engines(self.engines.values(), timeout_s)
+
+    def resume(self) -> None:
+        for engine in self.engines.values():
+            engine.resume_admission()
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def ready(self) -> bool:
+        return self._assume_ready or all(
+            e.ready for e in self.engines.values()
+        )
+
+    def status(self) -> Dict[str, Any]:
+        """The scrape body the router's load/health view is built from."""
+        engines = {}
+        queue_depth = inflight = 0
+        breaker_open = False
+        slo_burn = 0.0
+        for key, e in self.engines.items():
+            backlog = e.backlog
+            queue_depth += backlog
+            inflight += e.inflight
+            b_open = e.breaker is not None and e.breaker.state == "open"
+            breaker_open = breaker_open or b_open
+            burn = (e.slo_tracker.burn_rate()
+                    if e.slo_tracker is not None
+                    and e.slo_tracker.sample_count()
+                    >= e.slo_tracker.slo.min_samples else 0.0)
+            slo_burn = max(slo_burn, burn)
+            engines[key] = {
+                "ready": e.ready, "draining": e.draining,
+                "backlog": backlog, "breaker_open": b_open,
+                "slo_burn": round(burn, 4),
+            }
+        with self._sessions_lock:
+            sessions = len(self._sessions)
+        return {
+            "name": self.name,
+            "ready": self.ready,
+            "requests_total": sum(
+                e.requests_served for e in self.engines.values()),
+            "draining": any(e.draining for e in self.engines.values()),
+            "queue_depth": queue_depth,
+            "inflight": inflight,
+            "breaker_open": breaker_open,
+            "slo_burn": round(slo_burn, 4),
+            "params_version": int(self._m_version.value),
+            "sessions": sessions,
+            "engines": engines,
+        }
+
+    def close(self) -> None:
+        for engine in self.engines.values():
+            engine.close()
+
+
+def _scale_tree(tree, factor: float):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(
+        lambda x: x * factor
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else x,
+        tree,
+    )
+
+
+# -- the HTTP surface --------------------------------------------------------
+
+
+class ReplicaServer:
+    """Loopback HTTP server over one :class:`ReplicaApp` (the replica-side
+    half of the RPC shim; ``HttpReplicaClient`` is the router-side half)."""
+
+    def __init__(self, app: ReplicaApp, host: str = "127.0.0.1",
+                 port: int = 0,
+                 registry: Optional[obs.MetricsRegistry] = None):
+        self.app = app
+        self._host = host
+        self._port = port
+        self._registry = registry if registry is not None else obs.get_registry()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def url(self) -> Optional[str]:
+        return f"http://{self._host}:{self.port}" if self._httpd else None
+
+    def start(self) -> str:
+        if self._httpd is not None:
+            return self.url
+        app, registry = self.app, self._registry
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"  # keep-alive: the router re-uses
+            # nothing (urllib opens per call) but 1.1 gives Content-Length
+            # framed bodies on both sides
+
+            def log_message(self, *args) -> None:
+                pass  # RPC traffic must not spam the replica's stderr
+
+            def _reply(self, code: int, body: bytes,
+                       ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _query(self) -> Dict[str, str]:
+                if "?" not in self.path:
+                    return {}
+                out = {}
+                for pair in self.path.split("?", 1)[1].split("&"):
+                    k, _, v = pair.partition("=")
+                    out[k] = v
+                return out
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n) if n else b""
+
+            def do_GET(self) -> None:
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    ok, detail = obs.healthz()
+                    self._reply(200 if ok else 503,
+                                json.dumps(detail).encode() + b"\n")
+                elif path == "/statz":
+                    ok, detail = obs.healthz()
+                    body = {"replica": app.status(), "health": detail,
+                            **registry.snapshot()}
+                    self._reply(200, json.dumps(body).encode() + b"\n")
+                else:
+                    self._reply(404, _error_body("not_found", path))
+
+            def do_POST(self) -> None:
+                path = self.path.split("?", 1)[0]
+                q = self._query()
+                try:
+                    if path.startswith("/rpc/"):
+                        kind = path[len("/rpc/"):]
+                        timeout_s = (float(q["timeout_s"])
+                                     if "timeout_s" in q else None)
+                        out = app.call(kind, unpack_arrays(self._body()),
+                                       session=q.get("session"),
+                                       timeout_s=timeout_s)
+                        self._reply(200, pack_arrays(out),
+                                    "application/octet-stream")
+                    elif path == "/admin/drain":
+                        timeout_s = (float(q["timeout_s"])
+                                     if "timeout_s" in q else None)
+                        drained = app.drain(timeout_s)
+                        self._reply(200, json.dumps(
+                            {"drained": drained}).encode())
+                    elif path == "/admin/resume":
+                        app.resume()
+                        self._reply(200, b"{}")
+                    elif path == "/admin/update_params":
+                        spec = json.loads(self._body().decode() or "{}")
+                        version = app.update_params(spec)
+                        self._reply(200, json.dumps(
+                            {"params_version": version}).encode())
+                    elif path == "/admin/quit":
+                        self._reply(200, b"{}")
+                        app.quit_event.set()
+                    else:
+                        self._reply(404, _error_body("not_found", path))
+                except BaseException as e:  # mirrored, never a stack trace
+                    self._reply(503, _wire_error(e))
+
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"{self.app.name}-rpc", daemon=True,
+        )
+        self._thread.start()
+        return self.url
+
+    def close(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+            if self._thread is not None:
+                self._thread.join(timeout=5)
+                self._thread = None
+
+
+# -- the router-side clients -------------------------------------------------
+
+
+class HttpReplicaClient:
+    """Router-side handle to one replica process. Transport failures (dead
+    replica, mid-request ``kill -9``) surface as ``ConnectionError`` with the
+    taxonomy's transient markers — the failover policy re-routes them."""
+
+    def __init__(self, name: str, base_url: str, timeout_s: float = 120.0):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def _request(self, method: str, path: str, body: Optional[bytes] = None,
+                 timeout_s: Optional[float] = None) -> bytes:
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.base_url + path, data=body, method=method,
+            headers={"Content-Type": "application/octet-stream"},
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout_s if timeout_s is not None
+                else self.timeout_s
+            ) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            raise_wire_error(e.read(), self.name)
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            reason = getattr(e, "reason", e)
+            raise ConnectionError(
+                f"replica {self.name!r}: connection closed / failed to "
+                f"connect ({type(reason).__name__}: {reason})"
+            ) from e
+
+    def call(self, kind: str, arrays: Sequence[np.ndarray],
+             session: Optional[str] = None,
+             timeout_s: Optional[float] = None) -> List[np.ndarray]:
+        q = []
+        if session is not None:
+            q.append(f"session={session}")
+        if timeout_s is not None:
+            q.append(f"timeout_s={timeout_s:g}")
+        path = f"/rpc/{kind}" + ("?" + "&".join(q) if q else "")
+        out = self._request("POST", path, pack_arrays(arrays),
+                            timeout_s=timeout_s)
+        return unpack_arrays(out)
+
+    def scrape(self, timeout_s: float = 5.0) -> Dict[str, Any]:
+        """The replica's ``/statz`` ``replica`` block, plus ``up``. Never
+        raises: an unreachable replica scrapes as ``{"up": False}``."""
+        try:
+            body = self._request("GET", "/statz", timeout_s=timeout_s)
+            status = json.loads(body.decode()).get("replica", {})
+            status["up"] = True
+            return status
+        except Exception as e:
+            return {"up": False, "error": f"{type(e).__name__}: {e}"}
+
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        q = f"?timeout_s={timeout_s:g}" if timeout_s is not None else ""
+        body = self._request(
+            "POST", "/admin/drain" + q,
+            timeout_s=(timeout_s + 10.0) if timeout_s is not None else None,
+        )
+        return bool(json.loads(body.decode()).get("drained"))
+
+    def resume(self) -> None:
+        self._request("POST", "/admin/resume")
+
+    def update_params(self, spec: Dict[str, Any],
+                      timeout_s: Optional[float] = None) -> int:
+        body = self._request("POST", "/admin/update_params",
+                             json.dumps(spec).encode(), timeout_s=timeout_s)
+        return int(json.loads(body.decode())["params_version"])
+
+    def quit(self) -> None:
+        try:
+            self._request("POST", "/admin/quit", timeout_s=5.0)
+        except Exception:
+            pass  # already gone is fine
+
+
+class LocalReplica:
+    """In-process twin of :class:`HttpReplicaClient` over a
+    :class:`ReplicaApp` — the tier-1/test/local-bench transport.
+
+    ``kill()`` simulates ``kill -9``: every subsequent (and in-flight) call
+    raises the dead-replica ``ConnectionError`` signature, the session store
+    is wiped (the latents died with the 'process'), and scrapes report
+    ``up=False`` — until ``revive()`` (the supervisor-restart analogue, which
+    also resets admission and reports not-ready until re-warmed)."""
+
+    def __init__(self, app: ReplicaApp):
+        self.app = app
+        self.name = app.name
+        self._dead = threading.Event()
+
+    def _check_dead(self) -> None:
+        if self._dead.is_set():
+            raise ConnectionError(
+                f"replica {self.name!r}: connection closed (replica killed)"
+            )
+
+    def call(self, kind: str, arrays: Sequence[np.ndarray],
+             session: Optional[str] = None,
+             timeout_s: Optional[float] = None) -> List[np.ndarray]:
+        self._check_dead()
+        out = self.app.call(kind, list(arrays), session=session,
+                            timeout_s=timeout_s)
+        # a kill LANDING mid-request: the work may have run, but the
+        # response never reached the router (at-most-once delivery is about
+        # responses, not executions)
+        self._check_dead()
+        return out
+
+    def scrape(self, timeout_s: float = 5.0) -> Dict[str, Any]:
+        if self._dead.is_set():
+            return {"up": False, "error": "replica killed"}
+        status = self.app.status()
+        status["up"] = True
+        return status
+
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        self._check_dead()
+        return self.app.drain(timeout_s)
+
+    def resume(self) -> None:
+        self._check_dead()
+        self.app.resume()
+
+    def update_params(self, spec: Dict[str, Any],
+                      timeout_s: Optional[float] = None) -> int:
+        self._check_dead()
+        return self.app.update_params(spec)
+
+    def quit(self) -> None:
+        self.app.quit_event.set()
+
+    def kill(self) -> None:
+        self._dead.set()
+        with self.app._sessions_lock:
+            self.app._sessions.clear()
+
+    def revive(self) -> None:
+        self.app.resume()
+        self._dead.clear()
+
+
+# -- the replica process entry point -----------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        description="one serving replica behind the router tier "
+                    "(perceiver_io_tpu.serving)")
+    parser.add_argument("--port", type=int, default=0,
+                        help="RPC port (0 = ephemeral; announced on stderr)")
+    parser.add_argument("--name", default="replica")
+    parser.add_argument("--cpu", action="store_true",
+                        help="pin the CPU backend before jax initializes")
+    src = parser.add_argument_group("model source")
+    src.add_argument("--preset", choices=("tiny", "flagship"), default=None,
+                     help="synthetic-init preset (tests/benches; no "
+                          "checkpoint needed)")
+    src.add_argument("--seed", type=int, default=0,
+                     help="preset mode: param init seed")
+    src.add_argument("--checkpoint", default=None,
+                     help="serve a train_mlm checkpoint dir instead")
+    src.add_argument("--tokenizer", default=None,
+                     help="tokenizer json (checkpoint mode)")
+    src.add_argument("--step", type=int, default=None)
+    eng = parser.add_argument_group("engine (mirrors cli/serve.py)")
+    eng.add_argument("--max_batch", type=int, default=8)
+    eng.add_argument("--max_delay_ms", type=float, default=0.0)
+    eng.add_argument("--dtype", choices=("float32", "bfloat16"),
+                     default="float32")
+    eng.add_argument("--quantize", choices=("none", "int8"), default="none")
+    eng.add_argument("--compile_cache", default=None)
+    eng.add_argument("--no_warmup", action="store_true")
+    eng.add_argument("--queue_limit", type=int, default=None)
+    eng.add_argument("--request_deadline_s", type=float, default=None)
+    eng.add_argument("--dispatch_retries", type=int, default=2)
+    eng.add_argument("--breaker_failures", type=int, default=0)
+    eng.add_argument("--breaker_cooldown_s", type=float, default=5.0)
+    eng.add_argument("--heartbeat_deadline_s", type=float, default=None)
+    eng.add_argument("--slo_p99_ms", type=float, default=None)
+    eng.add_argument("--slo_availability", type=float, default=0.999)
+    parser.add_argument("--drain_timeout_s", type=float, default=60.0,
+                        help="graceful-exit bound: SIGTERM/SIGINT stop "
+                             "admission and wait this long for accepted "
+                             "work before exiting")
+    return parser
+
+
+def _build_app(args):
+    """Returns ``(app, max_seq_len)`` for the warmup example."""
+    import jax
+
+    from perceiver_io_tpu.inference.engine import ServingEngine, mlm_apply_fns
+
+    if args.checkpoint:
+        if not args.tokenizer:
+            raise SystemExit("--checkpoint mode needs --tokenizer")
+        from perceiver_io_tpu.data.tokenizer import load_tokenizer
+        from perceiver_io_tpu.inference import load_mlm_checkpoint
+
+        tokenizer = load_tokenizer(args.tokenizer)
+        model, params, max_seq_len = load_mlm_checkpoint(
+            args.checkpoint, tokenizer, step=args.step,
+            dtype="bfloat16" if args.dtype == "bfloat16" else None,
+        )
+
+        def params_factory(spec):
+            if spec.get("kind") != "checkpoint":
+                raise ValueError(f"checkpoint replica got spec {spec!r}")
+            _, new_params, _ = load_mlm_checkpoint(
+                spec.get("path", args.checkpoint), tokenizer,
+                step=spec.get("step"),
+                dtype="bfloat16" if args.dtype == "bfloat16" else None,
+            )
+            return new_params
+    else:
+        from perceiver_io_tpu.models.presets import flagship_mlm, tiny_mlm
+
+        tiny = (args.preset or "tiny") == "tiny"
+        build = tiny_mlm if tiny else flagship_mlm
+        vocab = 503 if tiny else 10003
+        max_seq_len = 64 if tiny else 512
+        model = build(vocab_size=vocab, max_seq_len=max_seq_len)
+        ids0 = np.zeros((1, max_seq_len), np.int32)
+
+        def init_params(seed: int):
+            return model.init(
+                {"params": jax.random.key(seed),
+                 "masking": jax.random.key(seed + 1)},
+                ids0, ids0 == 0,
+            )["params"]
+
+        params = init_params(args.seed)
+
+        def params_factory(spec):
+            if spec.get("kind") != "reinit":
+                raise ValueError(f"preset replica got spec {spec!r}")
+            return init_params(int(spec.get("seed", 0)))
+
+    slo = None
+    if args.slo_p99_ms is not None:
+        slo = obs.SLO(latency_target_s=args.slo_p99_ms / 1e3,
+                      availability_target=args.slo_availability,
+                      name=args.name, burn_alert=None)
+    common = dict(
+        max_batch=args.max_batch,
+        max_delay_ms=args.max_delay_ms,
+        compute_dtype="bfloat16" if args.dtype == "bfloat16" else None,
+        quantize=None if args.quantize == "none" else args.quantize,
+        queue_limit=args.queue_limit,
+        request_deadline_s=args.request_deadline_s,
+        dispatch_retries=args.dispatch_retries,
+        breaker_failures=args.breaker_failures,
+        breaker_cooldown_s=args.breaker_cooldown_s,
+        heartbeat_deadline_s=args.heartbeat_deadline_s,
+        compile_cache=args.compile_cache,
+        slo=slo,
+    )
+    fns = mlm_apply_fns(model)
+    engines = {
+        kind: ServingEngine(fn, params, name=f"{args.name}-{kind}", **common)
+        for kind, fn in fns.items()
+    }
+    app = ReplicaApp(
+        engines, params, params_factory=params_factory, name=args.name,
+        assume_ready=args.no_warmup, drain_timeout_s=args.drain_timeout_s,
+    )
+    return app, max_seq_len
+
+
+def _warm(app: ReplicaApp, args, max_seq_len: int) -> None:
+    ids = np.zeros((1, max_seq_len), np.int32)
+    pad = np.zeros((1, max_seq_len), bool)
+    positions = np.zeros((1, 2), np.int32)
+    app.engines["infer"].warmup(ids, pad, positions, background=True)
+    app.engines["encode"].warmup(ids, pad, background=True)
+
+    def warm_decode():
+        # the decoder's warmup example needs one latent row
+        try:
+            latents = app.engines["encode"].predict(ids, pad)
+            app.engines["decode"].warmup(latents, positions, background=True)
+        except Exception as e:
+            print(f"replica: decoder warmup failed ({type(e).__name__}: {e})",
+                  file=sys.stderr)
+
+    threading.Thread(target=warm_decode, name="replica-warm-decode",
+                     daemon=True).start()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    args = build_parser().parse_args(argv)
+    if args.cpu:
+        from perceiver_io_tpu.utils.platform import ensure_cpu_only
+
+        ensure_cpu_only()
+
+    app, max_seq_len = _build_app(args)
+    server = ReplicaServer(app, port=args.port)
+    url = server.start()
+    print(f"replica {args.name!r}: listening on {url}", file=sys.stderr,
+          flush=True)
+    if not args.no_warmup:
+        _warm(app, args, max_seq_len)
+
+    import signal
+
+    def _on_signal(signum, frame):
+        # graceful drain: stop admitting, finish accepted work, exit 0 —
+        # the same contract cli/serve.py honors (a supervisor rotation must
+        # not drop the queue)
+        print(f"replica {args.name!r}: signal {signum} — draining",
+              file=sys.stderr, flush=True)
+        app.quit_event.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _on_signal)
+        except ValueError:
+            pass  # not the main thread (programmatic use)
+
+    try:
+        app.quit_event.wait()
+    finally:
+        app.drain(args.drain_timeout_s)
+        server.close()
+        app.close()
+        obs.configure_event_log(None)
+    print(f"replica {args.name!r}: drained and exiting", file=sys.stderr,
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

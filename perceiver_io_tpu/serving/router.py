@@ -1,0 +1,632 @@
+"""Health-aware, least-loaded request router over N serving replicas.
+
+The router is the fleet's front door: callers submit exactly as they would to
+one ``ServingEngine`` (``submit() -> future``), and the router decides WHICH
+replica serves each request from the live per-replica view its scrape loop
+maintains (``/statz`` → up / ready / queue depth / breaker / SLO burn):
+
+- **least-loaded dispatch**: among eligible replicas (up, warm-pool ready,
+  not draining, breaker closed), pick the one with the lowest
+  ``router-inflight + scraped-queue-depth`` score. A replica whose SLO burn
+  crosses ``burn_degrade`` is DEGRADED: routed around while any healthy
+  replica remains, used as a last resort rather than shedding.
+- **failover** (:class:`~perceiver_io_tpu.resilience.FailoverPolicy`): a
+  dead replica surfaces as a connection error, an overloaded one as a
+  rejection — both displace the request to the next-best replica, up to the
+  placement budget. Re-routing happens ONLY for requests with no received
+  response (at-most-once delivery); a delivered result is never re-placed.
+  Accepted work is therefore lost only when the policy exhausts every
+  replica — the zero-lost-accepted drill pins this under ``kill -9``.
+- **latent-cache affinity**: ``encode(session=...)`` pins the session to the
+  replica now holding its latents; ``decode(session=...)`` MUST go there
+  (the state does not exist elsewhere, so there is nothing to fail over to).
+  If the pinned replica died, the pin is dropped and the caller sees
+  :class:`~perceiver_io_tpu.resilience.AffinityLost` — re-encoding
+  establishes a fresh pin on a live replica (spill-on-death re-encode).
+- **graceful drain** (``drain_replica``): stop routing to a replica, have it
+  finish accepted work (``/admin/drain``), then optionally detach it — the
+  rotation primitive rollouts and scale-downs share.
+- **rolling rollout** (``rolling_update``): swap replicas one at a time via
+  their hot-swap surface (params spec; AOT warm pools carry over, so a swap
+  is preparation time, not a compile family), bake each swap against its
+  scraped SLO burn / breaker state, and on regression roll the whole fleet
+  back to the previous tree.
+
+Health composes fleet-aware (``obs.fleet``): one replica's trouble degrades
+that replica's label in ``/statz``/``healthz()`` detail; the router's own
+``/healthz`` 503s only when fewer than ``min_serving`` replicas can serve.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import perceiver_io_tpu.obs as obs
+from perceiver_io_tpu.obs import fleet as _fleet
+from perceiver_io_tpu.resilience import (
+    AffinityLost,
+    FailoverPolicy,
+    RejectedError,
+)
+
+
+class RouterClosed(RuntimeError):
+    """submit() after close()."""
+
+
+class RouterFuture:
+    """Result handle for one routed request: ``result(timeout)`` returns the
+    replica's output arrays (a single array when there is exactly one).
+    ``replica`` / ``attempts`` record where and how it was finally served."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+        self.replica: Optional[str] = None
+        self.attempts = 0
+        self.t_done: Optional[float] = None  # monotonic completion stamp
+        # (the open-loop load harness computes latency as t_done - t_submit
+        # without the collect-loop skew a post-result() clock read has)
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def _deliver(self, result) -> None:
+        self._result = result
+        self.t_done = time.monotonic()
+        self._event.set()
+
+    def _fail(self, error: BaseException) -> None:
+        self._error = error
+        self.t_done = time.monotonic()
+        self._event.set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("request not served within timeout")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _Slot:
+    """Router-side state for one replica."""
+
+    def __init__(self, client):
+        self.client = client
+        self.name = client.name
+        self.inflight = 0          # router-side, under Router._lock
+        self.draining = False      # router-side admission stop
+        self.scrape: Dict[str, Any] = {"up": True, "ready": False}
+        self.failures = 0          # consecutive call failures (suspicion)
+
+    def load(self) -> float:
+        return self.inflight + float(self.scrape.get("queue_depth", 0) or 0)
+
+
+class Router:
+    """Least-loaded, health-aware dispatch over replica clients (HTTP
+    process replicas and/or in-process :class:`LocalReplica`s — any object
+    with the ``call/scrape/drain/resume/update_params`` surface)."""
+
+    def __init__(
+        self,
+        replicas: Sequence = (),
+        policy: Optional[FailoverPolicy] = None,
+        name: str = "router",
+        registry: Optional[obs.MetricsRegistry] = None,
+        scrape_interval_s: float = 0.25,
+        max_workers: int = 32,
+        queue_limit: Optional[int] = None,
+        burn_degrade: Optional[float] = 2.0,
+        min_serving: int = 1,
+        request_timeout_s: float = 120.0,
+    ):
+        self.name = name
+        self.policy = policy if policy is not None else FailoverPolicy()
+        self.queue_limit = queue_limit
+        self.burn_degrade = burn_degrade
+        self.request_timeout_s = request_timeout_s
+        self._lock = threading.Lock()
+        self._slots: Dict[str, _Slot] = {}
+        self._pins: Dict[str, str] = {}  # session -> replica name
+        self._pending = 0  # requests admitted, not yet delivered/failed
+        self._closed = threading.Event()
+        reg = registry if registry is not None else obs.get_registry()
+        self.registry = reg
+        labels = {"router": name}
+        self._m_requests = reg.counter(
+            "router_requests_total", "requests admitted", labels)
+        self._m_completed = reg.counter(
+            "router_completed_total", "requests delivered", labels)
+        self._m_failed = reg.counter(
+            "router_failed_total", "requests failed after placement", labels)
+        self._m_shed = reg.counter(
+            "router_shed_total",
+            "requests refused at router admission (queue_limit/no replica)",
+            labels)
+        self._m_reroutes = reg.counter(
+            "router_reroutes_total",
+            "failover re-placements (a request moved to another replica)",
+            labels)
+        self._m_spills = reg.counter(
+            "router_affinity_spills_total",
+            "sessions whose pinned replica died (caller re-encodes)", labels)
+        self._m_latency = reg.histogram(
+            "router_latency_seconds", "submit → result via the router",
+            labels)
+        self._gauges = _fleet.ReplicaGauges(fleet=name, registry=reg)
+        self.fleet_health = _fleet.FleetHealth(
+            self.statuses, name=name, min_serving=min_serving)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix=f"{name}-dispatch")
+        for client in replicas:
+            self.add_replica(client)
+        self._scrape_interval_s = scrape_interval_s
+        self._scraper = threading.Thread(
+            target=self._scrape_loop, name=f"{name}-scrape", daemon=True)
+        self._scraper.start()
+
+    # -- fleet membership ----------------------------------------------------
+
+    def add_replica(self, client, health_sources: Sequence = ()) -> None:
+        """Admit a replica. ``health_sources`` re-scopes process-global
+        health contributors (an in-process replica's breakers/SLO trackers)
+        under the fleet aggregate — one replica's open breaker must degrade
+        its label, not 503 the router (obs.fleet.adopt_source)."""
+        slot = _Slot(client)
+        slot.scrape = self._safe_scrape(client)
+        with self._lock:
+            self._slots[client.name] = slot
+        for src in health_sources:
+            self.fleet_health.adopt_source(client.name, src)
+        obs.event("router_replica_added", router=self.name,
+                  replica=client.name)
+
+    def remove_replica(self, name: str) -> None:
+        with self._lock:
+            slot = self._slots.pop(name, None)
+            dead_pins = [s for s, r in self._pins.items() if r == name]
+            for s in dead_pins:
+                del self._pins[s]
+        self.fleet_health.release_sources(name)
+        if slot is not None:
+            obs.event("router_replica_removed", router=self.name,
+                      replica=name)
+
+    def replicas(self) -> List[str]:
+        with self._lock:
+            return list(self._slots)
+
+    # -- scraping / health view ----------------------------------------------
+
+    @staticmethod
+    def _safe_scrape(client) -> Dict[str, Any]:
+        try:
+            return client.scrape()
+        except Exception as e:  # a scrape NEVER takes the router down
+            return {"up": False, "error": f"{type(e).__name__}: {e}"}
+
+    def refresh(self) -> None:
+        """One synchronous scrape sweep (the loop's body; tests and the
+        rollout bake call it directly for a current view)."""
+        with self._lock:
+            slots = list(self._slots.values())
+        serving = 0
+        for slot in slots:
+            slot.scrape = self._safe_scrape(slot.client)
+            state = self._state(slot)
+            if state == _fleet.SERVING:
+                serving += 1
+            s = slot.scrape
+            self._gauges.publish(
+                slot.name,
+                up=1.0 if s.get("up") else 0.0,
+                ready=1.0 if s.get("ready") else 0.0,
+                queue_depth=float(s.get("queue_depth", 0) or 0),
+                inflight=float(slot.inflight),
+                breaker_open=1.0 if s.get("breaker_open") else 0.0,
+                slo_burn=float(s.get("slo_burn", 0.0) or 0.0),
+            )
+        self._gauges.publish_fleet(size=len(slots), serving=serving)
+
+    def _scrape_loop(self) -> None:
+        while not self._closed.wait(self._scrape_interval_s):
+            self.refresh()
+
+    def _state(self, slot: _Slot) -> str:
+        s = slot.scrape
+        if not s.get("up"):
+            return _fleet.DOWN
+        if slot.draining or s.get("draining"):
+            return _fleet.DRAINING
+        if not s.get("ready"):
+            return _fleet.JOINING
+        if s.get("breaker_open"):
+            return _fleet.DEGRADED
+        if (self.burn_degrade is not None
+                and float(s.get("slo_burn", 0.0) or 0.0) > self.burn_degrade):
+            return _fleet.DEGRADED
+        return _fleet.SERVING
+
+    def statuses(self) -> Dict[str, Dict[str, Any]]:
+        """Per-replica view for ``obs.FleetHealth`` / ``/statz``."""
+        with self._lock:
+            slots = list(self._slots.values())
+        out = {}
+        for slot in slots:
+            s = slot.scrape
+            out[slot.name] = {
+                "state": self._state(slot),
+                "router_inflight": slot.inflight,
+                "queue_depth": s.get("queue_depth", 0),
+                "slo_burn": s.get("slo_burn", 0.0),
+                "breaker_open": bool(s.get("breaker_open")),
+                "params_version": s.get("params_version", 0),
+            }
+        return out
+
+    # -- placement -----------------------------------------------------------
+
+    def _pick(self, exclude: set, session: Optional[str] = None) -> _Slot:
+        """Least-loaded eligible replica; degraded replicas only as a last
+        resort; raises when nothing can take the work."""
+        with self._lock:
+            if session is not None and session in self._pins:
+                pinned = self._pins[session]
+                slot = self._slots.get(pinned)
+                if (slot is None or slot.name in exclude
+                        or self._state(slot) in (_fleet.DOWN,
+                                                 _fleet.DRAINING)):
+                    # the pin is dead: drop it — the caller re-encodes on
+                    # whatever the next encode pins (spill-on-death)
+                    self._pins.pop(session, None)
+                    self._m_spills.inc()
+                    raise AffinityLost(
+                        f"session {session!r}: pinned replica "
+                        f"{pinned!r} is gone — re-encode to re-pin"
+                    )
+                return slot
+            candidates = [s for s in self._slots.values()
+                          if s.name not in exclude]
+        serving = [s for s in candidates
+                   if self._state(s) == _fleet.SERVING]
+        pool = serving or [s for s in candidates
+                           if self._state(s) == _fleet.DEGRADED]
+        if not pool:
+            raise RejectedError(
+                f"router {self.name!r}: no replica available "
+                f"({len(candidates)} known, none serving)"
+            )
+        return min(pool, key=_Slot.load)
+
+    def _note_inflight(self, slot: _Slot, delta: int) -> None:
+        with self._lock:
+            slot.inflight += delta
+
+    def _run(self, fut: RouterFuture, kind: str,
+             arrays: List[np.ndarray], session: Optional[str],
+             pin_on_success: bool, deadline: Optional[float]) -> None:
+        tried: set = set()
+        attempt = 0
+        try:
+            while True:
+                attempt += 1
+                fut.attempts = attempt
+                slot = self._pick(tried, session=session)
+                timeout_s = self.request_timeout_s
+                if deadline is not None:
+                    timeout_s = min(timeout_s, deadline - time.monotonic())
+                    if timeout_s <= 0:
+                        from perceiver_io_tpu.resilience import (
+                            DeadlineExceeded,
+                        )
+
+                        raise DeadlineExceeded(
+                            "router deadline expired before placement"
+                        )
+                self._note_inflight(slot, 1)
+                try:
+                    out = slot.client.call(
+                        kind, arrays, session=session, timeout_s=timeout_s)
+                except BaseException as e:
+                    slot.failures += 1
+                    obs.event("router_request_failed", router=self.name,
+                              replica=slot.name, kind=kind,
+                              error=type(e).__name__, attempt=attempt)
+                    if ((session is None or pin_on_success)
+                            and self.policy.should_reroute(e, attempt)):
+                        # NO response was received — re-placing cannot
+                        # duplicate a delivered result. A pinned DECODE
+                        # never re-routes (the state lives on one replica);
+                        # an ENCODE may (its pin is set only on success, so
+                        # re-placing establishes the session elsewhere).
+                        tried.add(slot.name)
+                        self._m_reroutes.inc()
+                        pause = self.policy.backoff.backoff_s(attempt)
+                        if pause > 0:
+                            time.sleep(pause)
+                        continue
+                    if session is not None and isinstance(
+                            e, (ConnectionError, OSError)) and not pin_on_success:
+                        # a pinned decode hit a dying replica mid-request:
+                        # same spill semantics as a dead pin at placement
+                        with self._lock:
+                            self._pins.pop(session, None)
+                        self._m_spills.inc()
+                        raise AffinityLost(
+                            f"session {session!r}: replica {slot.name!r} "
+                            f"died mid-request — re-encode to re-pin"
+                        ) from e
+                    raise
+                finally:
+                    self._note_inflight(slot, -1)
+                slot.failures = 0
+                if pin_on_success and session is not None:
+                    with self._lock:
+                        self._pins[session] = slot.name
+                fut.replica = slot.name
+                fut._deliver(out[0] if len(out) == 1 else out)
+                self._m_completed.inc()
+                return
+        except BaseException as e:
+            self._m_failed.inc()
+            fut._fail(e)
+        finally:
+            with self._lock:
+                self._pending -= 1
+
+    def submit(self, *arrays, kind: str = "infer",
+               session: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> RouterFuture:
+        """Route one request; returns a :class:`RouterFuture`.
+
+        ``kind`` names the replica RPC verb (``infer``/``encode``/
+        ``decode``). ``session`` engages affinity: an ``encode`` pins the
+        session to the replica that served it, a ``decode`` must follow the
+        pin. ``deadline_s`` bounds the whole routed lifetime (placement +
+        failover + service)."""
+        if self._closed.is_set():
+            raise RouterClosed(f"submit() on closed router {self.name!r}")
+        with self._lock:
+            if (self.queue_limit is not None
+                    and self._pending >= self.queue_limit):
+                pending = self._pending
+                admitted = False
+            else:
+                self._pending += 1
+                admitted = True
+        if not admitted:
+            self._m_shed.inc()
+            raise RejectedError(
+                f"router {self.name!r}: {pending} requests pending "
+                f"(limit {self.queue_limit}) — request shed"
+            )
+        self._m_requests.inc()
+        fut = RouterFuture()
+        t0 = time.monotonic()
+        deadline = None if deadline_s is None else t0 + deadline_s
+        arrays = [np.asarray(a) for a in arrays]
+        pin = kind == "encode" and session is not None
+
+        def run_and_time():
+            self._run(fut, kind, arrays, session, pin, deadline)
+            if fut._error is None:
+                self._m_latency.observe(time.monotonic() - t0)
+
+        self._pool.submit(run_and_time)
+        return fut
+
+    def predict(self, *arrays, kind: str = "infer",
+                session: Optional[str] = None,
+                timeout: Optional[float] = None):
+        return self.submit(*arrays, kind=kind, session=session).result(
+            timeout=timeout)
+
+    # -- latent-cache affinity helpers ---------------------------------------
+
+    def encode(self, *arrays, session: str,
+               timeout: Optional[float] = None):
+        """Encode-once: runs the encoder on the least-loaded replica and pins
+        ``session`` there (the latents stay resident on that replica)."""
+        return self.predict(*arrays, kind="encode", session=session,
+                            timeout=timeout)
+
+    def decode(self, *arrays, session: str,
+               timeout: Optional[float] = None):
+        """Decode-many against a pinned session; raises
+        :class:`AffinityLost` when the pinned replica (and the latents)
+        died — the caller re-``encode()``s, which re-pins."""
+        return self.predict(*arrays, kind="decode", session=session,
+                            timeout=timeout)
+
+    def pinned(self, session: str) -> Optional[str]:
+        with self._lock:
+            return self._pins.get(session)
+
+    # -- drain / rollout -----------------------------------------------------
+
+    def drain_replica(self, name: str, timeout_s: Optional[float] = None,
+                      detach: bool = False) -> bool:
+        """Stop routing to ``name``, have it finish accepted work, and
+        optionally detach it from the fleet. Returns True when the replica
+        reported fully drained."""
+        with self._lock:
+            slot = self._slots.get(name)
+        if slot is None:
+            raise KeyError(f"unknown replica {name!r}")
+        slot.draining = True
+        obs.event("router_drain_begin", router=self.name, replica=name)
+        try:
+            drained = slot.client.drain(timeout_s)
+        except Exception as e:
+            obs.event("router_drain_failed", router=self.name, replica=name,
+                      error=type(e).__name__)
+            drained = False
+        if detach:
+            self.remove_replica(name)
+        obs.event("router_drained", router=self.name, replica=name,
+                  drained=drained, detached=detach)
+        return drained
+
+    def resume_replica(self, name: str) -> None:
+        with self._lock:
+            slot = self._slots.get(name)
+        if slot is None:
+            raise KeyError(f"unknown replica {name!r}")
+        slot.client.resume()
+        slot.draining = False
+
+    def rolling_update(
+        self,
+        spec: Dict[str, Any],
+        bake_s: float = 1.0,
+        burn_threshold: float = 2.0,
+        poll_s: float = 0.05,
+        min_bake_requests: int = 0,
+        update_timeout_s: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Roll ``spec`` across the fleet one replica at a time, auto-rolling
+        the WHOLE fleet back on regression.
+
+        Per replica: hot-swap (``update_params`` — traffic keeps flowing and
+        queues against whichever complete tree is installed; the compiled
+        programs and AOT warm pool carry over), then BAKE: watch the
+        replica's scraped SLO burn and breaker state for ``bake_s``. A
+        post-swap burn above ``burn_threshold``, an opened breaker, or the
+        replica going down/unready counts as a regression → every replica
+        updated so far (including this one, if reachable) gets
+        ``{"kind": "rollback"}`` and the rollout aborts.
+
+        ``min_bake_requests``: when > 0, the bake window additionally waits
+        (within ``bake_s``) until the replica has served that many requests
+        since the swap — a bake with no traffic proves nothing.
+        """
+        report: Dict[str, Any] = {
+            "spec": spec, "updated": [], "rolled_back": False,
+            "regressed": None, "reason": None,
+        }
+        for name in self.replicas():
+            with self._lock:
+                slot = self._slots.get(name)
+            if slot is None:
+                continue  # removed mid-rollout
+            if self._state(slot) == _fleet.DOWN:
+                report.setdefault("skipped", []).append(name)
+                continue
+            try:
+                version = slot.client.update_params(
+                    spec, timeout_s=update_timeout_s)
+            except Exception as e:
+                report.update(rolled_back=True, regressed=name,
+                              reason=f"update failed: {type(e).__name__}: {e}")
+                self._rollback(report["updated"])
+                return report
+            obs.event("router_rollout_swapped", router=self.name,
+                      replica=name, version=version)
+            report["updated"].append(name)
+            reason = self._bake(slot, bake_s, burn_threshold, poll_s,
+                                min_bake_requests)
+            if reason is not None:
+                report.update(rolled_back=True, regressed=name,
+                              reason=reason)
+                self._rollback(report["updated"])
+                return report
+        obs.event("router_rollout_complete", router=self.name,
+                  replicas=report["updated"])
+        return report
+
+    def _bake(self, slot: _Slot, bake_s: float, burn_threshold: float,
+              poll_s: float, min_requests: int) -> Optional[str]:
+        """Watch one freshly-swapped replica; returns a regression reason or
+        None (healthy bake). With ``min_requests`` > 0 the window extends
+        (up to 4x ``bake_s``) until the replica actually served that much
+        post-swap traffic — a bake with no traffic proves nothing."""
+        t0 = time.monotonic()
+        base = None
+        while True:
+            s = self._safe_scrape(slot.client)
+            slot.scrape = s
+            if not s.get("up"):
+                return "replica went down post-swap"
+            if s.get("breaker_open"):
+                return "breaker opened post-swap"
+            burn = float(s.get("slo_burn", 0.0) or 0.0)
+            if burn > burn_threshold:
+                return (f"SLO burn {burn:.2f} exceeded threshold "
+                        f"{burn_threshold:g} post-swap")
+            if base is None:
+                base = s.get("requests_total")
+            now = time.monotonic()
+            if now - t0 >= bake_s:
+                served = (None if base is None
+                          or s.get("requests_total") is None
+                          else s["requests_total"] - base)
+                if (min_requests <= 0 or served is None
+                        or served >= min_requests
+                        or now - t0 >= 4 * bake_s):
+                    return None
+            time.sleep(poll_s)
+
+    def _rollback(self, names: List[str]) -> None:
+        for name in names:
+            with self._lock:
+                slot = self._slots.get(name)
+            if slot is None:
+                continue
+            try:
+                slot.client.update_params({"kind": "rollback"})
+                obs.event("router_rollout_rolled_back", router=self.name,
+                          replica=name)
+            except Exception as e:
+                obs.event("router_rollback_failed", router=self.name,
+                          replica=name, error=type(e).__name__)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Drain the whole fleet under ONE shared deadline (``timeout_s``
+        bounds the fleet, not each replica — a wedged replica cannot
+        multiply the caller's shutdown wait by N). Router admission stays
+        open per replica drain semantics — callers stop submitting; used by
+        shutdown."""
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        ok = True
+        for name in self.replicas():
+            left = (None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+            ok = self.drain_replica(name, timeout_s=left) and ok
+        return ok
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            pending = self._pending
+        return {
+            "pending": pending,
+            "requests": self._m_requests.value,
+            "completed": self._m_completed.value,
+            "failed": self._m_failed.value,
+            "shed": self._m_shed.value,
+            "reroutes": self._m_reroutes.value,
+            "affinity_spills": self._m_spills.value,
+            "replicas": self.statuses(),
+        }
+
+    def close(self) -> None:
+        self._closed.set()
+        self._scraper.join(timeout=5)
+        self._pool.shutdown(wait=True, cancel_futures=True)
+        self.fleet_health.close()
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,288 @@
+"""Replica process supervision: spawn, babysit, restart-with-backoff.
+
+The supervisor owns the PROCESS half of the fleet story (the router owns the
+TRAFFIC half): it spawns N replica processes (``serving.replica`` CLI),
+watches them, and restarts any that die — with capped exponential backoff
+(:class:`~perceiver_io_tpu.resilience.RetryPolicy`), on the same port (so
+the router's client handle stays valid across a restart), never more than
+``max_restarts`` times per replica (a crash-looping replica is detached, not
+hammered).
+
+A restarted replica REJOINS only after its warm pool is live: the router's
+scrape loop sees it as JOINING (``ready=False``) until every engine's
+``engine_ready`` gauge flips — the restart is invisible to traffic beyond
+the failover blip, which is the whole point.
+
+Child-process hygiene reuses the r4 ``--spawn_hosts`` wiring lessons
+(``cli/common.py``): children write to LOG FILES, never undrained pipes (a
+chatty child deadlocks a pipe at ~64KB); the CPU backend is pinned via the
+child's env; SIGTERM gives a child its graceful drain (the replica CLI's
+signal handler) before SIGKILL.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import perceiver_io_tpu.obs as obs
+from perceiver_io_tpu.resilience import RetryPolicy
+from perceiver_io_tpu.serving.replica import HttpReplicaClient
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def default_replica_argv(name: str, port: int,
+                         extra: Sequence[str] = ()) -> List[str]:
+    """The standard child command: ``python -m
+    perceiver_io_tpu.serving.replica --port P --name NAME [extra...]``."""
+    return [sys.executable, "-m", "perceiver_io_tpu.serving.replica",
+            "--port", str(port), "--name", name, *extra]
+
+
+class _Replica:
+    def __init__(self, name: str, port: int):
+        self.name = name
+        self.port = port
+        self.proc: Optional[subprocess.Popen] = None
+        self.log = None
+        self.restarts = 0
+        self.restart_at: Optional[float] = None  # backoff gate
+        self.failed = False  # crash-looped past max_restarts
+
+
+class ReplicaSupervisor:
+    """Spawn and babysit ``count`` replica processes.
+
+    ``argv_builder(name, port) -> argv`` builds each child's full command
+    (default: the ``serving.replica`` CLI via :func:`default_replica_argv`
+    with ``extra_args``). ``cpu=True`` pins ``JAX_PLATFORMS=cpu`` in the
+    children (the offline fleet; on a real TPU the one local chip cannot
+    host N replicas anyway — multi-chip fleets run one replica per chip via
+    explicit ``argv_builder`` device selection).
+    """
+
+    def __init__(
+        self,
+        count: int = 3,
+        extra_args: Sequence[str] = (),
+        argv_builder: Optional[Callable[[str, int], List[str]]] = None,
+        base_name: str = "r",
+        cpu: bool = True,
+        restart_policy: Optional[RetryPolicy] = None,
+        max_restarts: int = 5,
+        poll_s: float = 0.2,
+        log_dir: Optional[str] = None,
+        registry: Optional[obs.MetricsRegistry] = None,
+    ):
+        if count < 1:
+            raise ValueError(f"count must be >= 1, got {count}")
+        self.count = count
+        self._argv_builder = argv_builder or (
+            lambda name, port: default_replica_argv(
+                name, port, extra=extra_args)
+        )
+        self._cpu = cpu
+        self._policy = restart_policy or RetryPolicy(
+            max_retries=max_restarts, base_s=0.25, max_s=5.0)
+        self.max_restarts = max_restarts
+        self._poll_s = poll_s
+        self._log_dir = log_dir
+        self._replicas: Dict[str, _Replica] = {
+            f"{base_name}{i}": _Replica(f"{base_name}{i}", _free_port())
+            for i in range(count)
+        }
+        self._clients: Dict[str, HttpReplicaClient] = {
+            name: HttpReplicaClient(
+                name, f"http://127.0.0.1:{rep.port}")
+            for name, rep in self._replicas.items()
+        }
+        reg = registry if registry is not None else obs.get_registry()
+        self._m_restarts = {
+            name: reg.counter(
+                "fleet_replica_restarts_total",
+                "unexpected replica exits the supervisor restarted",
+                {"replica": name})
+            for name in self._replicas
+        }
+        self._stopping = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _env(self) -> Dict[str, str]:
+        env = dict(os.environ)
+        if self._cpu:
+            env["JAX_PLATFORMS"] = "cpu"
+        # children must resolve the package even when the parent imported it
+        # from a path not on the default sys.path (cli/common.py pattern)
+        import perceiver_io_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(perceiver_io_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        return env
+
+    def _spawn(self, rep: _Replica) -> None:
+        if rep.log is None:
+            if self._log_dir is not None:
+                os.makedirs(self._log_dir, exist_ok=True)
+                rep.log = open(
+                    os.path.join(self._log_dir, f"{rep.name}.log"), "a")
+            else:
+                rep.log = tempfile.NamedTemporaryFile(
+                    mode="w+", prefix=f"replica_{rep.name}_", suffix=".log",
+                    delete=False)
+        argv = self._argv_builder(rep.name, rep.port)
+        # log FILES, never undrained pipes (cli/common.py: a child that
+        # emits ~64KB into a pipe nobody reads deadlocks)
+        rep.proc = subprocess.Popen(
+            argv, env=self._env(), stdout=rep.log,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        obs.event("replica_spawned", replica=rep.name, port=rep.port,
+                  pid=rep.proc.pid, restarts=rep.restarts)
+
+    def start(self) -> List[HttpReplicaClient]:
+        """Spawn the fleet and start the babysitter; returns the clients
+        (hand them to a :class:`Router`). Does NOT wait for readiness —
+        ``wait_ready()`` does, or let the router's JOINING state gate."""
+        for rep in self._replicas.values():
+            self._spawn(rep)
+        self._monitor = threading.Thread(
+            target=self._watch, name="replica-supervisor", daemon=True)
+        self._monitor.start()
+        return list(self._clients.values())
+
+    def clients(self) -> List[HttpReplicaClient]:
+        return list(self._clients.values())
+
+    def client(self, name: str) -> HttpReplicaClient:
+        return self._clients[name]
+
+    def wait_ready(self, timeout_s: float = 180.0,
+                   names: Optional[Sequence[str]] = None) -> None:
+        """Block until every (named) replica scrapes ready — the AOT warm
+        pool is live and traffic can flow without a compile wall."""
+        deadline = time.monotonic() + timeout_s
+        waiting = list(names if names is not None else self._clients)
+        while waiting:
+            waiting = [
+                n for n in waiting
+                if not self._clients[n].scrape(timeout_s=2.0).get("ready")
+            ]
+            if not waiting:
+                return
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"replicas not ready within {timeout_s:g}s: {waiting}"
+                )
+            time.sleep(self._poll_s)
+
+    # -- the babysitter ------------------------------------------------------
+
+    def _watch(self) -> None:
+        while not self._stopping.wait(self._poll_s):
+            for rep in self._replicas.values():
+                if rep.proc is None or rep.failed:
+                    continue
+                rc = rep.proc.poll()
+                if rc is None:
+                    continue
+                now = time.monotonic()
+                if rep.restart_at is None:
+                    rep.restarts += 1
+                    self._m_restarts[rep.name].inc()
+                    if rep.restarts > self.max_restarts:
+                        rep.failed = True
+                        obs.event("replica_crash_looped", replica=rep.name,
+                                  rc=rc, restarts=rep.restarts)
+                        print(
+                            f"[supervisor] replica {rep.name!r} crash-looped "
+                            f"({rep.restarts} restarts) — detaching",
+                            file=sys.stderr,
+                        )
+                        continue
+                    pause = self._policy.backoff_s(rep.restarts)
+                    rep.restart_at = now + pause
+                    obs.event("replica_exited", replica=rep.name, rc=rc,
+                              restart_in_s=round(pause, 3),
+                              restarts=rep.restarts)
+                if now >= rep.restart_at:
+                    rep.restart_at = None
+                    self._spawn(rep)
+
+    def note_stable(self, name: str) -> None:
+        """Reset a replica's restart budget after proven stability (callers
+        decide what 'stable' means — e.g. N minutes serving)."""
+        self._replicas[name].restarts = 0
+
+    # -- chaos / teardown ----------------------------------------------------
+
+    def kill(self, name: str, sig: int = signal.SIGKILL) -> int:
+        """Send ``sig`` to a replica (the chaos drill's ``kill -9``); returns
+        the pid. The babysitter restarts it with backoff."""
+        rep = self._replicas[name]
+        if rep.proc is None or rep.proc.poll() is not None:
+            raise RuntimeError(f"replica {name!r} is not running")
+        pid = rep.proc.pid
+        os.kill(pid, sig)
+        obs.event("replica_killed", replica=name, pid=pid, sig=int(sig))
+        return pid
+
+    def pid(self, name: str) -> Optional[int]:
+        rep = self._replicas[name]
+        return rep.proc.pid if rep.proc is not None else None
+
+    def restarts(self, name: str) -> int:
+        return self._replicas[name].restarts
+
+    def stop(self, timeout_s: float = 20.0) -> None:
+        """Graceful fleet shutdown: quit RPC → SIGTERM (drain) → SIGKILL."""
+        self._stopping.set()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5)
+        for name, rep in self._replicas.items():
+            if rep.proc is None or rep.proc.poll() is not None:
+                continue
+            self._clients[name].quit()
+        deadline = time.monotonic() + timeout_s
+        for rep in self._replicas.values():
+            if rep.proc is None:
+                continue
+            left = max(0.1, deadline - time.monotonic())
+            try:
+                rep.proc.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                rep.proc.terminate()
+                try:
+                    rep.proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    rep.proc.kill()
+                    rep.proc.wait(timeout=5)
+        for rep in self._replicas.values():
+            if rep.log is not None:
+                rep.log.close()
+
+    def log_path(self, name: str) -> Optional[str]:
+        rep = self._replicas[name]
+        return rep.log.name if rep.log is not None else None
+
+    def __enter__(self) -> "ReplicaSupervisor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -29,11 +29,25 @@ discipline because the per-phase DEVICE number can be cross-checked against
 the lower-quartile trace statistic while queue/admission phases are
 host-side and tunnel-insensitive.
 
+``--replicas N`` runs the same sweep through the multi-replica fabric
+(``perceiver_io_tpu.serving``): a router over N replicas —
+``--replica_mode inprocess`` (default; N engines behind ``LocalReplica``
+shims, fast) or ``process`` (real supervised replica processes, the
+acceptance-drill configuration). ``--kill_replica_at FRAC`` is the chaos
+drill: at FRAC of sweep point ``--kill_point``'s offered window one replica
+dies (``kill -9`` in process mode; the supervisor restarts it and it
+rejoins once warm), and the record's ``fleet`` block carries the drill's
+verdict — ``lost_accepted`` MUST be 0 (accepted requests re-route, never
+drop). The per-request phase attribution is engine-side and does not cross
+the RPC boundary, so fleet points carry end-to-end latency with empty
+phase breakdowns.
+
 Usage::
 
     timeout 1800 python tools/load_bench.py --cpu [--arrival poisson|bursty]
         [--duration_s 4] [--rate_factors 0.25,0.5,1.0,1.5,2.5]
         [--rates RPS,RPS,...] [--queue_limit 64] [--slo_p99_ms MS]
+        [--replicas 3 [--replica_mode process] [--kill_replica_at 0.5]]
 """
 
 from __future__ import annotations
@@ -42,6 +56,7 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional
@@ -61,6 +76,10 @@ POINT_KEYS = (
 # backend-free: importing the engine module pulls jax
 PHASE_KEYS = ("admission", "queue", "assembly", "dispatch", "device",
               "complete")
+# the fleet block of a --replicas run (null for single-engine sweeps);
+# lost_accepted is the chaos drill's verdict and must be 0
+FLEET_KEYS = ("replicas", "mode", "killed", "kill_at_frac", "kill_point",
+              "reroutes", "affinity_spills", "lost_accepted", "restarts")
 
 
 def _pct(values: List[float], q: float) -> Optional[float]:
@@ -89,7 +108,20 @@ def _build_requests(max_seq_len: int, vocab: int, n: int, seed: int):
     return reqs
 
 
-def _calibrate(engine, reqs, waves: int, wave_size: int):
+def _fut_latencies(fut, t_submit: float):
+    """(end-to-end latencies, phase records) for one completed future —
+    engine futures carry per-part phase attribution; router futures carry a
+    completion stamp (phases stay replica-side)."""
+    recs = getattr(fut, "phases", None) or []
+    if recs:
+        return [sum(r.values()) for r in recs], recs
+    t_done = getattr(fut, "t_done", None)
+    if t_done is not None:
+        return [t_done - t_submit], []
+    return [], []
+
+
+def _calibrate(submit, reqs, waves: int, wave_size: int):
     """Closed-loop capacity estimate: submit ``wave_size`` requests, wait for
     all, repeat — the engine batches each wave, so the measured rate is the
     batched service capacity the open-loop sweep should straddle. Also
@@ -98,14 +130,14 @@ def _calibrate(engine, reqs, waves: int, wave_size: int):
     rates, lats = [], []
     for w in range(waves):
         t0 = time.monotonic()
-        futs = [engine.submit(*reqs[i % len(reqs)]) for i in range(wave_size)]
-        for f in futs:
+        futs = [(submit(reqs[i % len(reqs)]), time.monotonic())
+                for i in range(wave_size)]
+        for f, _ in futs:
             f.result(timeout=300)
         dt = time.monotonic() - t0
         rates.append(wave_size / dt)
-        for f in futs:
-            for rec in f.phases:
-                lats.append(sum(rec.values()))
+        for f, ts in futs:
+            lats.extend(_fut_latencies(f, ts)[0])
     rates.sort()
     lat = _pct(lats, 0.5)
     return rates[len(rates) // 2], lat if lat is not None else 0.01
@@ -126,8 +158,9 @@ def _arrival_gaps(arrival: str, rate: float, duration: float, burst: int,
     return times
 
 
-def _run_point(engine, reqs, rate: float, duration: float, arrival: str,
-               burst: int, rng, drain_timeout_s: float) -> Dict:
+def _run_point(submit, breaker_state, reqs, rate: float, duration: float,
+               arrival: str, burst: int, rng, drain_timeout_s: float,
+               on_frac=None) -> Dict:
     from perceiver_io_tpu.resilience import (
         BreakerOpen,
         DeadlineExceeded,
@@ -138,20 +171,26 @@ def _run_point(engine, reqs, rate: float, duration: float, arrival: str,
     t0 = time.monotonic()
     futures = []
     shed = 0
+    fired = on_frac is None
     for i, at in enumerate(arrivals):
+        if not fired and at / duration >= on_frac[0]:
+            fired = True
+            on_frac[1]()  # the chaos hook (kill a replica mid-window)
         delay = t0 + at - time.monotonic()
         if delay > 0:
             time.sleep(delay)
         try:
-            futures.append(engine.submit(*reqs[i % len(reqs)]))
+            futures.append((submit(reqs[i % len(reqs)]), time.monotonic()))
         except (RejectedError, DeadlineExceeded, BreakerOpen):
             shed += 1  # open loop: an arrival the engine refuses is SHED
+    if not fired:
+        on_frac[1]()  # a sparse schedule may end before FRAC: fire late
     submitted = len(arrivals)
 
     completed = failed = 0
     lats: List[float] = []
     phases: Dict[str, List[float]] = defaultdict(list)
-    for fut in futures:
+    for fut, ts in futures:
         try:
             fut.result(timeout=drain_timeout_s)
         except (RejectedError, DeadlineExceeded):
@@ -161,8 +200,9 @@ def _run_point(engine, reqs, rate: float, duration: float, arrival: str,
             failed += 1
             continue
         completed += 1
-        for rec in fut.phases:
-            lats.append(sum(rec.values()))
+        fut_lats, recs = _fut_latencies(fut, ts)
+        lats.extend(fut_lats)
+        for rec in recs:
             for k, v in rec.items():
                 phases[k].append(v)
     elapsed = time.monotonic() - t0  # offered window + drain: under
@@ -180,8 +220,7 @@ def _run_point(engine, reqs, rate: float, duration: float, arrival: str,
         "p99_s": _pct(lats, 0.99),
         "phase_p50_s": {k: _pct(v, 0.50) for k, v in sorted(phases.items())},
         "phase_p99_s": {k: _pct(v, 0.99) for k, v in sorted(phases.items())},
-        "breaker": (engine.breaker.state if engine.breaker is not None
-                    else "absent"),
+        "breaker": breaker_state(),
     }
     return point
 
@@ -247,6 +286,29 @@ def main() -> None:
     parser.add_argument("--calibration_wave_size", type=int, default=24)
     parser.add_argument("--drain_timeout_s", type=float, default=120.0)
     parser.add_argument("--seed", type=int, default=0)
+    fleet = parser.add_argument_group(
+        "multi-replica fabric (perceiver_io_tpu.serving)")
+    fleet.add_argument("--replicas", type=int, default=0,
+                       help="run the sweep through a router over N replicas "
+                            "(0 = the single engine, the historical mode)")
+    fleet.add_argument("--replica_mode", choices=["inprocess", "process"],
+                       default="inprocess",
+                       help="inprocess = N engines behind LocalReplica shims "
+                            "(fast, tier-1); process = real supervised "
+                            "replica processes (the acceptance-drill mode)")
+    fleet.add_argument("--kill_replica_at", type=float, default=None,
+                       metavar="FRAC",
+                       help="chaos drill: at FRAC of --kill_point's offered "
+                            "window, kill one replica (SIGKILL in process "
+                            "mode — the supervisor restarts it; simulated "
+                            "death + later revive inprocess). The fleet "
+                            "block's lost_accepted must stay 0")
+    fleet.add_argument("--kill_point", type=int, default=0,
+                       help="sweep point index the kill fires in")
+    fleet.add_argument("--revive_after_s", type=float, default=1.0,
+                       help="inprocess mode: seconds the killed replica "
+                            "stays dead before reviving (the supervisor-"
+                            "restart stand-in)")
     args = parser.parse_args()
 
     if args.dry:
@@ -255,7 +317,8 @@ def main() -> None:
             "preset": args.preset, "arrival": args.arrival,
             "duration_s": args.duration_s,
             "point_keys": list(POINT_KEYS), "phase_keys": list(PHASE_KEYS),
-            "sweep": [], "capacity": None,
+            "fleet_keys": list(FLEET_KEYS),
+            "sweep": [], "capacity": None, "fleet": None,
         }
         print(json.dumps(record))
         return
@@ -279,38 +342,114 @@ def main() -> None:
     backend = jax.default_backend()
     tiny = args.preset == "tiny" or (args.preset == "auto" and backend != "tpu")
     _log(f"backend: {backend}; preset {'tiny' if tiny else 'flagship'}; "
-         f"arrival {args.arrival}; duration {args.duration_s}s/point")
+         f"arrival {args.arrival}; duration {args.duration_s}s/point"
+         + (f"; fleet {args.replicas}x{args.replica_mode}"
+            if args.replicas else ""))
 
-    build = tiny_mlm if tiny else flagship_mlm
     vocab = 503 if tiny else 10003
     max_seq_len = 64 if tiny else 512
-    model = build(vocab_size=vocab, max_seq_len=max_seq_len)
-    ids0 = np.zeros((1, max_seq_len), np.int32)
-    variables = model.init(
-        {"params": jax.random.key(0), "masking": jax.random.key(1)},
-        ids0, ids0 == 0,
-    )
-
-    def gathered_apply(p, token_ids, pad_mask, pos):
-        logits, _ = model.apply(
-            {"params": p}, token_ids, pad_mask, masking=False,
-            deterministic=True, positions=pos,
-        )
-        return logits
-
     reqs = _build_requests(max_seq_len, vocab, n=64, seed=args.seed)
     registry = obs.get_registry()
-    engine = ServingEngine(
-        gathered_apply, variables["params"], max_batch=args.max_batch,
-        name="load_bench", registry=registry,
-        queue_limit=args.queue_limit if args.queue_limit > 0 else None,
-        request_deadline_s=args.deadline_s,
-    )
-    engine.warmup(*reqs[0])
-    _log(f"warmed {engine.num_programs} bucket programs")
+
+    def build_model_apply():
+        build = tiny_mlm if tiny else flagship_mlm
+        model = build(vocab_size=vocab, max_seq_len=max_seq_len)
+        ids0 = np.zeros((1, max_seq_len), np.int32)
+        variables = model.init(
+            {"params": jax.random.key(0), "masking": jax.random.key(1)},
+            ids0, ids0 == 0,
+        )
+
+        def gathered_apply(p, token_ids, pad_mask, pos):
+            logits, _ = model.apply(
+                {"params": p}, token_ids, pad_mask, masking=False,
+                deterministic=True, positions=pos,
+            )
+            return logits
+
+        return gathered_apply, variables["params"]
+
+    queue_limit = args.queue_limit if args.queue_limit > 0 else None
+    engine = router = sup = None
+    local_replicas = []
+    killed = {"name": None}
+    if args.replicas > 0:
+        from perceiver_io_tpu.serving import Router
+
+        if args.replica_mode == "process":
+            from perceiver_io_tpu.serving import ReplicaSupervisor
+
+            extra = ["--preset", "tiny" if tiny else "flagship",
+                     "--max_batch", str(args.max_batch)]
+            if args.cpu:
+                extra.append("--cpu")
+            if queue_limit is not None:
+                extra += ["--queue_limit", str(queue_limit)]
+            if args.deadline_s is not None:
+                extra += ["--request_deadline_s", str(args.deadline_s)]
+            sup = ReplicaSupervisor(count=args.replicas, extra_args=extra,
+                                    cpu=args.cpu, registry=registry)
+            clients = sup.start()
+            _log(f"spawned {args.replicas} replica processes; waiting for "
+                 "warm pools (engine_ready)")
+            sup.wait_ready(timeout_s=600.0)
+        else:
+            from perceiver_io_tpu.serving import LocalReplica, ReplicaApp
+
+            gathered_apply, params = build_model_apply()
+            for i in range(args.replicas):
+                eng = ServingEngine(
+                    gathered_apply, params, max_batch=args.max_batch,
+                    name=f"lb_r{i}", registry=registry,
+                    queue_limit=queue_limit,
+                    request_deadline_s=args.deadline_s,
+                )
+                eng.warmup(*reqs[0])
+                app = ReplicaApp({"infer": eng}, params, name=f"r{i}",
+                                 registry=registry)
+                local_replicas.append(LocalReplica(app))
+            clients = local_replicas
+            _log(f"warmed {args.replicas} in-process replicas")
+        router = Router(clients, name="load_bench", registry=registry,
+                        scrape_interval_s=0.1,
+                        request_timeout_s=args.drain_timeout_s)
+        router.refresh()
+        submit = lambda req: router.submit(*req)
+
+        def breaker_state():
+            states = [s["state"] for s in router.statuses().values()]
+            return f"{sum(s == 'serving' for s in states)}/{len(states)} serving"
+
+        def kill_hook():
+            if args.replica_mode == "process":
+                name = sup.clients()[0].name
+                sup.kill(name)
+            else:
+                victim = local_replicas[0]
+                victim.kill()
+                name = victim.name
+                # the supervisor-restart stand-in: revive after a bounded
+                # outage (sessions stay lost, as a real restart loses them)
+                threading.Timer(args.revive_after_s, victim.revive).start()
+            killed["name"] = name
+            _log(f"chaos: killed replica {name!r} "
+                 f"({args.replica_mode} mode)")
+    else:
+        gathered_apply, params = build_model_apply()
+        engine = ServingEngine(
+            gathered_apply, params, max_batch=args.max_batch,
+            name="load_bench", registry=registry,
+            queue_limit=queue_limit,
+            request_deadline_s=args.deadline_s,
+        )
+        engine.warmup(*reqs[0])
+        _log(f"warmed {engine.num_programs} bucket programs")
+        submit = lambda req: engine.submit(*req)
+        breaker_state = lambda: (engine.breaker.state
+                                 if engine.breaker is not None else "absent")
 
     cal_rps, cal_lat_s = _calibrate(
-        engine, reqs, args.calibration_waves, args.calibration_wave_size)
+        submit, reqs, args.calibration_waves, args.calibration_wave_size)
     _log(f"calibrated closed-loop capacity ~{cal_rps:.1f} req/s, "
          f"median latency {cal_lat_s * 1e3:.2f} ms")
 
@@ -328,9 +467,14 @@ def main() -> None:
                  for f in args.rate_factors.split(",")]
     rng = np.random.default_rng(args.seed)
     points = []
-    for rate in rates:
-        point = _run_point(engine, reqs, rate, args.duration_s, args.arrival,
-                           args.burst, rng, args.drain_timeout_s)
+    for idx, rate in enumerate(rates):
+        on_frac = None
+        if (args.kill_replica_at is not None and args.replicas > 0
+                and idx == args.kill_point):
+            on_frac = (args.kill_replica_at, kill_hook)
+        point = _run_point(submit, breaker_state, reqs, rate,
+                           args.duration_s, args.arrival, args.burst, rng,
+                           args.drain_timeout_s, on_frac=on_frac)
         points.append(point)
         ms = lambda v: f"{v * 1e3:8.2f}" if v is not None else "       —"
         _log(f"offered {point['offered_rps']:8.1f} req/s -> achieved "
@@ -362,8 +506,34 @@ def main() -> None:
         capacity = None
         _log("capacity model: no point completed any request — nothing to fit")
 
-    ratio = registry.gauge(
-        "serving_phase_sum_ratio", labels={"engine": "load_bench"}).value
+    fleet_record = None
+    if args.replicas > 0:
+        stats = router.stats()
+        if sup is not None:
+            restarts = sum(sup.restarts(c.name) for c in sup.clients())
+        else:
+            restarts = 1 if killed["name"] is not None else 0
+        fleet_record = {
+            "replicas": args.replicas, "mode": args.replica_mode,
+            "killed": killed["name"],
+            "kill_at_frac": args.kill_replica_at,
+            "kill_point": (args.kill_point
+                           if args.kill_replica_at is not None else None),
+            "reroutes": int(stats["reroutes"]),
+            "affinity_spills": int(stats["affinity_spills"]),
+            # accepted-but-never-delivered — the chaos drill's verdict:
+            # a healthy fabric keeps this 0 through a kill -9
+            "lost_accepted": int(stats["failed"]),
+            "restarts": int(restarts),
+        }
+        _log(f"fleet: {json.dumps(fleet_record)}")
+
+    if engine is not None:
+        ratio = registry.gauge(
+            "serving_phase_sum_ratio", labels={"engine": "load_bench"}).value
+        ratio = round(ratio, 5)
+    else:
+        ratio = None  # phases stay replica-side in fleet mode
     record = {
         "metric": "load_bench", "dry": False, "backend": backend,
         "preset": "tiny" if tiny else "flagship",
@@ -373,11 +543,20 @@ def main() -> None:
         "seq_len": max_seq_len,
         "calibrated_rps": round(cal_rps, 3),
         "calibrated_latency_ms": round(cal_lat_s * 1e3, 3),
-        "phase_sum_ratio": round(ratio, 5),
+        "phase_sum_ratio": ratio,
         "sweep": [_point_for_record(p) for p in points],
         "capacity": capacity,
+        "fleet": fleet_record,
     }
-    engine.close()
+    if router is not None:
+        router.drain(args.drain_timeout_s)
+        router.close()
+    for lr in local_replicas:
+        lr.app.close()
+    if sup is not None:
+        sup.stop()
+    if engine is not None:
+        engine.close()
     print(json.dumps(record))
 
 
