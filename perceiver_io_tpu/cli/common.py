@@ -133,6 +133,17 @@ def add_trainer_args(parser: argparse.ArgumentParser) -> None:
                         "so restarts/resumes skip the remote compile of an "
                         "unchanged step. Fail-soft: an unusable dir warns "
                         "and trains uncached (PERF.md §Cold start)")
+    g.add_argument("--publish_dir", default=None, metavar="DIR",
+                   help="continuous deployment (perceiver_io_tpu.deploy): "
+                        "atomically publish the current params here every "
+                        "--publish_every_n_steps steps, with a manifest "
+                        "(step, val metrics, content digest) — the feed "
+                        "serve.py --watch_checkpoints admission-gates and "
+                        "hot-swaps into live serving. Fail-soft: a failed "
+                        "publish warns, training continues")
+    g.add_argument("--publish_every_n_steps", type=int, default=0,
+                   help="publication cadence in optimizer steps (required "
+                        "with --publish_dir)")
 
 
 def add_mesh_args(parser: argparse.ArgumentParser) -> None:
@@ -300,6 +311,8 @@ def trainer_config(args) -> TrainerConfig:
         dispatch_error_retries=getattr(args, "dispatch_error_retries", 0),
         fit_attempts=getattr(args, "fit_attempts", 1),
         compile_cache=getattr(args, "compile_cache", None),
+        publish_dir=getattr(args, "publish_dir", None),
+        publish_every_n_steps=getattr(args, "publish_every_n_steps", 0),
     )
 
 
@@ -785,7 +798,8 @@ def parse_with_resume(parser: argparse.ArgumentParser, argv):
     # flags have no --no_* spelling to override with)
     env_flags = {"resume", "multihost", "coordinator_address", "num_processes",
                  "process_id", "dp", "tp", "sp", "shard_seq", "zero_opt",
-                 "compile_cache"}  # a local path: never inherit across hosts
+                 # local paths: never inherit across hosts/invocations
+                 "compile_cache", "publish_dir", "publish_every_n_steps"}
     defaults = {
         k: v for k, v in hparams.items() if k in known and k not in env_flags
     }

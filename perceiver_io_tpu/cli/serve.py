@@ -61,10 +61,22 @@ latents, and a dead pin surfaces as a re-encode. ``--rolling_swap_step``
 rolls the fleet to another checkpoint step one replica at a time with
 auto-rollback on post-swap SLO burn/breaker regression.
 
+``--watch_checkpoints DIR`` closes the train→serve loop
+(``perceiver_io_tpu.deploy``, PERF.md §Deployment): the process polls DIR
+(a trainer's ``publish_dir``) for atomically-published checkpoints, runs
+each through the admission gate — manifest digest verification, all-finite
+param scan, a golden-batch forward within ``--gate_quality_tol`` of the
+incumbent — and hot-swaps only passing trees into live serving (rolling
+one replica at a time under ``--replicas``, each replica re-verifying the
+digest at load; re-quantized on the fly under ``--quantize int8``). A
+failing publication is quarantined in place (sticky, never re-attempted);
+a post-swap SLO-burn/breaker regression rolls back to the incumbent tree.
+
 Graceful drain: SIGTERM/SIGINT stop admission, finish every accepted
 request, flush the event log, and exit 0 (``--drain_timeout_s`` bounds the
 wait) — in both single-process and fleet modes, so a supervisor rotation
-never drops the queue.
+never drops the queue. An in-progress gated swap completes (or rolls back)
+before exit — never a half-swapped fleet.
 
 ``--metrics_port`` starts the localhost observability sidecar
 (``/metrics`` Prometheus text, ``/healthz``, ``/statz`` JSON snapshot, now
@@ -217,6 +229,29 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--rolling_burn_threshold", type=float, default=2.0,
                    help="post-swap SLO burn rate above which the rollout "
                         "rolls back")
+    d = parser.add_argument_group(
+        "continuous deployment (perceiver_io_tpu.deploy; PERF.md "
+        "§Deployment)")
+    d.add_argument("--watch_checkpoints", default=None, metavar="DIR",
+                   help="watch this publish directory (TrainerConfig."
+                        "publish_dir) for new checkpoint publications and "
+                        "hot-swap each one into live serving AFTER it "
+                        "passes the admission gate (digest verification, "
+                        "all-finite scan, golden-batch forward within "
+                        "--gate_quality_tol of the incumbent). A failing "
+                        "publication is quarantined in place and never "
+                        "re-attempted; a post-swap SLO-burn/breaker "
+                        "regression rolls back to the incumbent tree. "
+                        "Works in both single-process and --replicas mode "
+                        "(fleet swaps roll one replica at a time)")
+    d.add_argument("--gate_quality_tol", type=float, default=0.5,
+                   help="admission-gate quality bound: maximum relative "
+                        "deviation of the candidate's golden-batch outputs "
+                        "from the incumbent's (an online-refresh checkpoint "
+                        "continues the same run — garbage trees deviate by "
+                        "orders of magnitude)")
+    d.add_argument("--publish_poll_s", type=float, default=2.0,
+                   help="seconds between publish-directory polls")
     r = parser.add_argument_group(
         "resilience (PERF.md §Reliability: retry/shed/breaker semantics)")
     r.add_argument("--request_deadline_s", type=float, default=None,
@@ -356,6 +391,61 @@ def main(argv: Optional[Sequence[str]] = None):
             obs.configure_event_log(None)
 
 
+def _start_deployer(args, model, params, max_seq_len, target):
+    """The serving half of the train→serve loop (``--watch_checkpoints``):
+    poll the publish dir, admission-gate every publication (digest /
+    finite / golden-forward-vs-incumbent quality), and hot-swap passing
+    trees into ``target``. The gate is handed over as a FACTORY, so its
+    golden-program compile happens lazily on the deployer thread — serve
+    startup stays non-blocking (the r10 background-warmup property) even
+    when no publication ever arrives. Publications at or below the booted
+    checkpoint's step are ignored (a restart must not replay — or
+    quarantine — the historical backlog). Runs on a daemon thread; the
+    caller's drain path stops it via :func:`_stop_deployer`."""
+    import numpy as np
+
+    from perceiver_io_tpu.deploy import AdmissionGate, ModelDeployer
+    from perceiver_io_tpu.inference.engine import mlm_apply_fns
+    from perceiver_io_tpu.training.checkpoint import resolve_checkpoint_step
+
+    golden = (np.zeros((1, max_seq_len), np.int32),
+              np.zeros((1, max_seq_len), bool),
+              np.zeros((1, 2), np.int32))
+
+    def make_gate():
+        return AdmissionGate(
+            mlm_apply_fns(model)["infer"], golden, params,
+            quality_tol=args.gate_quality_tol, name="serve",
+        )
+
+    try:
+        min_step = resolve_checkpoint_step(args.checkpoint, args.step)
+    except Exception:  # unranked/odd checkpoint dir: accept every step
+        min_step = -1
+    deployer = ModelDeployer(
+        args.watch_checkpoints, make_gate, target,
+        poll_s=args.publish_poll_s, name="serve", min_step=min_step,
+    ).start()
+    print(f"serve: watching {args.watch_checkpoints} for checkpoint "
+          f"publications newer than step {min_step} (poll "
+          f"{args.publish_poll_s:g}s, quality tol "
+          f"{args.gate_quality_tol:g})", file=sys.stderr, flush=True)
+    return deployer
+
+
+def _stop_deployer(deployer, timeout_s: float) -> None:
+    if deployer is None:
+        return
+    if not deployer.stop(timeout_s):
+        print("serve: WARNING — deployment loop did not stop within "
+              f"{timeout_s:g}s (a swap may still be in flight)",
+              file=sys.stderr, flush=True)
+    else:
+        print(f"serve: deployment loop stopped "
+              f"({json.dumps(deployer.stats())})", file=sys.stderr,
+              flush=True)
+
+
 def _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint,
            drain_state=None):
     # Deliberately tier 1 ONLY in the serve process: the AOT executable
@@ -411,6 +501,17 @@ def _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint,
                       "serving immediately (--blocking_warmup restores the "
                       "wait)", file=sys.stderr)
 
+        deployer = None
+        if args.watch_checkpoints:
+            from perceiver_io_tpu.deploy import EngineSwapTarget
+
+            deployer = _start_deployer(
+                args, model, params, max_seq_len,
+                EngineSwapTarget(server, params,
+                                 bake_s=args.rolling_bake_s,
+                                 burn_threshold=args.rolling_burn_threshold),
+            )
+
         def emit(text: str, fills) -> None:
             line = {"text": text, "fills": fills}
             results.append(line)
@@ -421,58 +522,64 @@ def _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint,
         # finishes) every accepted request
         pending = []
         try:
-            if args.texts:
-                if args.cached:
-                    cached = server.encode(args.texts)
-                    for text, f in zip(args.texts, server.fill_masks_cached(
-                            cached, k=args.k)):
-                        emit(text, f)
-                else:
-                    for text in args.texts:
-                        pending.append((text, server.submit(text, k=args.k)))
-            if args.stdin:
-                if args.cached:
-                    # cached mode batches the whole pipe: one encode sweep,
-                    # one decode sweep — per-line sync round-trips would
-                    # serialize into exactly the naive dispatch the engine
-                    # exists to beat
-                    lines = [l.rstrip("\n") for l in sys.stdin]
-                    lines = [l for l in lines if l]
-                    cached = server.encode(lines)
-                    for text, f in zip(lines, server.fill_masks_cached(
-                            cached, k=args.k)):
-                        emit(text, f)
-                else:
-                    # a line-per-request stream: submit as lines arrive,
-                    # resolve in order — arrivals batch up behind the
-                    # in-flight dispatch. The marker line tells a supervisor
-                    # (and the drain test) admission is live.
-                    print("serve: admitting stdin", file=sys.stderr,
-                          flush=True)
-                    for line in sys.stdin:
-                        text = line.rstrip("\n")
-                        if text:
-                            pending.append(
-                                (text, server.submit(text, k=args.k)))
-        except _DrainRequested:
-            # graceful drain: admission stopped (the raise unwound the
-            # loops); everything already accepted below still finishes and
-            # the process exits 0 — a supervisor rotation never drops the
-            # queue. Later signals are absorbed by the handler.
-            print("serve: drain requested (signal) — admission stopped, "
-                  f"finishing {len(pending)} in-flight request(s)",
-                  file=sys.stderr, flush=True)
-        # admission is over either way: mark draining so a FIRST signal
-        # landing during the resolve loop below is absorbed by the handler
-        # (printed, not raised) — finish-in-flight can never be unwound
-        # into dropping accepted results
-        signaled = drain_state is not None and drain_state.get("draining")
-        if drain_state is not None:
-            drain_state["draining"] = True
-        for text, fut in pending:
-            emit(text, fut.result())
-        if signaled:
-            server.drain(args.drain_timeout_s)
+            try:
+                if args.texts:
+                    if args.cached:
+                        cached = server.encode(args.texts)
+                        for text, f in zip(args.texts, server.fill_masks_cached(
+                                cached, k=args.k)):
+                            emit(text, f)
+                    else:
+                        for text in args.texts:
+                            pending.append((text, server.submit(text, k=args.k)))
+                if args.stdin:
+                    if args.cached:
+                        # cached mode batches the whole pipe: one encode sweep,
+                        # one decode sweep — per-line sync round-trips would
+                        # serialize into exactly the naive dispatch the engine
+                        # exists to beat
+                        lines = [l.rstrip("\n") for l in sys.stdin]
+                        lines = [l for l in lines if l]
+                        cached = server.encode(lines)
+                        for text, f in zip(lines, server.fill_masks_cached(
+                                cached, k=args.k)):
+                            emit(text, f)
+                    else:
+                        # a line-per-request stream: submit as lines arrive,
+                        # resolve in order — arrivals batch up behind the
+                        # in-flight dispatch. The marker line tells a supervisor
+                        # (and the drain test) admission is live.
+                        print("serve: admitting stdin", file=sys.stderr,
+                              flush=True)
+                        for line in sys.stdin:
+                            text = line.rstrip("\n")
+                            if text:
+                                pending.append(
+                                    (text, server.submit(text, k=args.k)))
+            except _DrainRequested:
+                # graceful drain: admission stopped (the raise unwound the
+                # loops); everything already accepted below still finishes and
+                # the process exits 0 — a supervisor rotation never drops the
+                # queue. Later signals are absorbed by the handler.
+                print("serve: drain requested (signal) — admission stopped, "
+                      f"finishing {len(pending)} in-flight request(s)",
+                      file=sys.stderr, flush=True)
+            # admission is over either way: mark draining so a FIRST signal
+            # landing during the resolve loop below is absorbed by the handler
+            # (printed, not raised) — finish-in-flight can never be unwound
+            # into dropping accepted results
+            signaled = drain_state is not None and drain_state.get("draining")
+            if drain_state is not None:
+                drain_state["draining"] = True
+            for text, fut in pending:
+                emit(text, fut.result())
+            if signaled:
+                server.drain(args.drain_timeout_s)
+        finally:
+            # the drain contract extends to the deployment loop: an
+            # in-progress gated swap COMPLETES (or rolls back) before exit —
+            # never a half-swapped server
+            _stop_deployer(deployer, args.drain_timeout_s)
         if warmup_handle is not None and warmup_handle.done():
             try:
                 n = warmup_handle.wait(0)
@@ -580,6 +687,23 @@ def _serve_fleet(args, drain_state):
         with Router(clients, name="serve",
                     queue_limit=args.queue_limit) as router:
             router.refresh()
+            deployer = None
+            if args.watch_checkpoints:
+                from perceiver_io_tpu.deploy import RouterSwapTarget
+                from perceiver_io_tpu.inference import load_mlm_checkpoint
+
+                # the gate needs a reference forward + incumbent tree in THIS
+                # process (no replica may see a candidate before it passes);
+                # passing trees then roll replica-by-replica as publication
+                # specs each replica loads digest-verified
+                model, params, _ = load_mlm_checkpoint(
+                    args.checkpoint, tokenizer, step=args.step)
+                deployer = _start_deployer(
+                    args, model, params, max_seq_len,
+                    RouterSwapTarget(
+                        router, bake_s=args.rolling_bake_s,
+                        burn_threshold=args.rolling_burn_threshold),
+                )
             pending = []  # (text, future-or-None, n_masks)
 
             def submit(text):
@@ -621,39 +745,45 @@ def _serve_fleet(args, drain_state):
                 return topk(logits, n_masks)
 
             try:
-                for text in (args.texts or []):
-                    submit(text)
-                if args.stdin:
-                    print("serve: admitting stdin", file=sys.stderr,
-                          flush=True)
-                    for line in sys.stdin:
-                        text = line.rstrip("\n")
-                        if text:
-                            submit(text)
-            except _DrainRequested:
-                print("serve: drain requested (signal) — admission stopped, "
-                      f"finishing {len(pending)} in-flight request(s)",
-                      file=sys.stderr, flush=True)
-            # admission is over either way: mark draining so a FIRST signal
-            # landing during the resolve loop is absorbed by the handler
-            # (printed, not raised) — finish-in-flight can never be unwound
-            # into dropping accepted results
-            signaled = drain_state.get("draining")
-            drain_state["draining"] = True
-            for text, fut, n_masks in pending:
-                emit(text, [] if fut is None else resolve(fut, n_masks))
-            if args.rolling_swap_step is not None and not signaled:
-                report = router.rolling_update(
-                    {"kind": "checkpoint", "path": args.checkpoint,
-                     "step": args.rolling_swap_step},
-                    bake_s=args.rolling_bake_s,
-                    burn_threshold=args.rolling_burn_threshold,
-                )
-                print(f"serve: rolling swap {json.dumps(report)}",
-                      file=sys.stderr, flush=True)
-            if args.stats:
-                print(f"serve: fleet stats {json.dumps(router.stats())}",
-                      file=sys.stderr)
+                try:
+                    for text in (args.texts or []):
+                        submit(text)
+                    if args.stdin:
+                        print("serve: admitting stdin", file=sys.stderr,
+                              flush=True)
+                        for line in sys.stdin:
+                            text = line.rstrip("\n")
+                            if text:
+                                submit(text)
+                except _DrainRequested:
+                    print("serve: drain requested (signal) — admission "
+                          f"stopped, finishing {len(pending)} in-flight "
+                          "request(s)", file=sys.stderr, flush=True)
+                # admission is over either way: mark draining so a FIRST
+                # signal landing during the resolve loop is absorbed by the
+                # handler (printed, not raised) — finish-in-flight can never
+                # be unwound into dropping accepted results
+                signaled = drain_state.get("draining")
+                drain_state["draining"] = True
+                for text, fut, n_masks in pending:
+                    emit(text, [] if fut is None else resolve(fut, n_masks))
+                if args.rolling_swap_step is not None and not signaled:
+                    report = router.rolling_update(
+                        {"kind": "checkpoint", "path": args.checkpoint,
+                         "step": args.rolling_swap_step},
+                        bake_s=args.rolling_bake_s,
+                        burn_threshold=args.rolling_burn_threshold,
+                    )
+                    print(f"serve: rolling swap {json.dumps(report)}",
+                          file=sys.stderr, flush=True)
+                if args.stats:
+                    print(f"serve: fleet stats {json.dumps(router.stats())}",
+                          file=sys.stderr)
+            finally:
+                # the drain contract extends to the deployment loop: an
+                # in-progress ROLLING swap completes or rolls the fleet back
+                # before teardown — never a half-swapped fleet
+                _stop_deployer(deployer, args.drain_timeout_s)
             # graceful fleet teardown: replicas finish accepted work before
             # the supervisor's quit/terminate sequence
             router.drain(args.drain_timeout_s)

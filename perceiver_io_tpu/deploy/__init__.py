@@ -1,0 +1,75 @@
+"""Continuous train→serve deployment: validated checkpoint publication and
+gated hot-swap (the composition layer over training/, serving/, aot/, quant/
+and resilience/ — ROADMAP item 5).
+
+The trainer *publishes* checkpoints on a cadence
+(``TrainerConfig.publish_dir`` / ``publish_every_n_steps`` →
+:class:`CheckpointPublisher`): one atomic directory per step with the param
+tree and a manifest carrying step, val metrics, a sha256 content digest, and
+the package version. The serving side *watches* the publish directory
+(:class:`ModelDeployer`) and runs every new publication through the
+:class:`AdmissionGate` — digest verification, all-finite scan, golden-batch
+forward within a quality bound of the incumbent, optional AOT prewarm —
+BEFORE any replica sees the tree. A passing tree hot-swaps via
+``ServingEngine.update_params`` (:class:`EngineSwapTarget`, with an
+SLO/breaker bake and instant in-memory rollback) or rolls across the fleet
+via ``Router.rolling_update`` (:class:`RouterSwapTarget`, replicas loading
+the publication digest-verified themselves). Any failure quarantines the
+publication in place — sticky across processes, counted by reason — so a
+bad tree is never re-attempted and provably never reaches traffic.
+
+Chaos: ``PIT_FAULTS`` sites ``deploy.publish`` / ``deploy.gate`` /
+``deploy.swap`` make every failure path of the loop drillable
+(``tests/test_deploy.py``); ``tools/deploy_bench.py`` measures swap cadence
+and the per-swap latency blip under open-loop traffic (PERF.md §Deployment).
+"""
+
+from perceiver_io_tpu.deploy.gate import REASONS, AdmissionGate, GateResult
+from perceiver_io_tpu.deploy.publication import (
+    MANIFEST_NAME,
+    PARAMS_NAME,
+    REJECT_MARKER,
+    CheckpointPublisher,
+    DigestMismatchError,
+    PublicationInfo,
+    list_publications,
+    load_publication,
+    publication_name,
+    publish_params,
+    quarantine,
+    read_manifest,
+    read_quarantine,
+)
+from perceiver_io_tpu.deploy.watcher import (
+    CheckpointWatcher,
+    EngineSwapTarget,
+    ModelDeployer,
+    RouterSwapTarget,
+    swap_window_stats,
+)
+from perceiver_io_tpu.utils.treepath import tree_digest
+
+__all__ = [
+    "AdmissionGate",
+    "CheckpointPublisher",
+    "CheckpointWatcher",
+    "DigestMismatchError",
+    "EngineSwapTarget",
+    "GateResult",
+    "MANIFEST_NAME",
+    "ModelDeployer",
+    "PARAMS_NAME",
+    "PublicationInfo",
+    "REASONS",
+    "REJECT_MARKER",
+    "RouterSwapTarget",
+    "list_publications",
+    "load_publication",
+    "publication_name",
+    "publish_params",
+    "quarantine",
+    "read_manifest",
+    "read_quarantine",
+    "swap_window_stats",
+    "tree_digest",
+]

@@ -1,0 +1,241 @@
+"""The admission gate: no tree reaches a replica without passing it.
+
+The deployment loop's safety property is *provable non-admission*: a bad,
+torn, or corrupt publication must be structurally unable to reach traffic.
+The gate is the single choke point — the deployer hands every detected
+publication through :meth:`AdmissionGate.check` before any swap surface
+(``ServingEngine.update_params`` / ``Router.rolling_update``) hears about
+it. Four independent layers, each catching a failure mode the others cannot:
+
+1. **digest** — recompute the content digest over the loaded tree and match
+   the manifest. Catches bit corruption and tampering between publish and
+   load (a torn WRITE cannot exist: publication is an atomic rename).
+2. **finite scan** — every floating leaf must be all-finite. Catches a
+   poisoned training run (NaN moments published before the trainer's own
+   guards tripped) whose digest *verifies* — the digest proves provenance,
+   not health.
+3. **golden forward** — run the candidate on a fixed golden batch; outputs
+   must be finite AND within a configurable quality bound of the incumbent
+   tree's outputs on the same batch. Catches finite-but-garbage trees (a
+   scale bug, a wrong-step restore) that neither hash nor scan can see.
+   Default quality metric: relative mean absolute deviation from the
+   incumbent's outputs (an online-refresh candidate continues the same
+   run — its outputs live in the same regime; a garbage tree's do not).
+   Pass ``quality_fn(outputs) -> float`` (lower = better, e.g. golden-batch
+   loss) for a task metric instead: the candidate must then score within
+   ``quality_tol`` of the incumbent's score.
+4. **prewarm** — an optional callable run with the validated tree LAST, so
+   the swap never pays a compile wall mid-traffic (for a same-family tree
+   the engines' programs already fit — the hook matters when avals change:
+   dtype/sharding/quantization drift). A raising prewarm is a gate failure.
+
+``deploy.gate`` is a ``PIT_FAULTS`` site: an injected raise makes the gate
+itself fail (counted and quarantined as ``gate_error`` by the deployer) —
+the drill that proves a broken gate fails CLOSED, not open.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+import perceiver_io_tpu.obs as obs
+from perceiver_io_tpu.resilience import faults
+from perceiver_io_tpu.utils.treepath import tree_digest
+
+# normalized rejection reasons — the deploy_rejected_total{reason} label set
+# (bounded cardinality; the free-text detail rides the GateResult/event)
+REASONS = (
+    "digest_mismatch", "nonfinite_params", "nonfinite_outputs", "quality",
+    "prewarm_failed", "gate_error", "unreadable", "swap_failed",
+    "post_swap_regression",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class GateResult:
+    ok: bool
+    reason: Optional[str] = None     # one of REASONS when not ok
+    detail: str = ""                 # free text for events/logs
+    checks: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    seconds: float = 0.0
+
+
+def _all_finite(tree) -> Optional[str]:
+    """Key path of the first non-finite floating leaf, or None."""
+    import jax
+
+    from perceiver_io_tpu.utils.treepath import simple_keystr
+
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        a = np.asarray(leaf)
+        if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
+            return simple_keystr(path)
+    return None
+
+
+class AdmissionGate:
+    """Validates candidate param trees against an incumbent.
+
+    Args:
+      apply_fn: pure ``(params, *golden_inputs) -> outputs`` — the serving
+        forward (or any representative program).
+      golden_inputs: the fixed golden batch the forward runs on.
+      incumbent_params: the currently-served tree (the quality reference).
+        Call :meth:`set_incumbent` after every successful swap so the next
+        candidate is judged against what is actually serving.
+      quality_tol: bound on the quality check. Default metric: relative mean
+        absolute deviation of candidate outputs from incumbent outputs
+        (``mean|c-i| / (mean|i|+eps) <= quality_tol``). With ``quality_fn``:
+        ``quality_fn(candidate_out) <= quality_fn(incumbent_out) +
+        quality_tol``.
+      quality_fn: optional scalar scorer over the forward's outputs (lower =
+        better; e.g. golden-batch loss).
+      prewarm: optional hook run with the validated tree (AOT prewarm /
+        compile under the new fingerprint) — raising fails the gate.
+    """
+
+    def __init__(
+        self,
+        apply_fn: Callable[..., Any],
+        golden_inputs: Sequence[np.ndarray],
+        incumbent_params,
+        quality_tol: float = 0.5,
+        quality_fn: Optional[Callable[[Any], float]] = None,
+        prewarm: Optional[Callable[[Any], None]] = None,
+        registry: Optional[obs.MetricsRegistry] = None,
+        name: str = "deploy",
+    ):
+        import jax
+
+        if quality_tol <= 0:
+            raise ValueError(f"quality_tol must be > 0, got {quality_tol}")
+        self.name = name
+        self.quality_tol = float(quality_tol)
+        self.quality_fn = quality_fn
+        self.prewarm = prewarm
+        self._golden = tuple(np.asarray(a) for a in golden_inputs)
+        # one jitted program for both incumbent and candidates (same family
+        # => same treedef/avals => one compile, paid at gate construction
+        # time rather than on the first publication)
+        self._forward = jax.jit(lambda p, inputs: apply_fn(p, *inputs))
+        self._incumbent_out = None
+        # set_incumbent is eager, so construction also pays the golden
+        # program's ONE compile here; for the serving CLI this whole
+        # constructor runs lazily on the deployer thread (ModelDeployer
+        # gate factory), off the serve startup path
+        self.set_incumbent(incumbent_params)
+        reg = registry if registry is not None else obs.get_registry()
+        self._m_seconds = reg.histogram(
+            "deploy_gate_seconds",
+            "wall seconds one admission-gate evaluation took",
+            {"gate": name})
+
+    # -- incumbent management ------------------------------------------------
+
+    def set_incumbent(self, params) -> None:
+        """Adopt ``params`` as the quality reference (call after a
+        successful swap). Only the golden OUTPUTS are kept (the gate never
+        needs the tree again — no second full-model copy lives here), and
+        they are computed EAGERLY: on return, a ``check()`` can never mix
+        an old reference output with a new incumbent."""
+        import jax
+
+        self._incumbent_out = jax.device_get(
+            self._forward(params, self._golden))
+
+    def _incumbent_outputs(self):
+        return self._incumbent_out
+
+    # -- the gate ------------------------------------------------------------
+
+    def check(self, candidate, manifest: Optional[Dict[str, Any]] = None,
+              ) -> GateResult:
+        """Run every layer; returns a :class:`GateResult` (never raises —
+        an exception inside the gate is itself a rejection: fail CLOSED)."""
+        import jax
+
+        t0 = time.monotonic()
+        checks: Dict[str, Any] = {}
+        try:
+            faults.inject("deploy.gate")  # chaos hook (no-op by default)
+
+            # 1. provenance: the loaded tree is the published tree
+            if manifest is not None and manifest.get("digest"):
+                got = tree_digest(candidate)
+                checks["digest"] = got == manifest["digest"]
+                if not checks["digest"]:
+                    return self._done(GateResult(
+                        False, "digest_mismatch",
+                        f"content digest {got[:12]} != manifest "
+                        f"{str(manifest['digest'])[:12]}",
+                        checks), t0)
+
+            # 2. health: every floating leaf finite
+            bad = _all_finite(candidate)
+            checks["finite_params"] = bad is None
+            if bad is not None:
+                return self._done(GateResult(
+                    False, "nonfinite_params",
+                    f"non-finite values at param leaf {bad!r}", checks), t0)
+
+            # 3. behavior: golden forward, finite + within quality bound
+            out = jax.device_get(self._forward(candidate, self._golden))
+            bad = _all_finite(out)
+            checks["finite_outputs"] = bad is None
+            if bad is not None:
+                return self._done(GateResult(
+                    False, "nonfinite_outputs",
+                    "golden-batch forward produced non-finite outputs",
+                    checks), t0)
+            inc = self._incumbent_outputs()
+            if self.quality_fn is not None:
+                q_cand = float(self.quality_fn(out))
+                q_inc = float(self.quality_fn(inc))
+                checks["quality"] = {"candidate": q_cand, "incumbent": q_inc}
+                ok = np.isfinite(q_cand) and q_cand <= q_inc + self.quality_tol
+                detail = (f"quality {q_cand:.6g} vs incumbent {q_inc:.6g} "
+                          f"(tol {self.quality_tol:g})")
+            else:
+                c = np.concatenate([np.ravel(np.asarray(x, np.float64))
+                                    for x in jax.tree.leaves(out)])
+                i = np.concatenate([np.ravel(np.asarray(x, np.float64))
+                                    for x in jax.tree.leaves(inc)])
+                dev = float(np.mean(np.abs(c - i))
+                            / (np.mean(np.abs(i)) + 1e-9))
+                checks["quality"] = {"rel_deviation": dev}
+                ok = dev <= self.quality_tol
+                detail = (f"golden-output relative deviation {dev:.4g} vs "
+                          f"incumbent (tol {self.quality_tol:g})")
+            if not ok:
+                return self._done(GateResult(False, "quality", detail,
+                                             checks), t0)
+
+            # 4. no compile wall mid-traffic: prewarm under the new tree
+            if self.prewarm is not None:
+                try:
+                    self.prewarm(candidate)
+                    checks["prewarm"] = True
+                except Exception as e:
+                    checks["prewarm"] = False
+                    return self._done(GateResult(
+                        False, "prewarm_failed",
+                        f"{type(e).__name__}: {e}", checks), t0)
+
+            return self._done(GateResult(True, None, detail, checks), t0)
+        except Exception as e:
+            # the gate itself failed: fail CLOSED — the tree is NOT admitted
+            return self._done(GateResult(
+                False, "gate_error", f"{type(e).__name__}: {e}", checks), t0)
+
+    def _done(self, result: GateResult, t0: float) -> GateResult:
+        result = dataclasses.replace(
+            result, seconds=time.monotonic() - t0)
+        self._m_seconds.observe(result.seconds)
+        obs.event("deploy_gate", gate=self.name, ok=result.ok,
+                  reason=result.reason, detail=result.detail,
+                  seconds=round(result.seconds, 4))
+        return result

@@ -1,0 +1,304 @@
+"""Atomic checkpoint publication: the wire format between trainer and fleet.
+
+A *publication* is one directory under the publish root::
+
+    publish_dir/
+      step_00000040/
+        params.npz      # flattened param tree (simple_keystr -> array)
+        MANIFEST.json   # step, val metrics, content digest, package version
+      step_00000080/...
+      .tmp-step_00000120-77123/   # an in-progress publish (readers skip it)
+
+Atomicity is the whole point of the format: the payload and manifest are
+written into a ``.tmp-*`` sibling in the SAME directory and the finished
+directory lands with one ``os.replace`` — a rename on the same filesystem is
+atomic, so a reader either sees the complete publication or nothing. There
+is no observable torn state (``tests/test_deploy.py`` races a reader against
+a publishing thread to pin this).
+
+The manifest carries a sha256 CONTENT DIGEST over the param tree
+(``utils/treepath.tree_digest`` — same definition the checkpoint sidecars
+use), so the serving-side admission gate can prove the tree it loaded is the
+tree the trainer published: silent bit corruption or tampering between the
+two halves is a digest mismatch, not a served model.
+
+A rejected publication is *quarantined* in place: a ``REJECTED.json`` marker
+written next to the manifest. Quarantine is sticky across processes — every
+scanner skips marked publications, so a bad tree is never re-attempted.
+
+Fault sites (``PIT_FAULTS``): ``deploy.publish`` supports ``transient`` /
+``fatal`` raises (a publish that dies mid-write leaves only a ``.tmp-*``
+residue) and ``nan`` corruption — the NaN tree is poisoned BEFORE the digest
+is computed, so its digest *verifies* and only the gate's all-finite scan
+can stop it: the drill that proves the gate layers are independent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import perceiver_io_tpu.obs as obs
+from perceiver_io_tpu.resilience import faults
+from perceiver_io_tpu.utils.treepath import digest_named, flatten_named
+
+MANIFEST_NAME = "MANIFEST.json"
+PARAMS_NAME = "params.npz"
+REJECT_MARKER = "REJECTED.json"
+TMP_PREFIX = ".tmp-"
+MANIFEST_FORMAT = 1
+
+
+class DigestMismatchError(ValueError):
+    """A publication's params do not hash to the manifest's digest —
+    corruption or tampering between publish and load."""
+
+
+@dataclasses.dataclass(frozen=True)
+class PublicationInfo:
+    """One complete publication as a scanner sees it."""
+
+    path: str
+    step: int
+    manifest: Dict[str, Any]
+
+    @property
+    def rejected(self) -> bool:
+        return os.path.exists(os.path.join(self.path, REJECT_MARKER))
+
+
+def _package_version() -> str:
+    try:
+        import perceiver_io_tpu
+
+        return str(getattr(perceiver_io_tpu, "__version__", "0"))
+    except Exception:
+        return "0"
+
+
+def publication_name(step: int) -> str:
+    return f"step_{int(step):08d}"
+
+
+def publish_params(
+    publish_dir: str,
+    step: int,
+    params,
+    val_metrics: Optional[Dict[str, float]] = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Atomically publish ``params`` as ``publish_dir/step_NNNNNNNN``.
+
+    Returns the final publication path. Raises ``FileExistsError`` when the
+    step was already published (a publication is immutable — republish under
+    a new step). The payload is flattened to host numpy (one ``.npz``), the
+    manifest carries the content digest, and the finished directory lands
+    with a single same-dir ``os.replace`` — a concurrent reader can never
+    observe a half-written publication.
+    """
+    # chaos hook (no-op unless installed): raise kinds simulate a publish
+    # dying mid-write; the NaN kind corrupts BEFORE the digest, so the
+    # corrupted tree's digest VERIFIES and only the gate's finite scan can
+    # reject it — the layer separation the chaos suite pins
+    params = faults.fire("deploy.publish", params)
+
+    publish_dir = os.path.abspath(publish_dir)
+    final = os.path.join(publish_dir, publication_name(step))
+    if os.path.exists(final):
+        raise FileExistsError(f"publication already exists: {final}")
+    os.makedirs(publish_dir, exist_ok=True)
+
+    named = flatten_named(params)
+    digest = digest_named(named)  # one flatten + host fetch, not two
+    manifest = {
+        "format": MANIFEST_FORMAT,
+        "step": int(step),
+        "val_metrics": {k: float(v) for k, v in (val_metrics or {}).items()},
+        "digest": digest,
+        "leaf_count": len(named),
+        "package_version": _package_version(),
+        "published_unix_s": round(time.time(), 3),
+    }
+    if extra:
+        manifest["extra"] = extra
+
+    tmp = os.path.join(
+        publish_dir, f"{TMP_PREFIX}{publication_name(step)}-{os.getpid()}"
+    )
+    os.makedirs(tmp, exist_ok=False)
+    try:
+        with open(os.path.join(tmp, PARAMS_NAME), "wb") as f:
+            np.savez(f, **named)
+            f.flush()
+            os.fsync(f.fileno())
+        with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        # THE atomic step: the complete payload appears under its final name
+        # in one rename (same dir => same filesystem => atomic)
+        os.replace(tmp, final)
+    except BaseException:
+        # a failed publish leaves at most a .tmp-* residue, which every
+        # scanner skips — never a half-publication under the final name
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    try:  # make the rename durable (best-effort: not all OSes allow it)
+        dirfd = os.open(publish_dir, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+    except OSError:
+        pass
+    obs.event("deploy_published", step=int(step), path=final,
+              digest=digest[:12])
+    return final
+
+
+def read_manifest(path: str) -> Dict[str, Any]:
+    with open(os.path.join(path, MANIFEST_NAME)) as f:
+        return json.load(f)
+
+
+def list_publications(publish_dir: str,
+                      include_rejected: bool = False) -> List[PublicationInfo]:
+    """Complete publications under ``publish_dir``, ascending by step.
+
+    Skips in-progress ``.tmp-*`` residue and anything without a readable
+    manifest (a manifest exists only inside a directory that landed via the
+    atomic rename, so "has a manifest" == "is complete"). Quarantined
+    publications are skipped unless ``include_rejected``.
+    """
+    out: List[PublicationInfo] = []
+    try:
+        entries = sorted(os.listdir(publish_dir))
+    except FileNotFoundError:
+        return out
+    for name in entries:
+        if name.startswith(TMP_PREFIX):
+            continue
+        path = os.path.join(publish_dir, name)
+        if not os.path.isdir(path):
+            continue
+        try:
+            manifest = read_manifest(path)
+            step = int(manifest["step"])
+        except (OSError, ValueError, KeyError, TypeError):
+            continue  # no/unreadable manifest: not a publication
+        info = PublicationInfo(path=path, step=step, manifest=manifest)
+        if info.rejected and not include_rejected:
+            continue
+        out.append(info)
+    out.sort(key=lambda p: p.step)
+    return out
+
+
+def _unflatten(named: Dict[str, np.ndarray]):
+    """Rebuild the nested-dict param tree from "/"-joined key paths."""
+    tree: Dict[str, Any] = {}
+    for key, leaf in named.items():
+        parts = key.split("/")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaf
+    return tree
+
+
+def load_publication(path: str,
+                     verify_digest: bool = True) -> Tuple[Any, Dict[str, Any]]:
+    """Load one publication as ``(param_tree, manifest)``.
+
+    ``verify_digest=True`` recomputes the content digest over the loaded
+    arrays and raises :class:`DigestMismatchError` on mismatch — the
+    replica-side defense (``serving/replica.py`` publication specs), so a
+    tree corrupted AFTER the router-side gate passed it still cannot be
+    installed. The gate itself loads with ``verify_digest=False`` and owns
+    the check (one reject counter, one quarantine decision).
+    """
+    manifest = read_manifest(path)
+    with np.load(os.path.join(path, PARAMS_NAME)) as z:
+        named = {k: z[k] for k in z.files}
+    tree = _unflatten(named)
+    if verify_digest:
+        got = digest_named(named)
+        want = manifest.get("digest")
+        if got != want:
+            raise DigestMismatchError(
+                f"publication {path} digest mismatch: manifest {want!r} vs "
+                f"loaded content {got!r} — corrupted or tampered payload"
+            )
+    return tree, manifest
+
+
+def quarantine(path: str, reason: str) -> None:
+    """Mark a publication rejected (sticky: every scanner skips it, in this
+    process and any other, forever — a bad tree is never re-attempted)."""
+    marker = {"reason": reason, "rejected_unix_s": round(time.time(), 3)}
+    tmp = os.path.join(path, REJECT_MARKER + ".tmp")
+    try:
+        with open(tmp, "w") as f:
+            json.dump(marker, f, indent=2)
+        os.replace(tmp, os.path.join(path, REJECT_MARKER))
+    except OSError as e:
+        # quarantine is bookkeeping: failing to write the marker must not
+        # take the deployment loop down (the in-memory seen set still
+        # prevents re-attempts this process)
+        warnings.warn(f"could not quarantine {path}: {e}", stacklevel=2)
+    obs.event("deploy_quarantined", path=path, reason=reason)
+
+
+def read_quarantine(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(os.path.join(path, REJECT_MARKER)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class CheckpointPublisher:
+    """Trainer-side publisher: counters + fail-soft wrapper over
+    :func:`publish_params` (a publish failure must cost one warning, never
+    the training run)."""
+
+    def __init__(self, publish_dir: str,
+                 registry: Optional[obs.MetricsRegistry] = None):
+        self.publish_dir = os.path.abspath(publish_dir)
+        reg = registry if registry is not None else obs.get_registry()
+        self._m_published = reg.counter(
+            "deploy_published_total",
+            "checkpoint publications landed (atomic rename completed)")
+        self._m_failures = reg.counter(
+            "deploy_publish_failures_total",
+            "publish attempts that raised (training continued)")
+
+    def publish(self, step: int, params,
+                val_metrics: Optional[Dict[str, float]] = None,
+                extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+        """Publish; returns the publication path, or None on failure (warned
+        and counted — the trainer keeps training)."""
+        try:
+            path = publish_params(self.publish_dir, step, params,
+                                  val_metrics=val_metrics, extra=extra)
+        except Exception as e:
+            self._m_failures.inc()
+            warnings.warn(
+                f"checkpoint publication at step {step} failed "
+                f"({type(e).__name__}: {e}) — training continues; the "
+                f"serving side simply never sees this step",
+                stacklevel=2,
+            )
+            obs.event("deploy_publish_failed", step=int(step),
+                      error=type(e).__name__)
+            return None
+        self._m_published.inc()
+        return path

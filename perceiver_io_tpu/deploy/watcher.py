@@ -1,0 +1,467 @@
+"""The serving-side deployment loop: watch → gate → gated hot-swap.
+
+``CheckpointWatcher`` polls a publish directory for complete, non-quarantined
+publications (atomic-rename format, ``deploy/publication.py``).
+``ModelDeployer`` drives the loop: every new publication is loaded, run
+through the :class:`~perceiver_io_tpu.deploy.gate.AdmissionGate` *before any
+serving surface hears about it*, and only a passing tree flows into the swap
+target. Failure at any layer quarantines the publication (sticky marker +
+``deploy_rejected_total{reason}``) so it is never re-attempted — by this
+process or any other.
+
+Two swap targets cover the serving topologies:
+
+- :class:`EngineSwapTarget` — a single in-process ``ServingEngine`` /
+  ``MLMServer``: hot-swap via ``update_params`` (re-cast/re-quantized under
+  the engine's serving mode — int8w fleets re-quantize here), then BAKE:
+  watch the engine's SLO burn and breaker for a window; regression swaps the
+  previous tree straight back (kept in memory — rollback is an install, not
+  a load).
+- :class:`RouterSwapTarget` — the multi-replica fabric: the publication
+  flows into ``Router.rolling_update`` as a ``{"kind": "publication"}``
+  params spec (each replica loads it digest-verified), one replica at a
+  time with the r12 bake window; post-swap SLO-burn/breaker regression rolls
+  the WHOLE fleet back to the incumbent (the router's own auto-rollback).
+
+``deploy.swap`` is a ``PIT_FAULTS`` site: an injected raise fails the swap
+(rollback + quarantine) — every failure path of the loop is drillable.
+
+The deployer runs on a daemon thread (``start()``/``stop()``); ``stop()``
+WAITS for an in-progress deployment to finish, so a SIGTERM drain never
+exits mid-swap — the fleet is always wholly on one tree (``cli/serve.py``
+wires this into its drain path).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import perceiver_io_tpu.obs as obs
+from perceiver_io_tpu.deploy.gate import REASONS, AdmissionGate
+from perceiver_io_tpu.deploy.publication import (
+    PublicationInfo,
+    list_publications,
+    load_publication,
+    quarantine,
+)
+from perceiver_io_tpu.resilience import faults
+
+
+class CheckpointWatcher:
+    """Detects new publications: complete (manifest present — i.e. the
+    atomic rename landed), not quarantined, step above ``min_step`` and not
+    seen before. Pure detection; the deployer owns judgment."""
+
+    def __init__(self, publish_dir: str, min_step: int = -1):
+        self.publish_dir = publish_dir
+        self.min_step = min_step
+        self._seen: set = set()
+
+    def poll(self) -> List[PublicationInfo]:
+        """New publications in step order (each returned exactly once)."""
+        fresh = []
+        for info in list_publications(self.publish_dir):
+            if info.step <= self.min_step or info.step in self._seen:
+                continue
+            self._seen.add(info.step)
+            fresh.append(info)
+        return fresh
+
+
+def swap_window_stats(completions, swap_times, window_s: float = 0.5):
+    """Attribute request latencies to swap windows: the per-swap latency
+    *blip* methodology ``tools/deploy_bench.py`` and ``tools/load_bench.py
+    --publish_every_s`` share (PERF.md §Deployment).
+
+    ``completions``: ``(t_done_monotonic, latency_s)`` pairs for every
+    delivered request; ``swap_times``: one entry per completed swap — a
+    monotonic stamp, or a ``(t_start, t_end)`` interval (the honest form
+    for fleet rolls, whose install-plus-bake spans seconds: a point stamp
+    at the end would misattribute the early replicas' installs to steady
+    state). A request belongs to a swap window when it completed within
+    ±``window_s`` of the stamp/interval. Returns p99s in SECONDS:
+    steady-state (outside every window), per-swap, and the worst swap
+    window — the blip is ``p99_swap / p99_steady``.
+    """
+
+    def p99(vals):
+        v = sorted(vals)
+        return v[min(len(v) - 1, int(0.99 * len(v)))] if v else None
+
+    spans = [ts if isinstance(ts, (tuple, list)) else (ts, ts)
+             for ts in swap_times]
+    steady, per_swap = [], [[] for _ in spans]
+    for t_done, lat in completions:
+        hit = False
+        for i, (lo, hi) in enumerate(spans):
+            if lo - window_s <= t_done <= hi + window_s:
+                per_swap[i].append(lat)
+                hit = True
+        if not hit:
+            steady.append(lat)
+    swap_p99s = [p99(v) for v in per_swap]
+    observed = [p for p in swap_p99s if p is not None]
+    return {
+        "window_s": window_s,
+        "steady_n": len(steady),
+        "p99_steady_s": p99(steady),
+        "per_swap_p99_s": swap_p99s,
+        "per_swap_n": [len(v) for v in per_swap],
+        "p99_swap_s": max(observed) if observed else None,
+    }
+
+
+# -- swap targets -------------------------------------------------------------
+
+
+def _bake_engines(engines, bake_s: float, burn_threshold: float,
+                  poll_s: float, min_requests: int) -> Optional[str]:
+    """Post-swap observation over in-process engines (the single-process
+    sibling of ``Router._bake``): returns a regression reason or None.
+    ``min_requests`` > 0 extends the window (up to 4x) until that much
+    post-swap traffic was actually served — an idle bake proves nothing."""
+    engines = list(engines)
+    t0 = time.monotonic()
+    base = sum(e.requests_served for e in engines)
+    while True:
+        for e in engines:
+            if e.breaker is not None and e.breaker.state == "open":
+                return "breaker opened post-swap"
+            t = e.slo_tracker
+            if (t is not None and t.sample_count() >= t.slo.min_samples):
+                burn = t.burn_rate()
+                if burn > burn_threshold:
+                    return (f"SLO burn {burn:.2f} exceeded threshold "
+                            f"{burn_threshold:g} post-swap")
+        now = time.monotonic()
+        if now - t0 >= bake_s:
+            served = sum(e.requests_served for e in engines) - base
+            if (min_requests <= 0 or served >= min_requests
+                    or now - t0 >= 4 * bake_s):
+                return None
+        time.sleep(poll_s)
+
+
+class EngineSwapTarget:
+    """Gated hot-swap into one in-process engine family (``ServingEngine``
+    or ``MLMServer`` — anything with ``update_params``). Keeps the incumbent
+    RAW tree in memory so a failed bake rolls back instantly.
+
+    ``last_swap_installed`` / ``last_swap_rolled_back`` record what the most
+    recent :meth:`swap` actually DID — the deployer classifies a refusal as
+    a rollback only when a tree was installed and the incumbent restored,
+    never as a phantom."""
+
+    def __init__(self, target, incumbent, bake_s: float = 1.0,
+                 burn_threshold: float = 2.0, poll_s: float = 0.05,
+                 min_bake_requests: int = 0,
+                 engines: Optional[List[Any]] = None):
+        self.target = target
+        self._current = incumbent
+        self.bake_s = bake_s
+        self.burn_threshold = burn_threshold
+        self.poll_s = poll_s
+        self.min_bake_requests = min_bake_requests
+        self.last_swap_installed = False
+        self.last_swap_rolled_back = False
+        if engines is None:
+            # an MLMServer exposes its three engines; a ServingEngine is one
+            engines = ([target.engine, target.encoder, target.decoder]
+                       if hasattr(target, "encoder") else [target])
+        self._engines = engines
+
+    @property
+    def current(self):
+        return self._current
+
+    def swap(self, tree, info: PublicationInfo) -> Tuple[bool, Optional[str]]:
+        self.last_swap_installed = False
+        self.last_swap_rolled_back = False
+        prev = self._current
+        self.target.update_params(tree)  # raising here installed NOTHING
+        self.last_swap_installed = True
+        try:
+            reason = _bake_engines(self._engines, self.bake_s,
+                                   self.burn_threshold, self.poll_s,
+                                   self.min_bake_requests)
+        except Exception as e:
+            # the candidate IS installed at this point: a bake that dies
+            # (engine closed under a concurrent drain, …) must not leave a
+            # quarantined tree serving — roll back, then report
+            reason = f"bake failed: {type(e).__name__}: {e}"
+        if reason is not None:
+            # instant rollback: the previous raw tree re-prepares and
+            # installs between micro-batches, exactly like the swap did
+            try:
+                self.target.update_params(prev)
+                self.last_swap_rolled_back = True
+            except Exception as e:
+                reason += (f"; ROLLBACK FAILED ({type(e).__name__}: {e}) — "
+                           "the rejected candidate may still be serving")
+            return False, reason
+        self._current = tree
+        return True, None
+
+
+class RouterSwapTarget:
+    """Gated rollout through ``Router.rolling_update``: replicas realize the
+    ``{"kind": "publication", "path": ...}`` spec themselves (digest-verified
+    load on the replica — ``serving/replica.py``), the router bakes each
+    swap and auto-rolls the whole fleet back on regression."""
+
+    def __init__(self, router, bake_s: float = 1.0,
+                 burn_threshold: float = 2.0, poll_s: float = 0.05,
+                 min_bake_requests: int = 0,
+                 update_timeout_s: Optional[float] = None,
+                 spec_fn: Optional[Callable[[PublicationInfo], Dict]] = None):
+        self.router = router
+        self.bake_s = bake_s
+        self.burn_threshold = burn_threshold
+        self.poll_s = poll_s
+        self.min_bake_requests = min_bake_requests
+        self.update_timeout_s = update_timeout_s
+        self.spec_fn = spec_fn
+        self.last_report: Optional[Dict[str, Any]] = None
+        self.last_swap_installed = False
+        self.last_swap_rolled_back = False
+
+    def swap(self, tree, info: PublicationInfo) -> Tuple[bool, Optional[str]]:
+        self.last_swap_installed = False
+        self.last_swap_rolled_back = False
+        spec = (self.spec_fn(info) if self.spec_fn is not None
+                else {"kind": "publication", "path": info.path,
+                      "step": info.step})
+        report = self.router.rolling_update(
+            spec, bake_s=self.bake_s, burn_threshold=self.burn_threshold,
+            poll_s=self.poll_s, min_bake_requests=self.min_bake_requests,
+            update_timeout_s=self.update_timeout_s,
+        )
+        self.last_report = report
+        self.last_swap_installed = bool(report.get("updated"))
+        self.last_swap_rolled_back = bool(report.get("rolled_back"))
+        if report.get("rolled_back"):
+            return False, report.get("reason") or "fleet rolled back"
+        if not report.get("updated"):
+            # nothing installed anywhere — a failed swap, NOT a rollback
+            return False, "no replica accepted the update"
+        return True, None
+
+
+# -- the loop -----------------------------------------------------------------
+
+
+class ModelDeployer:
+    """watch → load → gate → gated swap, with quarantine on every failure.
+
+    ``target.swap(tree, info) -> (ok, reason)`` owns rollback semantics (see
+    the two targets above); the deployer owns detection, gating, quarantine,
+    counters, and the thread. ``on_deployed(record)`` fires after every
+    processed publication — ``record["action"]`` is ``swapped`` /
+    ``rejected`` / ``rolled_back``.
+    """
+
+    def __init__(
+        self,
+        publish_dir: str,
+        gate,
+        target,
+        poll_s: float = 2.0,
+        name: str = "deploy",
+        registry: Optional[obs.MetricsRegistry] = None,
+        on_deployed: Optional[Callable[[Dict[str, Any]], None]] = None,
+        min_step: int = -1,
+    ):
+        """``gate``: an :class:`AdmissionGate`, or a zero-arg factory for
+        one — the factory is resolved LAZILY on the watcher thread at the
+        first poll, keeping the gate's golden-program compile off the
+        caller's startup path (``cli/serve.py`` must serve immediately even
+        when no publication ever arrives). ``min_step``: publications at or
+        below this step are ignored — a restarted process passes the step
+        of the checkpoint it booted from, so the backlog of older
+        publications is neither replayed onto traffic nor mislabeled
+        rejected."""
+        self.watcher = CheckpointWatcher(publish_dir, min_step=min_step)
+        self._gate = gate if hasattr(gate, "check") else None
+        self._gate_factory = None if self._gate is not None else gate
+        self.target = target
+        self.poll_s = poll_s
+        self.name = name
+        self.on_deployed = on_deployed
+        self.history: List[Dict[str, Any]] = []
+        self._busy = threading.Lock()  # held across one whole deployment
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        reg = registry if registry is not None else obs.get_registry()
+        self._m_seen = reg.counter(
+            "deploy_publications_seen_total",
+            "complete publications the watcher detected", {"deploy": name})
+        self._m_swaps = reg.counter(
+            "deploy_swaps_total",
+            "gated swaps that completed and baked healthy", {"deploy": name})
+        self._m_rollbacks = reg.counter(
+            "deploy_rollbacks_total",
+            "swaps rolled back on post-swap regression", {"deploy": name})
+        self._m_rejected = {
+            reason: reg.counter(
+                "deploy_rejected_total",
+                "publications refused before or after the swap, by reason "
+                "(each is quarantined and never re-attempted)",
+                {"deploy": name, "reason": reason})
+            for reason in REASONS
+        }
+        self._m_step = reg.gauge(
+            "deploy_current_step",
+            "step of the newest publication serving traffic (0 = the boot "
+            "tree)", {"deploy": name})
+
+    @property
+    def gate(self) -> AdmissionGate:
+        if self._gate is None:
+            self._gate = self._gate_factory()
+        return self._gate
+
+    # -- one publication -----------------------------------------------------
+
+    def _reject(self, info: PublicationInfo, reason: str, detail: str,
+                rolled_back: bool = False) -> Dict[str, Any]:
+        reason = reason if reason in REASONS else "gate_error"
+        quarantine(info.path, f"{reason}: {detail}")
+        self._m_rejected[reason].inc()
+        if rolled_back:
+            self._m_rollbacks.inc()
+        return {
+            "action": "rolled_back" if rolled_back else "rejected",
+            "step": info.step, "reason": reason, "detail": detail,
+        }
+
+    def deploy_once(self, info: PublicationInfo) -> Dict[str, Any]:
+        """Process ONE publication end to end; returns the history record."""
+        t0 = time.monotonic()
+        record: Dict[str, Any]
+        try:
+            tree, manifest = load_publication(info.path, verify_digest=False)
+        except Exception as e:  # unreadable payload (tampered npz, IO error)
+            record = self._reject(info, "unreadable",
+                                  f"{type(e).__name__}: {e}")
+        else:
+            result = self.gate.check(tree, manifest)
+            if not result.ok:
+                record = self._reject(info, result.reason or "gate_error",
+                                      result.detail)
+                record["gate_s"] = result.seconds
+            else:
+                t_swap = time.monotonic()
+                try:
+                    faults.inject("deploy.swap")  # chaos hook
+                    ok, reason = self.target.swap(tree, info)
+                except Exception as e:
+                    # the targets own rollback: an exception ESCAPING swap
+                    # means nothing was installed (update_params raised, or
+                    # the injected pre-swap fault fired) or the target
+                    # already restored the incumbent — record a failed
+                    # swap, not a rollback
+                    reason = f"{type(e).__name__}: {e}"
+                    record = self._reject(info, "swap_failed", reason)
+                else:
+                    if ok:
+                        self.gate.set_incumbent(tree)
+                        self._m_swaps.inc()
+                        self._m_step.set(float(info.step))
+                        record = {"action": "swapped", "step": info.step,
+                                  "reason": None, "detail": ""}
+                    else:
+                        # a refusal is a ROLLBACK only if the target
+                        # actually installed something and restored the
+                        # incumbent; "no replica accepted" must not count
+                        # phantom rollbacks
+                        installed = getattr(self.target,
+                                            "last_swap_installed", True)
+                        record = self._reject(
+                            info,
+                            "post_swap_regression" if installed
+                            else "swap_failed",
+                            reason or "",
+                            rolled_back=getattr(
+                                self.target, "last_swap_rolled_back",
+                                installed))
+                record["gate_s"] = result.seconds
+                record["t_swap"] = t_swap  # install START (fleet rolls can
+                # span seconds of bake; blip attribution needs the interval)
+                record["swap_s"] = time.monotonic() - t_swap
+        record["t_done"] = time.monotonic()
+        record["seconds"] = record["t_done"] - t0
+        self.history.append(record)
+        obs.event("deploy_result", deploy=self.name, **{
+            k: record.get(k) for k in ("action", "step", "reason", "detail")})
+        if self.on_deployed is not None:
+            try:
+                self.on_deployed(dict(record))
+            except Exception:
+                pass  # a callback must never take the loop down
+        return record
+
+    def poll_once(self) -> List[Dict[str, Any]]:
+        """One synchronous sweep (the loop body; tests call it directly)."""
+        records = []
+        for info in self.watcher.poll():
+            self._m_seen.inc()
+            with self._busy:
+                if self._stop.is_set():
+                    break
+                records.append(self.deploy_once(info))
+        return records
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ModelDeployer":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name=f"{self.name}-watcher", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.poll_once()
+            except Exception as e:  # the loop survives anything
+                obs.event("deploy_loop_error", deploy=self.name,
+                          error=f"{type(e).__name__}: {e}")
+
+    def stop(self, timeout_s: float = 120.0) -> bool:
+        """Stop the loop, WAITING for an in-progress deployment: on return
+        the fleet is wholly on one tree (swap completed or rolled back) —
+        the SIGTERM-drain contract. Returns False if the wait timed out."""
+        self._stop.set()
+        ok = True
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+            ok = not self._thread.is_alive()
+            if ok:
+                self._thread = None
+        else:
+            # programmatic (never-started) use: just ensure no deploy_once
+            # is mid-flight on some caller thread
+            ok = self._busy.acquire(timeout=timeout_s)
+            if ok:
+                self._busy.release()
+        return ok
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "swaps": int(self._m_swaps.value),
+            "rollbacks": int(self._m_rollbacks.value),
+            "rejected": {r: int(c.value)
+                         for r, c in self._m_rejected.items() if c.value},
+            "current_step": int(self._m_step.value),
+            "seen": int(self._m_seen.value),
+        }
+
+    def __enter__(self) -> "ModelDeployer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

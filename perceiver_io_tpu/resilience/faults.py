@@ -24,7 +24,15 @@ Instrumented sites (grep for ``faults.inject`` / ``faults.corrupt``):
   failure);
 - ``trainer.dispatch`` — before the trainer's train-step dispatch;
 - ``trainer.metrics`` — ``corrupt`` hook over the train-step metrics (NaN
-  loss injection: the signature of a poisoned step).
+  loss injection: the signature of a poisoned step);
+- ``deploy.publish`` / ``deploy.gate`` / ``deploy.swap`` — the train→serve
+  deployment loop (``perceiver_io_tpu.deploy``): checkpoint publication
+  (``fire`` hook: raise kinds AND nan corruption of the published tree),
+  the serving-side admission gate, and the fleet hot-swap.
+
+The registered sites live in :data:`SITES`; :func:`parse_spec` validates
+every clause against them (and the kind set), so a typo'd drill fails
+loudly at install instead of silently injecting nothing.
 
 Env gating for whole-process chaos runs (no code changes)::
 
@@ -47,6 +55,37 @@ import numpy as np
 ENV_VAR = "PIT_FAULTS"
 
 _KINDS = ("transient", "fatal", "hang", "slow", "nan")
+
+# The registered instrumentation sites. parse_spec VALIDATES against this
+# set: a typo'd PIT_FAULTS drill must fail loudly at install, not silently
+# inject nothing while the operator believes chaos is running. Sites in
+# _SUFFIXED also accept a ".<qualifier>" suffix (the per-engine drill
+# targets, e.g. ``engine.dispatch.replica0-infer``).
+SITES = (
+    "engine.dispatch",
+    "engine.complete",
+    "trainer.dispatch",
+    "trainer.metrics",
+    # the train->serve deployment loop (perceiver_io_tpu.deploy): publish
+    # (raise = a publish dying mid-write; nan = a poisoned tree whose digest
+    # still verifies), admission gate, and the fleet swap itself
+    "deploy.publish",
+    "deploy.gate",
+    "deploy.swap",
+)
+_SUFFIXED = ("engine.dispatch", "engine.complete")
+
+
+def validate_site(site: str) -> str:
+    """Return ``site`` if registered (exactly, or a registered per-engine
+    prefix); raise ValueError naming the valid options otherwise."""
+    if site in SITES or any(site.startswith(s + ".") and len(site) > len(s) + 1
+                            for s in _SUFFIXED):
+        return site
+    raise ValueError(
+        f"unknown fault site {site!r}; one of {SITES} "
+        f"(or {', '.join(s + '.<engine-name>' for s in _SUFFIXED)})"
+    )
 
 
 class InjectedTransientError(RuntimeError):
@@ -123,39 +162,63 @@ class FaultInjector:
 
     def inject(self, site: str) -> None:
         for spec in self._tick(site, ("transient", "fatal", "hang", "slow")):
-            if spec.kind == "slow":
-                _interruptible_sleep(spec.delay_s)
-            elif spec.kind == "hang":
-                # the wedged tunnel: block until the test un-wedges it (or a
-                # bounded delay, so a forgotten release can't hang a suite)
-                if spec.release is not None:
-                    spec.release.wait(spec.delay_s or None)
-                else:
-                    _interruptible_sleep(spec.delay_s or 3600.0)
-            elif spec.kind == "transient":
-                raise InjectedTransientError(
-                    f"injected transient fault at {site!r} "
-                    f"(call {self.calls(site)})"
-                )
-            else:
-                raise InjectedFatalError(
-                    f"injected fatal fault at {site!r} (call {self.calls(site)})"
-                )
+            self._execute(spec, site)
 
     def corrupt(self, site: str, payload):
         """NaN-fill the floating leaves of ``payload`` when a ``nan`` spec
         fires on this call of ``site``; otherwise return it unchanged."""
         if not self._tick(site, ("nan",)):
             return payload
-        import jax
+        return _poison_tree(payload)
 
-        def poison(x):
-            a = np.asarray(x)
-            if np.issubdtype(a.dtype, np.floating):
-                return np.full_like(a, np.nan)
-            return x
+    def fire(self, site: str, payload):
+        """Combined hook for sites that support BOTH raise-type faults and
+        payload corruption (``deploy.publish``): ONE tick of ``site`` per
+        call, every spec kind considered, so a drill's 1-based call indices
+        count real calls — not the two internal ticks a separate
+        inject+corrupt pair would burn. Returns the (possibly corrupted)
+        payload, or raises/sleeps/hangs per the due raise-kind specs."""
+        due = self._tick(site, _KINDS)
+        for spec in due:
+            if spec.kind != "nan":
+                self._execute(spec, site)
+        if any(spec.kind == "nan" for spec in due):
+            payload = _poison_tree(payload)
+        return payload
 
-        return jax.tree.map(poison, payload)
+    def _execute(self, spec: FaultSpec, site: str) -> None:
+        """Run one due raise-kind spec (shared by inject and fire, so the
+        hang/slow/raise semantics cannot drift between the two hooks)."""
+        if spec.kind == "slow":
+            _interruptible_sleep(spec.delay_s)
+        elif spec.kind == "hang":
+            # the wedged tunnel: block until the test un-wedges it (or a
+            # bounded delay, so a forgotten release can't hang a suite)
+            if spec.release is not None:
+                spec.release.wait(spec.delay_s or None)
+            else:
+                _interruptible_sleep(spec.delay_s or 3600.0)
+        elif spec.kind == "transient":
+            raise InjectedTransientError(
+                f"injected transient fault at {site!r} "
+                f"(call {self.calls(site)})"
+            )
+        else:
+            raise InjectedFatalError(
+                f"injected fatal fault at {site!r} (call {self.calls(site)})"
+            )
+
+
+def _poison_tree(payload):
+    import jax
+
+    def poison(x):
+        a = np.asarray(x)
+        if np.issubdtype(a.dtype, np.floating):
+            return np.full_like(a, np.nan)
+        return x
+
+    return jax.tree.map(poison, payload)
 
 
 def _interruptible_sleep(seconds: float) -> None:
@@ -195,6 +258,11 @@ def parse_spec(text: str) -> FaultInjector:
     for clause in filter(None, (c.strip() for c in text.split(";"))):
         try:
             site, rest = clause.split(":", 1)
+            # validate EAGERLY against the registered site and kind sets: a
+            # typo'd drill must fail at install with the valid options named,
+            # not silently inject nothing (the kind check lives in FaultSpec;
+            # both surface through the clause-naming ValueError below)
+            validate_site(site)
             kind, _, when = rest.partition("@")
             delay = 0.0
             if "@delay:" in when:
@@ -246,4 +314,14 @@ def corrupt(site: str, payload):
         install_from_env()
     if _ACTIVE is not None:
         return _ACTIVE.corrupt(site, payload)
+    return payload
+
+
+def fire(site: str, payload):
+    """Combined raise+corrupt hook (one site tick per call — see
+    :meth:`FaultInjector.fire`); returns the possibly-corrupted payload."""
+    if not _ENV_CHECKED:
+        install_from_env()
+    if _ACTIVE is not None:
+        return _ACTIVE.fire(site, payload)
     return payload

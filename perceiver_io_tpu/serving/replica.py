@@ -22,9 +22,10 @@ are semantic, not syntactic:
 - **admin verbs are the rollout surface**: ``/admin/drain`` stops admission
   and returns once accepted work finished (``ServingEngine.drain``),
   ``/admin/resume`` re-opens, ``/admin/update_params`` hot-swaps the served
-  tree from a params *spec* (checkpoint path / reinit seed / scale factor /
-  ``rollback`` to the previous tree — kept in memory exactly for the
-  router's auto-rollback), ``/admin/quit`` exits cleanly.
+  tree from a params *spec* (checkpoint path / deploy publication dir
+  (digest-verified on load) / reinit seed / scale factor / ``rollback`` to
+  the previous tree — kept in memory exactly for the router's
+  auto-rollback), ``/admin/quit`` exits cleanly.
 - **readiness is explicit** (``GET /statz`` → ``replica.ready``): true only
   once every engine's warm pool is live (the ``engine_ready`` gauges), which
   is what gates a (re)started replica's join — a replica mid-warmup is
@@ -233,7 +234,7 @@ class ReplicaApp:
             elif kind == "scale":
                 factor = float(spec["factor"])
                 tree = _scale_tree(self._params, factor)
-            elif kind in ("reinit", "checkpoint"):
+            elif kind in ("reinit", "checkpoint", "publication"):
                 if self._params_factory is None:
                     raise ValueError(
                         f"this replica cannot realize {kind!r} specs "
@@ -243,7 +244,7 @@ class ReplicaApp:
             else:
                 raise ValueError(
                     f"unknown params spec kind {kind!r}; one of "
-                    "rollback|scale|reinit|checkpoint"
+                    "rollback|scale|reinit|checkpoint|publication"
                 )
             for engine in self.engines.values():
                 engine.update_params(tree)
@@ -616,6 +617,18 @@ class LocalReplica:
 # -- the replica process entry point -----------------------------------------
 
 
+def _load_publication_spec(spec: Dict[str, Any]):
+    """Realize a ``{"kind": "publication", "path": DIR}`` params spec: the
+    deploy-loop rollout surface (``perceiver_io_tpu.deploy``). The load
+    VERIFIES the manifest's content digest on the replica — even with the
+    router-side admission gate already passed, a tree corrupted between
+    gate and install raises here instead of serving."""
+    from perceiver_io_tpu.deploy import load_publication
+
+    tree, _ = load_publication(spec["path"], verify_digest=True)
+    return tree
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         description="one serving replica behind the router tier "
@@ -678,6 +691,8 @@ def _build_app(args):
         )
 
         def params_factory(spec):
+            if spec.get("kind") == "publication":
+                return _load_publication_spec(spec)
             if spec.get("kind") != "checkpoint":
                 raise ValueError(f"checkpoint replica got spec {spec!r}")
             _, new_params, _ = load_mlm_checkpoint(
@@ -706,6 +721,8 @@ def _build_app(args):
         params = init_params(args.seed)
 
         def params_factory(spec):
+            if spec.get("kind") == "publication":
+                return _load_publication_spec(spec)
             if spec.get("kind") != "reinit":
                 raise ValueError(f"preset replica got spec {spec!r}")
             return init_params(int(spec.get("seed", 0)))

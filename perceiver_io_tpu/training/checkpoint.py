@@ -30,6 +30,44 @@ import orbax.checkpoint as ocp
 HPARAMS_FILE = "hparams.json"
 LAST_SUBDIR = "last"  # unconditional newest-state slot (preemption/crash)
 METRICS_FILE = "metrics.json"
+# Content-digest sidecar: {step: sha256-over-params} per manager directory,
+# written at save() time and VERIFIED by restore_train_state(prefer_latest=
+# True) before a step is trusted — extending the truncated-newest fallback
+# (a partial save that fails to restore) to SILENT bit corruption (a save
+# that restores fine but holds different bytes than were written). Same
+# digest definition the deploy publications carry (utils/treepath).
+DIGESTS_FILE = "digests.json"
+
+
+def _record_digest(directory: str, step: int, params) -> None:
+    """Append ``{step: digest}`` to the sidecar (atomic tmp+replace; single
+    process only — a multi-host global tree is not addressable from one
+    process, and every host racing one json would corrupt it anyway)."""
+    if jax.process_count() > 1:
+        return
+    from perceiver_io_tpu.utils.treepath import tree_digest
+
+    digest = tree_digest(jax.device_get(params))
+    path = os.path.join(directory, DIGESTS_FILE)
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        data = {}
+    data[str(int(step))] = digest
+    os.makedirs(directory, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)
+
+
+def _expected_digest(directory: str, step: int) -> Optional[str]:
+    try:
+        with open(os.path.join(directory, DIGESTS_FILE)) as f:
+            return json.load(f).get(str(int(step)))
+    except (OSError, ValueError):
+        return None  # no sidecar (pre-digest checkpoints): nothing to check
 
 
 def _to_save_tree(state) -> Dict[str, Any]:
@@ -124,6 +162,8 @@ class CheckpointManager:
             ),
         )
         self._last_mngr.wait_until_finished()
+        _record_digest(os.path.join(self.directory, LAST_SUBDIR),
+                       step, state.params)
 
     def save(self, step: int, state, metrics: Dict[str, float]) -> bool:
         """Save if ``metrics[monitor]`` ranks in the top-k. Returns whether a
@@ -136,7 +176,7 @@ class CheckpointManager:
             )
         # item name 'val_metrics': orbax reserves 'metrics' for itself on
         # the release this runs under (RESERVED_ITEM_NAMES)
-        return self._mngr.save(
+        saved = self._mngr.save(
             int(step),
             args=ocp.args.Composite(
                 state=ocp.args.StandardSave(_to_save_tree(state)),
@@ -144,6 +184,12 @@ class CheckpointManager:
             ),
             metrics=metrics,
         )
+        if saved:
+            # the digest hashes the IN-MEMORY tree being saved (the intended
+            # content), so it needs no wait on the async write — a restore
+            # that later hashes differently read corrupted bytes
+            _record_digest(self.directory, step, state.params)
+        return saved
 
     def wait(self) -> None:
         """Block until in-flight async saves land (call before reading)."""
@@ -213,6 +259,19 @@ class CheckpointManager:
 
 
 # -- module-level restore helpers (no manager required) ---------------------
+
+
+def resolve_checkpoint_step(directory: str, step: Optional[int] = None,
+                            monitor: str = "val_loss",
+                            mode: str = "min") -> int:
+    """The step a param restore from ``directory`` would use (explicit →
+    best → latest) WITHOUT reading any arrays — e.g. the deploy watcher's
+    ``min_step`` floor, so a restarted serve process never replays
+    publications older than the checkpoint it booted from."""
+    if step is not None:
+        return int(step)
+    with _read_manager(directory, monitor, mode) as mngr:
+        return _resolve_step(mngr, None, directory)
 
 
 def load_hparams(directory: str) -> Dict[str, Any]:
@@ -286,9 +345,10 @@ def restore_train_state(
             errors = []
             for cand_step, source in candidates:
                 use = last_mngr if source == "last" else mngr
+                cand_dir = last_dir if source == "last" \
+                    else os.path.abspath(directory)
                 try:
                     restored = use.restore(cand_step, args=restore_args)["state"]
-                    return _from_save_tree(restored, like_state)
                 except Exception as e:  # corrupt/partial step dir
                     errors.append(e)
                     warnings.warn(
@@ -298,6 +358,31 @@ def restore_train_state(
                         f"to the previous checkpoint",
                         stacklevel=2,
                     )
+                    continue
+                # digest sidecar: a restore can SUCCEED while holding
+                # silently corrupted bytes — verify the params content
+                # against the digest recorded at save time before trusting
+                # the step (no sidecar entry = pre-digest checkpoint: trust)
+                expected = (_expected_digest(cand_dir, cand_step)
+                            if jax.process_count() == 1 else None)
+                if expected is not None:
+                    from perceiver_io_tpu.utils.treepath import tree_digest
+
+                    got = tree_digest(jax.device_get(restored["params"]))
+                    if got != expected:
+                        err = ValueError(
+                            f"checkpoint step {cand_step} ({source} slot) "
+                            f"restored but its params digest {got[:12]} does "
+                            f"not match the save-time sidecar "
+                            f"{expected[:12]} — silent corruption"
+                        )
+                        errors.append(err)
+                        warnings.warn(
+                            f"{err}; falling back to the previous checkpoint",
+                            stacklevel=2,
+                        )
+                        continue
+                return _from_save_tree(restored, like_state)
             raise errors[-1]
     with _read_manager(directory, monitor, mode) as mngr:
         step = _resolve_step(mngr, step, directory)

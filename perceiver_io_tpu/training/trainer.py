@@ -131,6 +131,17 @@ class TrainerConfig:
     # transient failure escaping the per-dispatch retries, auto-resume from
     # the newest checkpoint up to this many total attempts.
     fit_attempts: int = 1
+    # CONTINUOUS DEPLOYMENT (perceiver_io_tpu.deploy, PERF.md §Deployment):
+    # every publish_every_n_steps optimizer steps, atomically publish the
+    # CURRENT params to publish_dir with a manifest (step, val metrics,
+    # content digest, package version) — the trainer half of the train→serve
+    # loop. The serving side (cli/serve.py --watch_checkpoints) admission-
+    # gates each publication before any replica sees it. Publication is
+    # fail-soft: a failed publish warns and counts, never kills the run.
+    # Single-process only (publishing device_gets the full tree; multi-host
+    # global arrays are not host-addressable from one process).
+    publish_dir: Optional[str] = None
+    publish_every_n_steps: int = 0
     # COLD START (perceiver_io_tpu.aot, PERF.md §Cold start): point jax's
     # persistent compilation cache here so the train/eval step compiles
     # become disk hits across restarts/resumes — the tier the AOT executable
@@ -151,6 +162,13 @@ class TrainerConfig:
             )
         if self.fit_attempts < 1:
             raise ValueError(f"fit_attempts must be >= 1, got {self.fit_attempts}")
+        if (self.publish_dir is None) != (self.publish_every_n_steps <= 0):
+            raise ValueError(
+                "checkpoint publication needs BOTH publish_dir and "
+                "publish_every_n_steps > 0 (got "
+                f"publish_dir={self.publish_dir!r}, "
+                f"publish_every_n_steps={self.publish_every_n_steps})"
+            )
 
     @property
     def recovery_active(self) -> bool:
@@ -219,6 +237,19 @@ class Trainer:
                 "single-process only — multi-host runs recover by "
                 "restarting from the newest checkpoint (--resume)"
             )
+        self._publisher = None
+        if config.publish_dir:
+            if jax.process_count() > 1:
+                # publishing device_gets the FULL param tree; a multi-host
+                # global array is not addressable from one process — the
+                # multi-host deployment story is checkpoint-dir based
+                raise ValueError(
+                    "checkpoint publication (publish_dir) is single-process "
+                    "only"
+                )
+            from perceiver_io_tpu.deploy import CheckpointPublisher
+
+            self._publisher = CheckpointPublisher(config.publish_dir)
         self.mesh = mesh
         self.predict_hook = predict_hook
         self.tokens_per_example = tokens_per_example
@@ -321,6 +352,8 @@ class Trainer:
         self._retry_policy = RetryPolicy(
             max_retries=config.dispatch_error_retries)
         self._bad_streak = 0
+        self._last_val_metrics: Dict[str, float] = {}
+        self._last_train_loss = float("nan")
 
         self._selfprof = None
         if config.selfprofile_every_n_steps > 0:
@@ -629,8 +662,20 @@ class Trainer:
             return {}
         return {f"val_{k}": v / weight for k, v in totals.items()}
 
+    def _publish(self, step_i: int) -> None:
+        """Publish the CURRENT params (deploy.CheckpointPublisher — atomic,
+        manifest-carrying, fail-soft). Metrics in the manifest: the newest
+        validation pass plus the last logged train loss, so the serving-side
+        gate (and operators) can see what quality the tree claims."""
+        metrics = dict(self._last_val_metrics)
+        if np.isfinite(self._last_train_loss):
+            metrics.setdefault("train_loss", float(self._last_train_loss))
+        self._publisher.publish(
+            step_i, jax.device_get(self.state.params), val_metrics=metrics)
+
     def _validate_and_checkpoint(self, step_i: int, val_loader) -> Dict[str, float]:
         val_metrics = self._run_eval(val_loader) if val_loader is not None else {}
+        self._last_val_metrics = dict(val_metrics)
         if val_metrics:
             self.logger.log_scalars(step_i, val_metrics)
         ckpt_metrics = dict(val_metrics)
@@ -848,6 +893,13 @@ class Trainer:
                         self._validate_and_checkpoint(step_i, val_loader)
                         last_validated_step = step_i
                         window_start, window_steps = time.perf_counter(), 0
+
+                    # train→serve publication cadence (AFTER a same-boundary
+                    # eval, so the manifest carries the fresh val metrics)
+                    pn = cfg.publish_every_n_steps
+                    if (self._publisher is not None
+                            and step_i // pn > prev_step // pn):
+                        self._publish(step_i)
 
                     if cfg.max_steps is not None and step_i >= cfg.max_steps:
                         done = True

@@ -8,6 +8,11 @@ sharding specs resolving identically on the int8 tree) rides on it — so
 there is exactly ONE definition. Inlined rather than
 ``jax.tree_util.keystr(path, simple=True, separator='/')`` because not
 every jax build this runs under has the simple/separator kwargs.
+
+``tree_digest`` is the content digest over a param tree that the deploy
+subsystem's publication manifests (``perceiver_io_tpu.deploy``) and the
+checkpoint digest sidecars (``training/checkpoint.py``) both carry — one
+definition here so a digest computed at train time verifies at serve time.
 """
 
 from __future__ import annotations
@@ -24,3 +29,45 @@ def simple_keystr(path) -> str:
         else:
             parts.append(str(entry))
     return "/".join(parts)
+
+
+def flatten_named(tree) -> dict:
+    """``{simple_keystr(path): host numpy leaf}`` in sorted-path order —
+    the serialization form publications store and digests hash."""
+    import jax
+    import numpy as np
+
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return {
+        simple_keystr(path): np.asarray(jax.device_get(leaf))
+        for path, leaf in sorted(leaves, key=lambda pl: simple_keystr(pl[0]))
+    }
+
+
+def digest_named(named: dict) -> str:
+    """sha256 over an already-flattened ``{path: host array}`` dict (the
+    :func:`flatten_named` form) — callers that hold the flattened payload
+    anyway (publication writers) must not pay a second flatten + per-leaf
+    device fetch just to hash it."""
+    import hashlib
+
+    import numpy as np
+
+    h = hashlib.sha256()
+    for name in sorted(named):
+        a = np.ascontiguousarray(named[name])
+        if a.dtype.byteorder == ">":  # hash a platform-stable byte order
+            a = a.astype(a.dtype.newbyteorder("<"))
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def tree_digest(tree) -> str:
+    """sha256 over the tree's CONTENT: sorted key paths, dtypes, shapes, and
+    raw little-endian leaf bytes. Two trees digest equal iff they hold the
+    same values at the same paths — placement, donation state, and leaf
+    array type (np vs jax.Array) do not enter."""
+    return digest_named(flatten_named(tree))

@@ -486,6 +486,12 @@ def test_load_bench_dry_emits_schema_json_line():
         assert key in record["point_keys"], record
     assert record["phase_keys"] == [
         "admission", "queue", "assembly", "dispatch", "device", "complete"]
+    # the continuous-deployment ride-along (--publish_every_s) declares its
+    # block's keys; the block itself is null when the ride-along is off
+    assert record["deploy"] is None
+    for key in ("publishes", "swaps", "rejects", "rollbacks",
+                "p99_steady_ms", "p99_swap_ms", "per_swap_p99_ms"):
+        assert key in record["deploy_keys"], record
 
 
 def test_load_bench_cpu_sweep_shows_saturation_signature(tmp_path):
@@ -544,6 +550,65 @@ def test_load_bench_cpu_sweep_shows_saturation_signature(tmp_path):
             "admission", "queue", "assembly", "dispatch", "device",
             "complete"}
     assert 0.9 <= record["phase_sum_ratio"] <= 1.1, record["phase_sum_ratio"]
+
+
+def test_deploy_bench_dry_emits_schema_json_line():
+    """tools/deploy_bench.py --dry emits EXACTLY one JSON line declaring the
+    record + per-swap keys without touching any backend."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "deploy_bench.py"),
+         "--dry"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    record = json.loads(lines[0])
+    assert record["metric"] == "deploy_bench" and record["dry"] is True
+    for key in ("swaps", "rejects", "rollbacks", "lost_accepted",
+                "swap_cadence_s", "p99_steady_ms", "p99_swap_ms",
+                "blip_ratio", "per_swap"):
+        assert key in record["record_keys"], record
+    assert record["per_swap_keys"] == [
+        "step", "action", "gate_ms", "swap_ms", "p99_ms", "n_window"]
+
+
+def test_deploy_bench_cpu_gated_swaps_zero_loss(tmp_path):
+    """The deployment-loop acceptance contract: tools/deploy_bench.py --cpu
+    pushes N publications through gate + hot-swap under open-loop traffic
+    and emits ONE JSON line with every swap completed, ZERO lost accepted
+    requests, and the per-swap latency attribution populated."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "deploy_bench.py"),
+         "--cpu", "--swaps", "3", "--publish_every_s", "0.5",
+         "--calibration_waves", "1", "--rate_factor", "0.3"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    record = json.loads(lines[0])
+    assert record["metric"] == "deploy_bench" and record["backend"] == "cpu"
+    assert record["preset"] == "tiny" and record["mode"] == "engine"
+    # every publication passed the gate and swapped; none were lost to it
+    assert record["publishes"] == record["swaps"] == 3, record
+    assert record["rejects"] == 0 and record["rollbacks"] == 0, record
+    assert record["lost_accepted"] == 0 and record["failed"] == 0, record
+    assert record["completed"] > 0 and record["shed"] == 0, record
+    # attribution populated: a steady p99 plus a window around every swap
+    assert record["p99_steady_ms"] is not None, record
+    assert len(record["per_swap"]) == 3, record
+    for s in record["per_swap"]:
+        assert s["action"] == "swapped" and s["swap_ms"] > 0, s
+        assert s["n_window"] > 0, s
 
 
 def test_bench_backend_probe_emits_json_error_record():

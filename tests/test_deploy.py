@@ -1,0 +1,952 @@
+"""Continuous train→serve deployment loop: atomic publication (no torn
+reads), the admission gate's independent rejection layers (digest / finite /
+quality), sticky quarantine, post-swap rollback, the trainer's publish
+cadence, and the end-to-end chaos drill — trainer-published checkpoints
+flowing through gated rolling swaps into a live 3-replica fleet under
+open-loop traffic with zero lost accepted requests.
+
+Tier-1 coverage runs IN-PROCESS (trivial jitted engines, LocalReplica
+shims); the real-process train+serve drill is ``slow``-marked and names the
+tier-1 tests that retain its logic coverage.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import perceiver_io_tpu.obs as obs
+from perceiver_io_tpu.deploy import (
+    AdmissionGate,
+    CheckpointPublisher,
+    CheckpointWatcher,
+    DigestMismatchError,
+    EngineSwapTarget,
+    ModelDeployer,
+    RouterSwapTarget,
+    list_publications,
+    load_publication,
+    publish_params,
+    read_quarantine,
+    swap_window_stats,
+    tree_digest,
+)
+from perceiver_io_tpu.inference import ServingEngine
+from perceiver_io_tpu.resilience import (
+    FaultInjector,
+    FaultSpec,
+    InjectedTransientError,
+    faults,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tree(w: float = 2.0):
+    return {"w": np.float32(w), "b": np.zeros((3,), np.float32)}
+
+
+def _infer(p, x):
+    return x * p["w"] + p["b"]
+
+
+def _tamper(pub_path: str) -> None:
+    """Flip stored bytes under the manifest's nose (payload corruption
+    between publish and load)."""
+    npz = os.path.join(pub_path, "params.npz")
+    with np.load(npz) as z:
+        named = {k: z[k] for k in z.files}
+    first = sorted(named)[0]
+    named[first] = np.asarray(named[first]) + 1.0
+    with open(npz, "wb") as f:
+        np.savez(f, **named)
+
+
+@pytest.fixture
+def reg():
+    return obs.MetricsRegistry()
+
+
+@pytest.fixture
+def no_faults():
+    prev = faults.install(None)
+    yield
+    faults.install(prev)
+
+
+# -- digest + publication format ---------------------------------------------
+
+
+def test_tree_digest_stability_and_sensitivity():
+    t = {"a": {"kernel": np.arange(6, dtype=np.float32).reshape(2, 3)},
+         "bias": np.ones((3,), np.float32)}
+    d = tree_digest(t)
+    # stable across copies and array types (values define the digest)
+    import jax.numpy as jnp
+
+    assert tree_digest({"a": {"kernel": t["a"]["kernel"].copy()},
+                        "bias": jnp.ones((3,), jnp.float32)}) == d
+    # one flipped bit, a changed shape, or a moved key all change it
+    flipped = {"a": {"kernel": t["a"]["kernel"].copy()}, "bias": t["bias"]}
+    flipped["a"]["kernel"][0, 0] += 1e-7
+    assert tree_digest(flipped) != d
+    assert tree_digest({"a": {"kernel": t["a"]["kernel"].reshape(3, 2)},
+                        "bias": t["bias"]}) != d
+    assert tree_digest({"a2": {"kernel": t["a"]["kernel"]},
+                        "bias": t["bias"]}) != d
+
+
+def test_publication_roundtrip_and_digest_tamper_detection(tmp_path):
+    pub = publish_params(str(tmp_path), 40, _tree(), {"val_loss": 1.5})
+    tree, manifest = load_publication(pub)
+    assert manifest["step"] == 40 and manifest["val_metrics"] == {"val_loss": 1.5}
+    assert manifest["digest"] == tree_digest(tree)
+    assert np.allclose(tree["w"], 2.0)
+    # a publication is immutable: same step refuses
+    with pytest.raises(FileExistsError):
+        publish_params(str(tmp_path), 40, _tree())
+    # tampered payload fails the digest-verified load (the replica-side
+    # defense behind serving/replica.py publication specs)
+    _tamper(pub)
+    with pytest.raises(DigestMismatchError):
+        load_publication(pub)
+
+
+def test_publish_atomic_no_torn_reads(tmp_path, no_faults):
+    """A reader racing a publishing thread NEVER observes a half-written
+    publication: everything listed loads and digest-verifies. Residue
+    (.tmp dirs, manifest-less dirs) is invisible to scanners."""
+    d = str(tmp_path)
+    # handcrafted residue a crashed publisher could leave behind
+    os.makedirs(os.path.join(d, ".tmp-step_00000999-1"))
+    os.makedirs(os.path.join(d, "step_00000998"))  # no manifest: incomplete
+    with open(os.path.join(d, "step_00000998", "params.npz"), "wb") as f:
+        f.write(b"partial")
+
+    stop = threading.Event()
+    publish_errors = []
+
+    def publisher():
+        try:
+            for k in range(1, 9):
+                publish_params(d, k, _tree(1.0 + k))
+        except Exception as e:  # pragma: no cover
+            publish_errors.append(e)
+        finally:
+            stop.set()
+
+    t = threading.Thread(target=publisher)
+    t.start()
+    observed = set()
+    deadline = time.monotonic() + 60
+    while len(observed) < 8 and time.monotonic() < deadline:
+        for info in list_publications(d):
+            # every visible publication is COMPLETE: digest-verified load
+            tree, manifest = load_publication(info.path, verify_digest=True)
+            assert manifest["step"] == info.step
+            observed.add(info.step)
+    t.join(timeout=30)
+    assert not publish_errors
+    assert observed == set(range(1, 9))
+    assert {i.step for i in list_publications(d)} == set(range(1, 9))
+
+
+def test_publisher_fail_soft_and_fault_site(tmp_path, reg, no_faults):
+    """deploy.publish raise-kinds are drillable; the trainer-side
+    CheckpointPublisher survives them (warn + counter), the raw API
+    raises."""
+    faults.install(FaultInjector([
+        FaultSpec(site="deploy.publish", kind="transient", at=(1, 2))]))
+    with pytest.raises(InjectedTransientError):
+        publish_params(str(tmp_path), 1, _tree())
+    pub = CheckpointPublisher(str(tmp_path), registry=reg)
+    with pytest.warns(UserWarning, match="publication at step 2 failed"):
+        assert pub.publish(2, _tree()) is None
+    assert pub.publish(3, _tree()) is not None  # fault budget exhausted
+    assert reg.counter("deploy_publish_failures_total").value == 1
+    assert reg.counter("deploy_published_total").value == 1
+    # a failed publish leaves no half-publication behind
+    assert [i.step for i in list_publications(str(tmp_path))] == [3]
+
+
+def test_faults_site_and_kind_validation():
+    """Satellite: a typo'd PIT_FAULTS drill fails at install naming the
+    valid options — never silently injects nothing."""
+    with pytest.raises(ValueError, match=r"unknown fault site.*deploy.gate"):
+        faults.validate_site("deploy.gat")
+    with pytest.raises(ValueError, match="bad PIT_FAULTS clause"):
+        faults.parse_spec("engin.dispatch:transient@1")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.parse_spec("engine.dispatch:transientt@1")
+    # the three deploy sites are registered; per-engine suffixes stay valid
+    inj = faults.parse_spec(
+        "deploy.publish:nan@1;deploy.gate:transient@1;deploy.swap:fatal@1;"
+        "engine.dispatch.myrep-infer:slow@1@delay:0")
+    assert inj is not None
+    # fire() ticks a site ONCE per call (raise + corrupt kinds share the
+    # same 1-based call index)
+    inj2 = FaultInjector([
+        FaultSpec(site="deploy.publish", kind="transient", at=(1,)),
+        FaultSpec(site="deploy.publish", kind="nan", at=(2,)),
+    ])
+    with pytest.raises(InjectedTransientError):
+        inj2.fire("deploy.publish", _tree())
+    assert np.isnan(inj2.fire("deploy.publish", _tree())["w"])
+    assert not np.isnan(inj2.fire("deploy.publish", _tree())["w"])
+
+
+# -- the admission gate -------------------------------------------------------
+
+
+def test_gate_layers_reject_independently(reg, no_faults):
+    inc = _tree(2.0)
+    golden = [np.ones((2, 3), np.float32)]
+    gate = AdmissionGate(_infer, golden, inc, quality_tol=0.25, registry=reg)
+
+    ok = gate.check(_tree(2.001))
+    assert ok.ok, ok
+    # digest: loaded content != manifest
+    r = gate.check(_tree(2.001), {"digest": "0" * 64})
+    assert (not r.ok) and r.reason == "digest_mismatch"
+    # finite scan catches a NaN tree whose digest VERIFIES (the layer
+    # separation: provenance is not health)
+    nan_tree = {"w": np.float32("nan"), "b": inc["b"]}
+    r = gate.check(nan_tree, {"digest": tree_digest(nan_tree)})
+    assert (not r.ok) and r.reason == "nonfinite_params"
+    # quality: a finite-but-garbage tree deviates by orders of magnitude
+    r = gate.check(_tree(200.0))
+    assert (not r.ok) and r.reason == "quality"
+    # custom quality_fn: lower-is-better scoring with an absolute tolerance
+    gate_q = AdmissionGate(
+        _infer, golden, inc, quality_tol=0.1,
+        quality_fn=lambda out: float(np.mean(np.abs(out))), registry=reg)
+    assert gate_q.check(_tree(1.9)).ok          # scores BETTER than incumbent
+    r = gate_q.check(_tree(2.2))                # worse by > tol
+    assert (not r.ok) and r.reason == "quality"
+    # prewarm failure is a gate failure (fail closed)
+    def boom(tree):
+        raise RuntimeError("compile exploded")
+
+    gate_p = AdmissionGate(_infer, golden, inc, quality_tol=0.25,
+                           prewarm=boom, registry=reg)
+    r = gate_p.check(_tree(2.001))
+    assert (not r.ok) and r.reason == "prewarm_failed"
+    # an injected gate fault fails CLOSED, not open
+    faults.install(FaultInjector([
+        FaultSpec(site="deploy.gate", kind="fatal", at=(1,))]))
+    r = gate.check(_tree(2.001))
+    assert (not r.ok) and r.reason == "gate_error"
+
+
+# -- the deployment loop ------------------------------------------------------
+
+
+def _engine_stack(reg, incumbent, tmp_path, bake_s=0.05, **gate_kw):
+    eng = ServingEngine(_infer, incumbent, max_batch=4, name="dep-eng",
+                        registry=reg)
+    eng.warmup(np.ones((1, 3), np.float32))
+    gate = AdmissionGate(_infer, [np.ones((2, 3), np.float32)], incumbent,
+                         registry=reg, **gate_kw)
+    target = EngineSwapTarget(eng, incumbent, bake_s=bake_s, poll_s=0.01)
+    deployer = ModelDeployer(str(tmp_path), gate, target, poll_s=0.02,
+                             registry=reg)
+    return eng, deployer
+
+
+def test_deployer_rejects_nan_and_tamper_quarantine_sticky(
+        tmp_path, reg, no_faults):
+    """The reject drills through the FULL loop: a NaN-corrupted publication
+    (PIT_FAULTS machinery — its digest verifies!) and a digest-tampered one
+    are both quarantined and NEVER installed; quarantine is sticky for new
+    watchers (a restarted process skips the markers on disk)."""
+    inc = _tree(2.0)
+    eng, deployer = _engine_stack(reg, inc, tmp_path, quality_tol=0.5)
+    # publication 2 NaN-corrupts INSIDE publish (digest matches the NaNs)
+    faults.install(FaultInjector([
+        FaultSpec(site="deploy.publish", kind="nan", at=(2,))]))
+    publish_params(str(tmp_path), 10, _tree(2.001))
+    publish_params(str(tmp_path), 20, _tree(2.002))   # the NaN one
+    p3 = publish_params(str(tmp_path), 30, _tree(2.003))
+    _tamper(p3)
+    recs = deployer.poll_once()
+    assert [(r["action"], r["step"]) for r in recs] == [
+        ("swapped", 10), ("rejected", 20), ("rejected", 30)]
+    assert recs[1]["reason"] == "nonfinite_params"
+    assert recs[2]["reason"] == "digest_mismatch"
+    # the engine serves the ONE admitted tree
+    out = eng.predict(np.ones((1, 3), np.float32))
+    assert np.allclose(out, 2.001)
+    # counters label the reasons
+    labels = {"deploy": "deploy"}
+    assert reg.counter("deploy_rejected_total",
+                       labels={**labels, "reason": "nonfinite_params"}
+                       ).value == 1
+    assert reg.counter("deploy_rejected_total",
+                       labels={**labels, "reason": "digest_mismatch"}
+                       ).value == 1
+    # sticky: markers on disk — a FRESH watcher (process restart) skips both
+    assert read_quarantine(p3)["reason"].startswith("digest_mismatch")
+    assert [i.step for i in CheckpointWatcher(str(tmp_path)).poll()] == [10]
+    assert deployer.poll_once() == []  # and this process never re-attempts
+    eng.close()
+
+
+def test_deployer_min_step_skips_restart_backlog(tmp_path, reg, no_faults):
+    """A restarted serving process must not replay (or quarantine!) the
+    backlog of publications older than the checkpoint it booted from:
+    min_step floors the watcher, and a lazy gate FACTORY resolves on first
+    use (the serve CLI hands one over so the golden compile stays off the
+    startup path)."""
+    inc = _tree(2.0)
+    eng = ServingEngine(_infer, inc, max_batch=4, name="dep-min",
+                        registry=reg)
+    eng.warmup(np.ones((1, 3), np.float32))
+    built = []
+
+    def gate_factory():
+        built.append(True)
+        return AdmissionGate(_infer, [np.ones((2, 3), np.float32)], inc,
+                             quality_tol=0.5, registry=reg)
+
+    target = EngineSwapTarget(eng, inc, bake_s=0.02, poll_s=0.01)
+    p_old = publish_params(str(tmp_path), 5, _tree(1.0))  # pre-boot history
+    publish_params(str(tmp_path), 50, _tree(2.001))
+    deployer = ModelDeployer(str(tmp_path), gate_factory, target,
+                             poll_s=0.02, registry=reg, min_step=10)
+    assert not built  # factory untouched until a publication is processed
+    recs = deployer.poll_once()
+    assert [(r["action"], r["step"]) for r in recs] == [("swapped", 50)]
+    assert built == [True]
+    # the old publication was neither deployed nor mislabeled rejected
+    assert read_quarantine(p_old) is None
+    # the admitted tree installs between micro-batches — poll for it
+    deadline = time.monotonic() + 10
+    out = None
+    while time.monotonic() < deadline:
+        out = eng.predict(np.ones((1, 3), np.float32))
+        if np.allclose(out, 2.001):
+            break
+        time.sleep(0.01)
+    assert np.allclose(out, 2.001), out
+    eng.close()
+
+
+def test_engine_target_rollback_on_post_swap_slo_burn(tmp_path, no_faults):
+    """Post-swap regression on the single-engine target: dispatch faults
+    armed AFTER the swap installs burn the SLO during the bake → the target
+    re-installs the incumbent and the publication is quarantined."""
+    reg = obs.MetricsRegistry()
+    inc = _tree(2.0)
+    slo = obs.SLO(latency_target_s=5.0, availability_target=0.9,
+                  name="deptgt", burn_alert=None, min_samples=3)
+    eng = ServingEngine(_infer, inc, max_batch=4, name="dep-rb",
+                        registry=reg, slo=slo, dispatch_retries=0)
+    eng.warmup(np.ones((1, 3), np.float32))
+    gate = AdmissionGate(_infer, [np.ones((2, 3), np.float32)], inc,
+                         quality_tol=0.5, registry=reg)
+    target = EngineSwapTarget(eng, inc, bake_s=0.8, poll_s=0.01,
+                              min_bake_requests=3)
+    deployer = ModelDeployer(str(tmp_path), gate, target, poll_s=0.02,
+                             registry=reg)
+    publish_params(str(tmp_path), 10, _tree(2.01))
+
+    stop = threading.Event()
+    lost = []
+
+    def traffic():
+        x = np.ones((1, 3), np.float32)
+        while not stop.is_set():
+            try:
+                eng.submit(x).result(timeout=30)
+            except Exception as e:
+                lost.append(e)  # expected: the faulted dispatches fail
+            time.sleep(0.002)
+
+    installed = threading.Event()
+
+    def arm_after_swap():
+        # the regression must be strictly POST-swap: watch the served output
+        # flip to the candidate tree, then arm the dispatch faults
+        deadline = time.monotonic() + 30
+        x = np.ones((1, 3), np.float32)
+        while time.monotonic() < deadline:
+            if deployer.history:
+                return  # deployment already finished: the drill failed
+            try:
+                out = eng.predict(x)
+            except Exception:
+                time.sleep(0.005)
+                continue
+            if np.allclose(out, 2.01):
+                faults.install(FaultInjector([FaultSpec(
+                    site="engine.dispatch.dep-rb", kind="transient",
+                    every=1)]))
+                installed.set()
+                return
+            time.sleep(0.005)
+
+    t = threading.Thread(target=traffic, daemon=True)
+    w = threading.Thread(target=arm_after_swap, daemon=True)
+    t.start()
+    w.start()
+    recs = deployer.poll_once()
+    faults.install(None)
+    stop.set()
+    t.join(timeout=10)
+    assert installed.is_set(), "faults never armed — the drill did not run"
+    assert len(recs) == 1 and recs[0]["action"] == "rolled_back", recs
+    assert recs[0]["reason"] == "post_swap_regression"
+    assert "SLO burn" in recs[0]["detail"]
+    # the incumbent tree is serving again (the rollback INSTALLS between
+    # micro-batches — poll until the worker adopted it), and the
+    # publication is quarantined
+    deadline = time.monotonic() + 10
+    out = None
+    while time.monotonic() < deadline:
+        try:
+            out = eng.predict(np.ones((1, 3), np.float32))
+        except Exception:
+            pass
+        if out is not None and np.allclose(out, 2.0):
+            break
+        time.sleep(0.02)
+    assert out is not None and np.allclose(out, 2.0), out
+    assert list_publications(str(tmp_path)) == []  # quarantined
+    assert deployer.stats()["rollbacks"] == 1
+    eng.close()
+
+
+def test_deployer_stop_waits_for_inflight_swap(tmp_path, reg, no_faults):
+    """The SIGTERM-drain contract: stop() does not return while a swap is
+    mid-flight — the serving surface is wholly on ONE tree afterwards."""
+    inc = _tree(2.0)
+    gate = AdmissionGate(_infer, [np.ones((2, 3), np.float32)], inc,
+                         quality_tol=0.5, registry=reg)
+    release = threading.Event()
+    swapped = []
+
+    class SlowTarget:
+        def swap(self, tree, info):
+            release.wait(30)
+            swapped.append(info.step)
+            return True, None
+
+    deployer = ModelDeployer(str(tmp_path), gate, SlowTarget(), poll_s=0.02,
+                             registry=reg).start()
+    publish_params(str(tmp_path), 10, _tree(2.001))
+    deadline = time.monotonic() + 10
+    while not deployer.history and deployer._busy.acquire(blocking=False):
+        deployer._busy.release()  # not yet picked up
+        assert time.monotonic() < deadline, "deployment never started"
+        time.sleep(0.005)
+    # the swap is mid-flight: a bounded stop reports the timeout honestly
+    assert deployer.stop(timeout_s=0.2) is False
+    assert swapped == []
+    release.set()
+    assert deployer.stop(timeout_s=10) is True
+    assert swapped == [10]  # the in-progress swap COMPLETED before exit
+
+
+# -- trainer + checkpoint satellites ------------------------------------------
+
+
+def test_trainer_publishes_on_cadence(tmp_path, no_faults):
+    """TrainerConfig.publish_dir/publish_every_n_steps: publications land
+    atomically on the step cadence with metrics in the manifest; config
+    validation requires both halves."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from perceiver_io_tpu.training import TrainState
+    from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+    def train_step(state, batch):
+        def loss_fn(p):
+            return jnp.mean((batch["x"] @ p["w"] - batch["y"]) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads), {"loss": loss}
+
+    state = TrainState.create({"w": jnp.ones((3, 1), jnp.float32)},
+                              optax.sgd(1e-2), jax.random.key(0))
+    rng = np.random.default_rng(0)
+    loader = [{"x": rng.normal(size=(4, 3)).astype(np.float32),
+               "y": np.ones((4, 1), np.float32)} for _ in range(9)]
+    pub_dir = tmp_path / "pub"
+    cfg = TrainerConfig(max_steps=9, log_every_n_steps=3,
+                        logdir=str(tmp_path / "logs"), use_tensorboard=False,
+                        compute_mfu=False, publish_dir=str(pub_dir),
+                        publish_every_n_steps=3)
+    with Trainer(train_step, None, state, cfg,
+                 example_batch=loader[0]) as tr:
+        tr.fit(loader)
+    infos = list_publications(str(pub_dir))
+    assert [i.step for i in infos] == [3, 6, 9]
+    tree, manifest = load_publication(infos[-1].path)  # digest-verified
+    assert "train_loss" in manifest["val_metrics"]
+    assert np.isfinite(np.asarray(tree["w"])).all()
+    with pytest.raises(ValueError, match="publish_every_n_steps"):
+        TrainerConfig(max_steps=1, publish_dir=str(pub_dir))
+
+
+def test_checkpoint_digest_sidecar_detects_silent_corruption(tmp_path):
+    """Satellite: save() records a content digest; prefer_latest restore
+    verifies it and falls back past a step whose restored bytes no longer
+    hash to what was saved (silent bit corruption — the case the r9
+    truncated-newest fallback cannot see, because the restore SUCCEEDS)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from perceiver_io_tpu.training import (
+        CheckpointManager,
+        TrainState,
+        restore_train_state,
+    )
+    from perceiver_io_tpu.training.checkpoint import DIGESTS_FILE
+
+    tx = optax.sgd(0.1)
+    s1 = TrainState.create({"w": jnp.full((2, 2), 1.0)}, tx,
+                           jax.random.key(0))
+    s2 = s1.replace(step=2, params={"w": jnp.full((2, 2), 2.0)})
+    directory = str(tmp_path / "ckpt")
+    with CheckpointManager(directory, max_to_keep=2) as mgr:
+        mgr.save(1, s1, {"val_loss": 1.0})
+        mgr.save(2, s2, {"val_loss": 0.5})
+    sidecar = os.path.join(directory, DIGESTS_FILE)
+    with open(sidecar) as f:
+        digests = json.load(f)
+    assert set(digests) == {"1", "2"}
+
+    like = s1
+    # intact: the newest step restores and verifies
+    restored = restore_train_state(directory, like, prefer_latest=True)
+    assert np.allclose(restored.params["w"], 2.0)
+    # "corrupt" step 2: its save-time digest no longer matches the bytes a
+    # restore returns (stand-in for bit rot in the stored arrays)
+    digests["2"] = "0" * 64
+    with open(sidecar, "w") as f:
+        json.dump(digests, f)
+    with pytest.warns(UserWarning, match="digest.*does not match"):
+        restored = restore_train_state(directory, like, prefer_latest=True)
+    assert np.allclose(restored.params["w"], 1.0)  # fell back to step 1
+
+
+# -- the end-to-end chaos drill (tier-1, in-process) --------------------------
+
+
+def _pub_factory(log):
+    def factory(spec):
+        if spec.get("kind") != "publication":
+            raise ValueError(f"unexpected spec {spec!r}")
+        tree, _ = load_publication(spec["path"])  # digest-verified
+        log.append(spec["path"])
+        return tree
+
+    return factory
+
+
+def test_fleet_deploy_chaos_e2e(no_faults):
+    """THE acceptance drill, tier-1 in-process: a publisher on a cadence +
+    a 3-replica fleet under open-loop traffic. >=3 gated swaps complete with
+    lost_accepted=0; one PIT-NaN-corrupted and one digest-tampered
+    publication are rejected by the gate and NEVER reach a replica (the
+    replicas' publication loader logs every path they realize); one
+    injected post-swap SLO burn rolls the whole fleet back to the
+    incumbent tree."""
+    import tempfile
+
+    from perceiver_io_tpu.serving import LocalReplica, ReplicaApp, Router
+
+    reg = obs.MetricsRegistry()
+    inc = _tree(2.0)
+    # tight availability: by pub6 the SLO window holds seconds of good
+    # traffic, and the bake must see the burn cross within its window — at
+    # a 1e-3 error budget a handful of post-swap failures crosses 2.0
+    slo = obs.SLO(latency_target_s=5.0, availability_target=0.999,
+                  name="depfleet", burn_alert=None, min_samples=5)
+    loaded_paths = []
+    replicas = []
+    for i in range(3):
+        eng = ServingEngine(_infer, inc, max_batch=4, name=f"dp{i}-infer",
+                            registry=reg, slo=slo, dispatch_retries=0)
+        app = ReplicaApp({"infer": eng}, inc,
+                         params_factory=_pub_factory(loaded_paths),
+                         name=f"dp{i}", registry=reg, assume_ready=True)
+        replicas.append(LocalReplica(app))
+    router = Router(replicas, scrape_interval_s=0.02, registry=reg,
+                    name="depfleet")
+    router.refresh()
+
+    publish_dir = tempfile.mkdtemp(prefix="deploy_chaos_")
+    gate = AdmissionGate(_infer, [np.ones((2, 3), np.float32)], inc,
+                         quality_tol=0.5, registry=reg, name="chaos")
+    target = RouterSwapTarget(router, bake_s=0.6, poll_s=0.02,
+                              min_bake_requests=3)
+    deployer = ModelDeployer(publish_dir, gate, target, poll_s=0.03,
+                             registry=reg, name="chaos").start()
+
+    stop = threading.Event()
+    lost = []
+    x1 = np.ones((1, 3), np.float32)
+
+    def traffic():  # open-loop-ish: constant arrivals, never self-throttled
+        futs = []
+        while not stop.is_set():
+            futs.append(router.submit(x1))
+            futs = [f for f in futs if not f.done() or _note(f)]
+            time.sleep(0.002)
+        for f in futs:
+            _note_final(f)
+
+    def _note(f):
+        try:
+            f.result(0)
+        except Exception as e:
+            lost.append(e)
+        return False  # drop from the outstanding list
+
+    def _note_final(f):
+        try:
+            f.result(30)
+        except Exception as e:
+            lost.append(e)
+
+    t = threading.Thread(target=traffic, daemon=True)
+    t.start()
+    try:
+        # publications 1-3: good trees -> three gated rolling swaps
+        faults.install(FaultInjector([
+            FaultSpec(site="deploy.publish", kind="nan", at=(4,))]))
+        for k in (1, 2, 3):
+            publish_params(publish_dir, 10 * k, _tree(2.0 + 1e-3 * k))
+        deadline = time.monotonic() + 60
+        while len(deployer.history) < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert [r["action"] for r in deployer.history] == ["swapped"] * 3, \
+            deployer.history
+        # publication 4: NaN-corrupted by the PIT_FAULTS machinery (digest
+        # verifies!); publication 5: digest-tampered after landing
+        publish_params(publish_dir, 40, _tree(2.004))
+        p5 = publish_params(publish_dir, 50, _tree(2.005))
+        _tamper(p5)
+        deadline = time.monotonic() + 60
+        while len(deployer.history) < 5 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert [r["action"] for r in deployer.history[3:]] == \
+            ["rejected", "rejected"], deployer.history
+        assert deployer.history[3]["reason"] == "nonfinite_params"
+        assert deployer.history[4]["reason"] == "digest_mismatch"
+
+        # publication 6: good tree, but post-swap dispatch faults on dp0
+        # burn its SLO during the bake -> the FLEET rolls back
+        armed = threading.Event()
+
+        def arm_after_swap():
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if replicas[0].scrape().get("params_version", 0) >= 4:
+                    faults.install(FaultInjector([FaultSpec(
+                        site="engine.dispatch.dp0-infer", kind="transient",
+                        every=1)]))
+                    armed.set()
+                    return
+                time.sleep(0.005)
+
+        w = threading.Thread(target=arm_after_swap, daemon=True)
+        w.start()
+        publish_params(publish_dir, 60, _tree(2.006))
+        deadline = time.monotonic() + 90
+        while len(deployer.history) < 6 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        faults.install(None)
+        assert armed.is_set(), "post-swap faults never armed"
+        assert len(deployer.history) == 6, deployer.history
+        assert deployer.history[5]["action"] == "rolled_back", \
+            deployer.history[5]
+    finally:
+        faults.install(None)
+        stop.set()
+        t.join(timeout=30)
+        deployer.stop(60)
+
+    stats = deployer.stats()
+    assert stats["swaps"] == 3
+    assert stats["rollbacks"] == 1
+    # the rolled-back publication is quarantined too (sticky, like every
+    # other rejection — it must never be re-attempted)
+    assert stats["rejected"] == {"nonfinite_params": 1, "digest_mismatch": 1,
+                                 "post_swap_regression": 1}
+    # the rejected publications NEVER reached a replica: every path the
+    # replicas realized is a step-10/20/30/60 publication
+    bad = {"step_00000040", "step_00000050"}
+    assert not bad & {os.path.basename(p) for p in loaded_paths}
+    # the WHOLE fleet rolled back to the last admitted tree (publication
+    # 3): every replica serves it once the rollback install lands
+    deadline = time.monotonic() + 15
+    on_pub3 = 0
+    while time.monotonic() < deadline and on_pub3 < 6:
+        try:
+            out = router.predict(x1, timeout=30)
+        except Exception:
+            time.sleep(0.02)
+            continue
+        if np.allclose(out, 2.003):
+            on_pub3 += 1
+        else:
+            on_pub3 = 0
+            time.sleep(0.02)
+    assert on_pub3 >= 6, f"fleet still serving a non-rollback tree: {out}"
+    # ZERO lost accepted requests across 3 swaps + 2 rejects + 1 rollback
+    assert not lost, f"lost accepted requests: {lost[:3]}"
+    router.close()
+    for r in replicas:
+        r.app.close()
+
+
+# -- serve CLI wiring ---------------------------------------------------------
+
+
+@pytest.mark.slow  # tier-1 budget (r13, ~64 s margin at 806 s): trains its
+# own tiny MLM. The deployment-loop logic stays tier-1 in
+# test_fleet_deploy_chaos_e2e + test_deployer_rejects_nan_and_tamper...,
+# the stop-waits-for-inflight-swap drain contract in
+# test_deployer_stop_waits_for_inflight_swap, and the bench contract in
+# test_cli.py::test_deploy_bench_cpu_gated_swaps_zero_loss; this adds only
+# the serve.py flag wiring ride.
+def test_serve_watch_checkpoints_single_mode(tmp_path, no_faults):
+    """cli/serve.py --watch_checkpoints: a good publication hot-swaps into
+    the live server, a NaN one is quarantined, and the drain path stops the
+    deployment loop cleanly (stdin stays open until both happened, pinning
+    the loop's liveness DURING serving)."""
+    import glob
+    import sys
+
+    from perceiver_io_tpu.cli import serve, train_mlm
+    from perceiver_io_tpu.data.tokenizer import load_tokenizer
+    from perceiver_io_tpu.inference import load_mlm_checkpoint
+
+    run_dir = train_mlm.main([
+        "--synthetic", "--logdir", str(tmp_path / "logs" / "watch"),
+        "--root", str(tmp_path / "cache"),
+        "--num_latents", "4", "--num_latent_channels", "16",
+        "--num_encoder_layers", "1",
+        "--num_self_attention_layers_per_block", "1",
+        "--num_cross_attention_heads", "2",
+        "--num_self_attention_heads", "2", "--dtype", "float32",
+        "--synthetic_size", "64", "--batch_size", "16",
+        "--max_seq_len", "32", "--vocab_size", "120",
+        "--max_steps", "2", "--log_every_n_steps", "1",
+    ])
+    ckpt = os.path.join(run_dir, "checkpoints")
+    tok = glob.glob(str(tmp_path / "cache" / "*tokenizer*.json"))[0]
+    _, params, _ = load_mlm_checkpoint(ckpt, load_tokenizer(tok))
+
+    import jax
+
+    watch_dir = tmp_path / "pub"
+    good = jax.tree.map(lambda a: np.asarray(a) * 1.0005, params)
+    publish_params(str(watch_dir), 50, good, {"val_loss": 1.0})
+    nan_pub = publish_params(
+        str(watch_dir), 60,
+        jax.tree.map(lambda a: np.full_like(np.asarray(a), np.nan)
+                     if np.issubdtype(np.asarray(a).dtype, np.floating)
+                     else np.asarray(a), params))
+
+    r_fd, w_fd = os.pipe()
+    results, errors = [], []
+
+    def run_serve():
+        old = sys.stdin
+        sys.stdin = os.fdopen(r_fd, "r")
+        try:
+            results.extend(serve.main([
+                "--checkpoint", ckpt, "--tokenizer", tok, "--stdin",
+                "--max_batch", "4", "--k", "2", "--no_warmup",
+                "--watch_checkpoints", str(watch_dir),
+                "--publish_poll_s", "0.05", "--rolling_bake_s", "0.05",
+                "--gate_quality_tol", "0.5",
+            ]))
+        except BaseException as e:  # pragma: no cover
+            errors.append(e)
+        finally:
+            sys.stdin.close()
+            sys.stdin = old
+
+    t = threading.Thread(target=run_serve, daemon=True)
+    t.start()
+    writer = os.fdopen(w_fd, "w")
+    writer.write("a [MASK] b\n")
+    writer.flush()
+    # hold admission open until the loop processed BOTH publications: the
+    # NaN one is quarantined on disk, the good one is serving (gauge)
+    gauge = obs.get_registry().gauge("deploy_current_step",
+                                     labels={"deploy": "serve"})
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if read_quarantine(nan_pub) is not None and gauge.value == 50:
+            break
+        time.sleep(0.05)
+    writer.close()  # EOF -> drain -> deployer.stop -> exit
+    t.join(timeout=120)
+    assert not errors, errors
+    assert read_quarantine(nan_pub)["reason"].startswith("nonfinite_params")
+    assert gauge.value == 50, "the good publication never swapped in"
+    assert len(results) == 1 and len(results[0]["fills"]) == 1
+    assert len(results[0]["fills"][0]) == 2
+
+
+# -- the real-process train+serve drill (slow) --------------------------------
+
+
+_TRAINER_SCRIPT = """
+import sys
+from perceiver_io_tpu.utils.platform import ensure_cpu_only
+ensure_cpu_only()
+import numpy as np, jax
+from perceiver_io_tpu.models.presets import tiny_mlm
+from perceiver_io_tpu.training import (TrainState, OptimizerConfig,
+                                       make_optimizer, make_mlm_steps)
+from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+publish_dir, logdir = sys.argv[1], sys.argv[2]
+vocab, seq = 503, 64
+model = tiny_mlm(vocab_size=vocab, max_seq_len=seq)
+ids0 = np.zeros((1, seq), np.int32)
+params = model.init({"params": jax.random.key(0),
+                     "masking": jax.random.key(1)}, ids0, ids0 == 0)["params"]
+tx, schedule = make_optimizer(OptimizerConfig(learning_rate=1e-4))
+state = TrainState.create(params, tx, jax.random.key(2))
+train_step, _, _ = make_mlm_steps(model, schedule)
+rng = np.random.default_rng(0)
+loader = [{"token_ids": rng.integers(3, vocab, (4, seq)).astype(np.int32),
+           "pad_mask": np.zeros((4, seq), bool)} for _ in range(12)]
+cfg = TrainerConfig(max_steps=12, log_every_n_steps=3, logdir=logdir,
+                    use_tensorboard=False, compute_mfu=False,
+                    publish_dir=publish_dir, publish_every_n_steps=3)
+with Trainer(train_step, None, state, cfg, example_batch=loader[0]) as tr:
+    tr.fit(loader)
+print("TRAINER_DONE", flush=True)
+"""
+
+
+@pytest.mark.slow  # real processes end to end; the gated-swap/reject/
+# rollback logic stays tier-1 in test_fleet_deploy_chaos_e2e, the publish
+# cadence in test_trainer_publishes_on_cadence, the CLI wiring in
+# test_serve_watch_checkpoints_single_mode
+def test_train_serve_deploy_drill_real_process(tmp_path):
+    """A REAL trainer process publishing on a cadence (with PIT_FAULTS
+    NaN-corrupting its second publication) + 3 supervised replica processes
+    behind a router: every clean publication flows through the gate into a
+    rolling fleet swap, the NaN one and a test-tampered one are rejected and
+    never reach any replica, and open-loop traffic loses zero accepted
+    requests throughout."""
+    import subprocess
+    import sys
+
+    from perceiver_io_tpu.models.presets import tiny_mlm
+    from perceiver_io_tpu.serving import ReplicaSupervisor, Router
+
+    publish_dir = tmp_path / "pub"
+    publish_dir.mkdir()
+    env = dict(os.environ)
+    env["PIT_FAULTS"] = "deploy.publish:nan@2"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    trainer = subprocess.Popen(
+        [sys.executable, "-c", _TRAINER_SCRIPT, str(publish_dir),
+         str(tmp_path / "logs")],
+        cwd=ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+
+    # meanwhile: the serving fleet (same tiny preset => same tree family)
+    import jax
+
+    reg = obs.get_registry()
+    vocab, seq = 503, 64
+    model = tiny_mlm(vocab_size=vocab, max_seq_len=seq)
+    ids0 = np.zeros((1, seq), np.int32)
+    params = model.init({"params": jax.random.key(0),
+                         "masking": jax.random.key(1)},
+                        ids0, ids0 == 0)["params"]
+
+    def gathered_apply(p, token_ids, pad_mask, pos):
+        logits, _ = model.apply({"params": p}, token_ids, pad_mask,
+                                masking=False, deterministic=True,
+                                positions=pos)
+        return logits
+
+    sup = ReplicaSupervisor(
+        count=3, extra_args=["--preset", "tiny", "--cpu", "--no_warmup"],
+        cpu=True)
+    try:
+        clients = sup.start()
+        sup.wait_ready(timeout_s=600.0)
+        with Router(clients, name="drill", registry=reg,
+                    scrape_interval_s=0.1) as router:
+            router.refresh()
+            gate = AdmissionGate(
+                gathered_apply,
+                (ids0, np.zeros((1, seq), bool), np.zeros((1, 2), np.int32)),
+                params, quality_tol=0.5, registry=reg, name="drill")
+            target = RouterSwapTarget(router, bake_s=0.2, poll_s=0.05)
+            deployer = ModelDeployer(str(publish_dir), gate, target,
+                                     poll_s=0.2, registry=reg,
+                                     name="drill").start()
+            stop = threading.Event()
+            lost = []
+
+            def traffic():
+                rng = np.random.default_rng(1)
+                while not stop.is_set():
+                    ids = rng.integers(3, vocab, (1, seq)).astype(np.int32)
+                    try:
+                        router.predict(
+                            ids, np.zeros((1, seq), bool),
+                            np.zeros((1, 2), np.int32), timeout=120)
+                    except Exception as e:
+                        lost.append(e)
+                    time.sleep(0.02)
+
+            t = threading.Thread(target=traffic, daemon=True)
+            t.start()
+            try:
+                out, _ = trainer.communicate(timeout=600)
+                assert trainer.returncode == 0, out[-3000:]
+                assert "TRAINER_DONE" in out
+                # trainer published steps 3,6,9,12; #2 (step 6) is the NaN
+                # one. Add a digest-tampered publication from the test side.
+                p_t = publish_params(str(publish_dir), 100,
+                                     jax.tree.map(
+                                         lambda a: np.asarray(a) * 1.001,
+                                         params))
+                _tamper(p_t)
+                deadline = time.monotonic() + 300
+                while (len(deployer.history) < 5
+                       and time.monotonic() < deadline):
+                    time.sleep(0.2)
+            finally:
+                stop.set()
+                t.join(timeout=60)
+                deployer.stop(120)
+            actions = {r["step"]: r["action"] for r in deployer.history}
+            assert actions.get(6) == "rejected", deployer.history
+            assert actions.get(100) == "rejected", deployer.history
+            swapped = [s for s, a in actions.items() if a == "swapped"]
+            assert sorted(swapped) == [3, 9, 12], deployer.history
+            stats = deployer.stats()
+            assert stats["swaps"] == 3 and stats["rejected"] == {
+                "nonfinite_params": 1, "digest_mismatch": 1}
+            # every replica is on the final published tree (version: one
+            # bump per rolling swap), and no accepted request was lost
+            for c in clients:
+                assert c.scrape().get("params_version") == 3, c.scrape()
+            assert not lost, f"lost accepted requests: {lost[:3]}"
+            router.drain(60)
+    finally:
+        trainer.kill()
+        sup.stop()

@@ -80,6 +80,13 @@ PHASE_KEYS = ("admission", "queue", "assembly", "dispatch", "device",
 # lost_accepted is the chaos drill's verdict and must be 0
 FLEET_KEYS = ("replicas", "mode", "killed", "kill_at_frac", "kill_point",
               "reroutes", "affinity_spills", "lost_accepted", "restarts")
+# the deploy block of a --publish_every_s run (null otherwise): the
+# train→serve ride-along — checkpoints published and gate-swapped DURING the
+# sweep, with p99 attributed to ±window swap windows vs steady state
+# (perceiver_io_tpu.deploy.swap_window_stats; PERF.md §Deployment)
+DEPLOY_KEYS = ("publish_every_s", "publishes", "swaps", "rejects",
+               "rollbacks", "p99_steady_ms", "p99_swap_ms", "blip_ratio",
+               "per_swap_p99_ms")
 
 
 def _pct(values: List[float], q: float) -> Optional[float]:
@@ -160,7 +167,7 @@ def _arrival_gaps(arrival: str, rate: float, duration: float, burst: int,
 
 def _run_point(submit, breaker_state, reqs, rate: float, duration: float,
                arrival: str, burst: int, rng, drain_timeout_s: float,
-               on_frac=None) -> Dict:
+               on_frac=None, sink=None) -> Dict:
     from perceiver_io_tpu.resilience import (
         BreakerOpen,
         DeadlineExceeded,
@@ -202,6 +209,13 @@ def _run_point(submit, breaker_state, reqs, rate: float, duration: float,
         completed += 1
         fut_lats, recs = _fut_latencies(fut, ts)
         lats.extend(fut_lats)
+        if sink is not None:
+            # (completion stamp, latency) pairs for the deploy ride-along's
+            # swap-window attribution (engine futures: submit stamp + latency
+            # approximates t_done; router futures carry t_done directly)
+            t_done = getattr(fut, "t_done", None)
+            for la in fut_lats:
+                sink.append((t_done if t_done is not None else ts + la, la))
         for rec in recs:
             for k, v in rec.items():
                 phases[k].append(v)
@@ -309,6 +323,17 @@ def main() -> None:
                        help="inprocess mode: seconds the killed replica "
                             "stays dead before reviving (the supervisor-"
                             "restart stand-in)")
+    dep = parser.add_argument_group(
+        "continuous deployment ride-along (perceiver_io_tpu.deploy)")
+    dep.add_argument("--publish_every_s", type=float, default=None,
+                     metavar="S",
+                     help="publish a (gate-passing) checkpoint every S "
+                          "seconds DURING the sweep and hot-swap it through "
+                          "the deployment loop; the record gains a 'deploy' "
+                          "block (swaps/rejects/rollbacks + per-swap p99 "
+                          "blip vs steady). Default: off")
+    dep.add_argument("--blip_window_s", type=float, default=0.5,
+                     help="half-width of the per-swap p99 attribution window")
     args = parser.parse_args()
 
     if args.dry:
@@ -317,8 +342,8 @@ def main() -> None:
             "preset": args.preset, "arrival": args.arrival,
             "duration_s": args.duration_s,
             "point_keys": list(POINT_KEYS), "phase_keys": list(PHASE_KEYS),
-            "fleet_keys": list(FLEET_KEYS),
-            "sweep": [], "capacity": None, "fleet": None,
+            "fleet_keys": list(FLEET_KEYS), "deploy_keys": list(DEPLOY_KEYS),
+            "sweep": [], "capacity": None, "fleet": None, "deploy": None,
         }
         print(json.dumps(record))
         return
@@ -370,7 +395,7 @@ def main() -> None:
         return gathered_apply, variables["params"]
 
     queue_limit = args.queue_limit if args.queue_limit > 0 else None
-    engine = router = sup = None
+    engine = router = sup = params = None
     local_replicas = []
     killed = {"name": None}
     if args.replicas > 0:
@@ -453,6 +478,71 @@ def main() -> None:
     _log(f"calibrated closed-loop capacity ~{cal_rps:.1f} req/s, "
          f"median latency {cal_lat_s * 1e3:.2f} ms")
 
+    # -- continuous-deployment ride-along (--publish_every_s) ----------------
+    deploy_stack = None
+    completion_sink = None
+    if args.publish_every_s:
+        import tempfile
+
+        import perceiver_io_tpu.deploy as deploy_mod
+
+        if params is None:
+            # process-replica fleets never built the model locally; the
+            # replicas init the SAME tree (preset + seed 0), so this copy is
+            # a faithful incumbent for the gate
+            gathered_apply, params = build_model_apply()
+        publish_dir = tempfile.mkdtemp(prefix="load_bench_pub_")
+        gate = deploy_mod.AdmissionGate(
+            gathered_apply, reqs[0], params, quality_tol=0.5,
+            registry=registry, name="load_bench")
+        if router is not None:
+            target = deploy_mod.RouterSwapTarget(router, bake_s=0.2,
+                                                 poll_s=0.02)
+        else:
+            target = deploy_mod.EngineSwapTarget(engine, params, bake_s=0.2,
+                                                 poll_s=0.02)
+        swap_times: List[float] = []
+
+        def _on_deployed(rec):
+            if rec["action"] == "swapped":
+                # install-start → bake-end interval (see swap_window_stats)
+                swap_times.append((rec["t_swap"], rec["t_done"]))
+            _log(f"deploy: step {rec['step']} {rec['action']}"
+                 + (f" ({rec['reason']})" if rec.get("reason") else ""))
+
+        deployer = deploy_mod.ModelDeployer(
+            publish_dir, gate, target,
+            poll_s=max(args.publish_every_s / 4, 0.05),
+            registry=registry, name="load_bench",
+            on_deployed=_on_deployed).start()
+        stop_pub = threading.Event()
+        pub_count = [0]
+
+        def _publisher():
+            import jax as _jax
+
+            while not stop_pub.wait(args.publish_every_s):
+                k = pub_count[0] + 1
+                scale = 1.0 + 1e-3 * k  # same-regime tree: the gate passes
+                tree = _jax.tree.map(
+                    lambda x: x * scale
+                    if np.issubdtype(np.asarray(x).dtype, np.floating)
+                    else x, params)
+                try:
+                    deploy_mod.publish_params(publish_dir, 10 * k, tree,
+                                              {"val_loss": 1.0})
+                    pub_count[0] = k
+                except Exception as e:
+                    _log(f"deploy: publish failed {type(e).__name__}: {e}")
+
+        pub_thread = threading.Thread(target=_publisher, daemon=True)
+        pub_thread.start()
+        completion_sink = []
+        deploy_stack = (deploy_mod, deployer, stop_pub, pub_thread,
+                        swap_times, pub_count)
+        _log(f"deploy ride-along: publishing every {args.publish_every_s}s "
+             f"into {publish_dir}")
+
     slo = obs.SLO(
         latency_target_s=(args.slo_p99_ms / 1e3 if args.slo_p99_ms
                           else max(5.0 * cal_lat_s, 1e-3)),
@@ -474,7 +564,8 @@ def main() -> None:
             on_frac = (args.kill_replica_at, kill_hook)
         point = _run_point(submit, breaker_state, reqs, rate,
                            args.duration_s, args.arrival, args.burst, rng,
-                           args.drain_timeout_s, on_frac=on_frac)
+                           args.drain_timeout_s, on_frac=on_frac,
+                           sink=completion_sink)
         points.append(point)
         ms = lambda v: f"{v * 1e3:8.2f}" if v is not None else "       —"
         _log(f"offered {point['offered_rps']:8.1f} req/s -> achieved "
@@ -505,6 +596,36 @@ def main() -> None:
     else:
         capacity = None
         _log("capacity model: no point completed any request — nothing to fit")
+
+    deploy_record = None
+    if deploy_stack is not None:
+        deploy_mod, deployer, stop_pub, pub_thread, swap_times, pub_count = \
+            deploy_stack
+        stop_pub.set()
+        pub_thread.join(timeout=30)
+        deadline = time.monotonic() + 60
+        while (len(deployer.history) < pub_count[0]
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        deployer.stop(120)
+        st = deployer.stats()
+        blip = deploy_mod.swap_window_stats(
+            completion_sink, swap_times, args.blip_window_s)
+        ms = lambda v: None if v is None else round(v * 1e3, 3)
+        deploy_record = {
+            "publish_every_s": args.publish_every_s,
+            "publishes": pub_count[0],
+            "swaps": st["swaps"],
+            "rejects": sum(st["rejected"].values()),
+            "rollbacks": st["rollbacks"],
+            "p99_steady_ms": ms(blip["p99_steady_s"]),
+            "p99_swap_ms": ms(blip["p99_swap_s"]),
+            "blip_ratio": (
+                round(blip["p99_swap_s"] / blip["p99_steady_s"], 3)
+                if blip["p99_swap_s"] and blip["p99_steady_s"] else None),
+            "per_swap_p99_ms": [ms(v) for v in blip["per_swap_p99_s"]],
+        }
+        _log(f"deploy: {json.dumps(deploy_record)}")
 
     fleet_record = None
     if args.replicas > 0:
@@ -547,6 +668,7 @@ def main() -> None:
         "sweep": [_point_for_record(p) for p in points],
         "capacity": capacity,
         "fleet": fleet_record,
+        "deploy": deploy_record,
     }
     if router is not None:
         router.drain(args.drain_timeout_s)
