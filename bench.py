@@ -44,46 +44,42 @@ import json
 import os
 import sys
 
+from perceiver_io_tpu.utils.jsonline import emit_json_line
+from perceiver_io_tpu.utils.platform import (  # noqa: E402 — after the
+    BackendProbeTimeout,  # stdout-contract imports; probes stay deadlined
+    probe_backend,
+)
+
 
 def _probe_backend() -> str:
-    """Resolve ``jax.default_backend()`` under a wall-clock deadline.
+    """Resolve the jax backend under a wall-clock deadline.
 
     The first backend touch is where a dark axon tunnel bites: the PJRT
     plugin hangs (or raises) inside ``jax.default_backend()``, which used to
     escape as a raw traceback on stdout — violating the one-JSON-line
     contract exactly when the driver most needs a parseable record. The
-    probe runs on an abandonable daemon thread (``call_with_deadline``); on
-    timeout or error ONE JSON error line is printed and the process exits
-    nonzero via ``os._exit`` (a wedged PJRT thread cannot be joined).
-    PIT_BENCH_BACKEND_DEADLINE_S overrides the 120 s default.
+    shared ``utils.platform.probe_backend`` helper runs the probe on an
+    abandonable daemon thread; on timeout or error ONE JSON error line is
+    printed and the process exits nonzero via ``os._exit`` (a wedged PJRT
+    thread cannot be joined). PIT_BENCH_BACKEND_DEADLINE_S overrides the
+    120 s default.
     """
-    import jax
-
-    from perceiver_io_tpu.utils.profiling import call_with_deadline
-
-    deadline = float(os.environ.get("PIT_BENCH_BACKEND_DEADLINE_S", "120"))
     try:
-        done, backend = call_with_deadline(
-            jax.default_backend, deadline, "default_backend"
-        )
+        return probe_backend(deadline_s=120.0).backend
+    except BackendProbeTimeout as e:
+        _exit_backend_unavailable(str(e))
     except Exception as e:  # backend init raised (plugin error, no devices)
         _exit_backend_unavailable(f"{type(e).__name__}: {str(e)[:300]}")
-    if not done:
-        _exit_backend_unavailable(
-            f"jax.default_backend() gave no answer within {deadline:g}s "
-            "(wedged axon tunnel?)"
-        )
-    return backend
 
 
 def _exit_backend_unavailable(reason: str) -> None:
     """Emit the single JSON error record and exit nonzero."""
-    print(json.dumps({
+    emit_json_line({
         "error": "tpu_unavailable",
         "metric": "mlm_tokens_per_sec_per_chip",
         "value": None,
         "reason": reason,
-    }))
+    })
     sys.stdout.flush()
     sys.stderr.flush()
     os._exit(2)
@@ -204,7 +200,7 @@ def main() -> None:
         pass
     vs_baseline = tokens_per_sec_per_chip / baseline if baseline else 1.0
 
-    print(json.dumps({
+    emit_json_line({
         "metric": "mlm_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec_per_chip, 1),
         "unit": "tokens/s/chip",
@@ -214,7 +210,7 @@ def main() -> None:
             round(device_s * 1e3, 3) if device_s is not None else None
         ),
         "host_ms_per_step": round(host_s * 1e3, 3),
-    }))
+    })
 
     _maybe_kernel_smoke(backend)
 

@@ -1,0 +1,239 @@
+"""pitlint core: findings, rule protocol, file scanning, baseline, pragmas.
+
+Deliberately jax-free and import-light: the static pass must parse ~130 files
+well inside the tier-1 lint test's 20 s budget, and ``tools/lint.py
+--changed`` must be a sub-second local loop. Rules get one parsed
+:class:`FileContext` per file and return :class:`Finding`\\ s.
+
+Suppression has two tiers with different lifetimes:
+
+- ``# pitlint: ignore[RULE-ID] reason`` on the offending line — for sites
+  that are CORRECT forever (e.g. a wall-clock subtraction that genuinely
+  computes an epoch timestamp). The reason rides the code.
+- the checked-in baseline file — for pre-existing DEBT that should not block
+  CI but should not silently grow either. Baseline keys are line-number-free
+  (``rule|path|scope|message``) so unrelated edits don't invalidate them;
+  each line may carry a ``# justification`` suffix.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.txt")
+
+# ONE definition of the lint scope, shared by tools/lint.py and the tier-1
+# test (tests/test_lint.py) so the fast local loop, CI, and the baseline can
+# never disagree about what is covered:
+# - DEFAULT_TARGETS: the full rule set;
+# - TEST_FAULT_TARGETS: tests/ runs ONLY the fault-site rule (PIT_FAULTS
+#   drill specs in tests must name registered sites — the issue-r13
+#   contract — but test code legitimately prints, reads wall clocks, etc.);
+# - DOC_TARGETS: markdown whose concrete PIT_FAULTS examples are validated.
+DEFAULT_TARGETS = ("perceiver_io_tpu", "tools", "bench.py")
+TEST_FAULT_TARGETS = ("tests",)
+DOC_TARGETS = ("README.md", "PERF.md", "ROADMAP.md", "CHANGES.md")
+
+_PRAGMA = re.compile(r"#\s*pitlint:\s*(?:ignore|disable)\[([A-Za-z0-9*,\s-]+)\]")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one site."""
+
+    rule: str      # "PIT-JIT", "PIT-LOCK", ...
+    path: str      # repo-relative, "/"-separated
+    line: int      # 1-based
+    scope: str     # dotted qualname of the enclosing def/class ("" = module)
+    message: str   # stable text (no line numbers — baseline keys survive edits)
+
+    def key(self) -> str:
+        return f"{self.rule}|{self.path}|{self.scope}|{self.message}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} [{self.scope or '<module>'}] {self.message}"
+
+
+class FileContext:
+    """One parsed source file as the rules see it."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> set of suppressed rule ids ("*" suppresses every rule)
+        self.pragmas: Dict[int, Set[str]] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _PRAGMA.search(text)
+            if m:
+                self.pragmas[i] = {
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                }
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.pragmas.get(line)
+        return rules is not None and (rule in rules or "*" in rules)
+
+
+class Rule:
+    """Base class: subclasses set ``rule_id`` and implement ``check``."""
+
+    rule_id: str = "PIT-???"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, ctx: FileContext, node: ast.AST, scope: str,
+                message: str) -> Finding:
+        return Finding(self.rule_id, ctx.relpath,
+                       getattr(node, "lineno", 0), scope, message)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """Render ``a.b.c`` attribute/name chains; None for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor that maintains the dotted qualname of the current scope."""
+
+    def __init__(self):
+        self._scope: List[str] = []
+
+    @property
+    def scope(self) -> str:
+        return ".".join(self._scope)
+
+    def _visit_scoped(self, node):
+        self._scope.append(node.name)
+        try:
+            self.generic_visit(node)
+        finally:
+            self._scope.pop()
+
+    visit_FunctionDef = _visit_scoped
+    visit_AsyncFunctionDef = _visit_scoped
+    visit_ClassDef = _visit_scoped
+
+
+class Baseline:
+    """The checked-in suppression file: one finding key per line, optional
+    ``# justification`` suffix. Keys are line-number-free (see
+    :meth:`Finding.key`) so they survive unrelated edits."""
+
+    def __init__(self, keys: Optional[Dict[str, str]] = None):
+        self.keys: Dict[str, str] = dict(keys or {})  # key -> justification
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        keys: Dict[str, str] = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                for raw in f:
+                    line = raw.strip()
+                    if not line or line.startswith("#"):
+                        continue
+                    key, _, why = line.partition("  #")
+                    keys[key.strip()] = why.strip()
+        return cls(keys)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write("# pitlint baseline — pre-existing findings that do not "
+                    "block CI.\n# One `rule|path|scope|message` key per line; "
+                    "`  # justification` suffix.\n# Regenerate with: "
+                    "python tools/lint.py --write-baseline\n")
+            for key in sorted(self.keys):
+                why = self.keys[key]
+                f.write(f"{key}  # {why}\n" if why else f"{key}\n")
+
+    def __contains__(self, finding: Finding) -> bool:
+        return finding.key() in self.keys
+
+    def split(self, findings: Sequence[Finding]
+              ) -> Tuple[List[Finding], List[Finding]]:
+        """``(new, baselined)`` partition, preserving order."""
+        new = [f for f in findings if f not in self]
+        old = [f for f in findings if f in self]
+        return new, old
+
+    def stale_keys(self, findings: Sequence[Finding]) -> List[str]:
+        """Baseline entries no current finding matches (debt actually paid
+        down — prune them so the file never protects future regressions)."""
+        live = {f.key() for f in findings}
+        return sorted(k for k in self.keys if k not in live)
+
+
+def all_rules() -> List[Rule]:
+    """The registered static rule set (import here, not at module scope, so
+    ``core`` stays dependency-free for the rule modules themselves)."""
+    from perceiver_io_tpu.analysis.rules_clock import DurationClockRule
+    from perceiver_io_tpu.analysis.rules_contract import ToolContractRule
+    from perceiver_io_tpu.analysis.rules_faults import FaultSiteRule
+    from perceiver_io_tpu.analysis.rules_locks import LockDisciplineRule
+    from perceiver_io_tpu.analysis.rules_purity import JitPurityRule
+
+    return [JitPurityRule(), ToolContractRule(), FaultSiteRule(),
+            LockDisciplineRule(), DurationClockRule()]
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                yield path
+        else:
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        yield os.path.join(dirpath, name)
+
+
+def scan_paths(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
+               root: Optional[str] = None) -> List[Finding]:
+    """Run the static rules over every ``.py`` under ``paths``.
+
+    ``root`` anchors the repo-relative paths findings (and baseline keys)
+    carry; default is the common parent of ``paths``. Unparseable files
+    surface as a ``PIT-PARSE`` finding rather than crashing the pass.
+    """
+    rules = list(rules) if rules is not None else all_rules()
+    if root is None:
+        root = os.path.commonpath([os.path.abspath(p) for p in paths])
+        if os.path.isfile(root):
+            root = os.path.dirname(root)
+    findings: List[Finding] = []
+    for file_path in iter_py_files(paths):
+        relpath = os.path.relpath(os.path.abspath(file_path), root)
+        try:
+            with open(file_path, encoding="utf-8") as f:
+                ctx = FileContext(file_path, relpath, f.read())
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            findings.append(Finding(
+                "PIT-PARSE", relpath.replace(os.sep, "/"),
+                getattr(e, "lineno", 0) or 0, "",
+                f"unparseable: {type(e).__name__}"))
+            continue
+        for rule in rules:
+            for f in rule.check(ctx):
+                if not ctx.suppressed(f.rule, f.line):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

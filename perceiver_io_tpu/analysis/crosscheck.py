@@ -1,0 +1,78 @@
+"""PIT-SHARD: every sharding path-regex matches every preset's param tree.
+
+``parallel/sharding.py`` routes parameters to mesh axes by path regex; the
+param-tree names mirror torch for golden parity (CLAUDE.md invariants). A
+rename — say ``q_proj`` → ``query_proj`` — would break NOTHING loudly: the
+regex simply stops matching, the tensor silently replicates, and tensor
+parallelism quietly degrades to replication. This audit makes that failure
+loud: each rule regex must match at least one parameter path in EACH
+``models/presets.py`` preset tree.
+
+CPU-only by construction: trees come from ``jax.eval_shape`` over
+``model.init`` — shapes trace abstractly, nothing allocates, no backend
+beyond CPU is touched. Runs inside the tier-1 lint test and (by default)
+``tools/lint.py``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, List, Tuple
+
+from perceiver_io_tpu.analysis.core import Finding
+
+RULE_ID = "PIT-SHARD"
+
+
+def _preset_builders() -> Dict[str, Tuple[Callable, int]]:
+    """name -> (builder, max_seq_len). One entry per preset in
+    ``models/presets.py`` — a new preset joins the audit by construction."""
+    from perceiver_io_tpu.models import presets
+
+    return {
+        "tiny_mlm": (presets.tiny_mlm, 64),
+        "flagship_mlm": (presets.flagship_mlm, 512),
+        "flagship_tpu_mlm": (presets.flagship_tpu_mlm, 512),
+    }
+
+
+def preset_param_paths(builder: Callable, max_seq_len: int) -> List[str]:
+    """The "/"-joined param paths of one preset, via shape-only tracing."""
+    import jax
+    import numpy as np
+
+    from perceiver_io_tpu.utils.treepath import simple_keystr
+
+    model = builder()
+    ids = jax.ShapeDtypeStruct((1, max_seq_len), np.int32)
+    pad = jax.ShapeDtypeStruct((1, max_seq_len), np.bool_)
+    variables = jax.eval_shape(
+        model.init,
+        {"params": jax.random.key(0), "masking": jax.random.key(1)},
+        ids, pad,
+    )
+    paths: List[str] = []
+    jax.tree_util.tree_map_with_path(
+        lambda path, leaf: paths.append(simple_keystr(path)),
+        variables["params"],
+    )
+    return paths
+
+
+def audit_sharding_rules() -> List[Finding]:
+    """Findings for every (rule regex, preset) pair with zero matches."""
+    from perceiver_io_tpu.parallel.sharding import PARAM_RULES
+
+    findings: List[Finding] = []
+    for preset_name, (builder, seq_len) in _preset_builders().items():
+        paths = preset_param_paths(builder, seq_len)
+        for pattern, _spec in PARAM_RULES:
+            rx = re.compile(pattern)
+            if not any(rx.search(p) for p in paths):
+                findings.append(Finding(
+                    RULE_ID, "perceiver_io_tpu/parallel/sharding.py", 0,
+                    "PARAM_RULES",
+                    f"rule regex {pattern!r} matches no param path in "
+                    f"preset {preset_name!r} ({len(paths)} paths) — a "
+                    f"param rename silently stranded this sharding rule"))
+    return findings

@@ -1,0 +1,131 @@
+"""PIT-CLOCK: elapsed-time math uses a monotonic clock, never wall clock.
+
+``time.time()`` steps under NTP slew/adjustment; a duration computed from it
+can be negative or wildly wrong, and these durations feed SLO burn rates,
+backoff, and bake windows. ``time.monotonic()`` / ``time.perf_counter()``
+are the sanctioned duration clocks; ``time.time()`` remains correct ONLY as
+a wall-clock *timestamp* (manifest fields, log correlation).
+
+The rule flags subtractions involving wall-clock values:
+
+- a direct ``time.time()`` operand in a ``-`` expression;
+- a name assigned from ``time.time()`` in the same function used in a ``-``
+  expression;
+- a ``self.<attr>`` assigned from ``time.time()`` anywhere in the class,
+  used in a ``-`` expression anywhere in that class.
+
+Sites that subtract wall clocks to *produce another wall-clock timestamp*
+(epoch arithmetic) are the rare legitimate exception — they carry the
+inline pragma with their reasoning.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from perceiver_io_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+)
+
+
+def _is_wallclock_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and dotted_name(node.func) in (
+        "time.time", "time.time_ns")
+
+
+def _self_attr(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return ""
+
+
+class DurationClockRule(Rule):
+    rule_id = "PIT-CLOCK"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        self._check_scope(ctx, ctx.tree, "", findings, set())
+        return findings
+
+    def _check_scope(self, ctx: FileContext, scope_node: ast.AST,
+                     scope: str, findings: List[Finding],
+                     tainted_attrs: Set[str]) -> None:
+        """Recurse per def/class scope so tracked names stay local; a class's
+        tainted ``self.<attr>`` set is inherited by its methods."""
+        if isinstance(scope_node, ast.ClassDef):
+            tainted_attrs = tainted_attrs | self._tainted_self_attrs(
+                scope_node)
+        tainted_names = self._tainted_names(scope_node)
+
+        def walk(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    child_scope = f"{scope}.{child.name}" if scope \
+                        else child.name
+                    self._check_scope(ctx, child, child_scope, findings,
+                                      tainted_attrs)
+                    continue
+                if isinstance(child, ast.BinOp) \
+                        and isinstance(child.op, ast.Sub):
+                    for side in (child.left, child.right):
+                        why = self._wallclock_operand(
+                            side, tainted_names, tainted_attrs)
+                        if why:
+                            findings.append(self.finding(
+                                ctx, child, scope,
+                                f"elapsed-time subtraction over wall clock "
+                                f"({why}) — use time.monotonic() for "
+                                f"durations"))
+                            break
+                walk(child)
+
+        walk(scope_node)
+
+    def _wallclock_operand(self, node: ast.AST, names: Set[str],
+                           attrs: Set[str]) -> str:
+        if _is_wallclock_call(node):
+            return "time.time() operand"
+        if isinstance(node, ast.Name) and node.id in names:
+            return f"{node.id!r} was assigned from time.time()"
+        a = _self_attr(node)
+        if a and a in attrs:
+            return f"self.{a} was assigned from time.time()"
+        return ""
+
+    @staticmethod
+    def _tainted_names(scope_node: ast.AST) -> Set[str]:
+        """Names assigned from time.time() directly in this def scope (not
+        descending into nested defs — their scopes are checked separately)."""
+        out: Set[str] = set()
+
+        def walk(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    continue
+                if isinstance(child, ast.Assign) \
+                        and _is_wallclock_call(child.value):
+                    for t in child.targets:
+                        if isinstance(t, ast.Name):
+                            out.add(t.id)
+                walk(child)
+
+        walk(scope_node)
+        return out
+
+    @staticmethod
+    def _tainted_self_attrs(cls: ast.ClassDef) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and _is_wallclock_call(node.value):
+                for t in node.targets:
+                    a = _self_attr(t)
+                    if a:
+                        out.add(a)
+        return out

@@ -1,0 +1,179 @@
+"""PIT-FAULT: every fault-injection site and drill spec names a registered
+site+kind.
+
+The runtime half of this contract is r13's ``faults.parse_spec`` validation —
+a typo'd ``PIT_FAULTS`` env drill fails loudly at install. This rule is the
+static twin: the *instrumented call sites* (``faults.inject/fire/corrupt``,
+``FaultSpec(site=...)``) and the *example specs* embedded in tests and docs
+are checked against the registered :data:`~perceiver_io_tpu.resilience
+.faults.SITES` and kind set at lint time, so a renamed site cannot leave a
+dangling hook or a doc teaching a drill that silently injects nothing.
+
+Checked shapes:
+
+- ``faults.inject("site")`` / ``faults.fire("site", x)`` /
+  ``faults.corrupt("site", x)`` string-literal first args (module alias or
+  direct import);
+- f-string sites (``f"engine.dispatch.{name}"``): the literal prefix must be
+  a registered suffix-extensible site;
+- ``FaultSpec(site="...", kind="...")`` keyword literals;
+- ``PIT_FAULTS`` spec strings: env assignments/`setenv` calls in code, plus
+  ``PIT_FAULTS="..."`` examples anywhere in the raw source (docstrings) —
+  each is run through ``parse_spec``.
+
+Validation imports :mod:`perceiver_io_tpu.resilience.faults` (numpy-only at
+import; no backend touch), so the lint stays CPU-safe and there is exactly
+ONE registry — the runtime's.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional
+
+from perceiver_io_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    ScopedVisitor,
+    dotted_name,
+)
+
+_HOOKS = {"inject", "fire", "corrupt"}
+# doc examples: only CONCRETE specs (dotted site) are validated — grammar
+# teaching text with meta-variables ("site:kind@WHEN") is not a drill
+_SPEC_RE = re.compile(r"""PIT_FAULTS\s*=\s*["']([a-z_]+\.[^"'\n]+)["']""")
+
+
+def _faults():
+    from perceiver_io_tpu.resilience import faults
+
+    return faults
+
+
+def _site_error(site: str) -> Optional[str]:
+    try:
+        _faults().validate_site(site)
+        return None
+    except ValueError as e:
+        return str(e)
+
+
+def _prefix_error(prefix: str) -> Optional[str]:
+    """An f-string site's literal head must extend a suffix-extensible site
+    (``engine.dispatch.`` + runtime engine name)."""
+    faults = _faults()
+    if any(prefix == s + "." for s in faults._SUFFIXED):
+        return None
+    return (f"f-string fault site prefix {prefix!r} does not extend a "
+            f"registered suffixed site ({', '.join(faults._SUFFIXED)})")
+
+
+def _spec_error(spec: str) -> Optional[str]:
+    try:
+        _faults().parse_spec(spec)
+        return None
+    except ValueError as e:
+        return str(e)
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, rule: "FaultSiteRule", ctx: FileContext):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+
+    def _flag(self, node: ast.AST, message: str) -> None:
+        self.findings.append(
+            self.rule.finding(self.ctx, node, self.scope, message))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in _HOOKS and (name.startswith("faults.")
+                               or name in _HOOKS) and node.args:
+            self._check_site_arg(node.args[0])
+        elif leaf == "FaultSpec":
+            for kw in node.keywords:
+                if kw.arg == "site" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    err = _site_error(kw.value.value)
+                    if err:
+                        self._flag(kw.value, f"FaultSpec: {err}")
+        elif leaf == "setenv" and len(node.args) >= 2:
+            k, v = node.args[0], node.args[1]
+            if (isinstance(k, ast.Constant) and k.value == "PIT_FAULTS"
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                err = _spec_error(v.value)
+                if err:
+                    self._flag(v, f"PIT_FAULTS spec: {err}")
+        self.generic_visit(node)
+
+    def _check_site_arg(self, arg: ast.AST) -> None:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            err = _site_error(arg.value)
+            if err:
+                self._flag(arg, err)
+        elif isinstance(arg, ast.JoinedStr) and arg.values:
+            head = arg.values[0]
+            if isinstance(head, ast.Constant) and isinstance(head.value, str):
+                err = _prefix_error(head.value)
+                if err:
+                    self._flag(arg, err)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # env["PIT_FAULTS"] = "<spec>" / os.environ["PIT_FAULTS"] = ...
+        for target in node.targets:
+            if (isinstance(target, ast.Subscript)
+                    and isinstance(target.slice, ast.Constant)
+                    and target.slice.value == "PIT_FAULTS"
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                err = _spec_error(node.value.value)
+                if err:
+                    self._flag(node.value, f"PIT_FAULTS spec: {err}")
+        self.generic_visit(node)
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        for k, v in zip(node.keys, node.values):
+            if (isinstance(k, ast.Constant) and k.value == "PIT_FAULTS"
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                err = _spec_error(v.value)
+                if err:
+                    self._flag(v, f"PIT_FAULTS spec: {err}")
+        self.generic_visit(node)
+
+
+class FaultSiteRule(Rule):
+    rule_id = "PIT-FAULT"
+
+    # the registry itself (docstring teaches the grammar, error paths embed
+    # deliberately-invalid examples) and the lint suite's own fixtures
+    # (strings that MUST contain invalid sites for the negative tests)
+    SELF_EXCLUDED = ("resilience/faults.py", "tests/test_lint.py")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.relpath.endswith(self.SELF_EXCLUDED):
+            return ()
+        visitor = _Visitor(self, ctx)
+        visitor.visit(ctx.tree)
+        findings = visitor.findings
+        findings.extend(self.check_text(ctx.relpath, ctx.source))
+        return findings
+
+    def check_text(self, relpath: str, text: str) -> List[Finding]:
+        """``PIT_FAULTS="..."`` examples in raw text — docstrings here, and
+        markdown docs when ``tools/lint.py`` feeds them through directly."""
+        findings: List[Finding] = []
+        for i, line in enumerate(text.splitlines(), start=1):
+            for m in _SPEC_RE.finditer(line):
+                err = _spec_error(m.group(1))
+                if err:
+                    findings.append(Finding(
+                        self.rule_id, relpath, i, "",
+                        f"PIT_FAULTS example: {err}"))
+        return findings

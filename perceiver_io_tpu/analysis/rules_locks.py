@@ -1,0 +1,116 @@
+"""PIT-LOCK: declared guarded attributes are touched only under their lock.
+
+Classes declare the discipline themselves, C++-``GUARDED_BY`` style::
+
+    class ServingEngine:
+        _guarded_by = {"_stats": "_stats_lock", "_backlog": "_stats_lock"}
+        _assumes_locked = ("deploy_once",)   # optional: callee runs with the
+                                             # lock already held by its caller
+
+The rule then checks, per method of the class (``__init__`` exempt — no
+other thread can hold a reference yet), that every ``self.<attr>`` load or
+store of a guarded attribute sits lexically inside ``with self.<lock>:``.
+Methods named in ``_assumes_locked`` (or whose name ends ``_locked`` —
+the naming convention the engine already uses, e.g. ``_rotate_locked``)
+are treated as running under the lock.
+
+Lexical containment is deliberately the whole analysis: it cannot prove a
+``_locked`` helper is *only* called under the lock, but it turns "reviewer
+remembers which fields need ``_stats_lock``" into "the class says so and a
+machine checks every touch" — the same trade race detectors make. Genuinely
+lock-free fast paths carry an inline ``# pitlint: ignore[PIT-LOCK]`` pragma
+with their reasoning.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from perceiver_io_tpu.analysis.core import FileContext, Finding, Rule
+
+
+def _literal_str_dict(node: ast.AST) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    if isinstance(node, ast.Dict):
+        for k, v in zip(node.keys, node.values):
+            if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    and isinstance(v, ast.Constant)
+                    and isinstance(v.value, str)):
+                out[k.value] = v.value
+    return out
+
+
+def _literal_str_seq(node: ast.AST) -> Tuple[str, ...]:
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+class LockDisciplineRule(Rule):
+    rule_id = "PIT-LOCK"
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(ctx, node))
+        return findings
+
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> Iterable[Finding]:
+        guarded: Dict[str, str] = {}
+        assumes: Tuple[str, ...] = ()
+        for stmt in cls.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                if stmt.targets[0].id == "_guarded_by":
+                    guarded = _literal_str_dict(stmt.value)
+                elif stmt.targets[0].id == "_assumes_locked":
+                    assumes = _literal_str_seq(stmt.value)
+        if not guarded:
+            return ()
+        findings: List[Finding] = []
+        for stmt in cls.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if stmt.name == "__init__" or stmt.name in assumes \
+                    or stmt.name.endswith("_locked"):
+                continue
+            qual = f"{cls.name}.{stmt.name}"
+            self._scan(ctx, stmt, qual, guarded, frozenset(), findings)
+        return findings
+
+    def _scan(self, ctx: FileContext, node: ast.AST, qual: str,
+              guarded: Dict[str, str], held: frozenset,
+              findings: List[Finding]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.With):
+                locks = set()
+                for item in child.items:
+                    e = item.context_expr
+                    if isinstance(e, ast.Attribute) \
+                            and isinstance(e.value, ast.Name) \
+                            and e.value.id == "self":
+                        locks.add(e.attr)
+                # the with-items themselves evaluate OUTSIDE the lock
+                for item in child.items:
+                    self._scan(ctx, item, qual, guarded, held, findings)
+                inner = held | locks
+                for stmt in child.body:
+                    self._scan(ctx, stmt, qual, guarded,
+                               frozenset(inner), findings)
+                continue
+            if isinstance(child, ast.Attribute) \
+                    and isinstance(child.value, ast.Name) \
+                    and child.value.id == "self" \
+                    and child.attr in guarded \
+                    and guarded[child.attr] not in held:
+                findings.append(self.finding(
+                    ctx, child, qual,
+                    f"self.{child.attr} touched outside "
+                    f"'with self.{guarded[child.attr]}' "
+                    f"(declared in _guarded_by)"))
+            self._scan(ctx, child, qual, guarded, held, findings)

@@ -262,6 +262,13 @@ class ModelDeployer:
     ``rejected`` / ``rolled_back``.
     """
 
+    # pitlint PIT-LOCK: the history log is appended by whichever thread runs
+    # a deployment and read by stats pollers; deploy_once runs with _busy
+    # already held by poll_once (the one-deployment-at-a-time critical
+    # section), so it is declared rather than re-acquiring
+    _guarded_by = {"history": "_busy"}
+    _assumes_locked = ("deploy_once",)
+
     def __init__(
         self,
         publish_dir: str,

@@ -397,6 +397,22 @@ class ServingEngine:
     (true of every model here) and be deterministic (dropout off).
     """
 
+    # pitlint PIT-LOCK (analysis/rules_locks.py): these attributes are shared
+    # between the submit/caller threads and the worker — every touch outside
+    # __init__ must sit inside `with self.<lock>` (lock-free fast paths carry
+    # an inline pragma with their reasoning)
+    _guarded_by = {
+        "_stats": "_stats_lock",
+        "_dispatch_seq": "_stats_lock",
+        "_backlog": "_stats_lock",
+        "_assembling": "_stats_lock",
+        "_pending_params": "_params_lock",
+        "_params_version": "_params_lock",
+        "_params_staged": "_params_lock",
+        "_aot_programs": "_aot_lock",
+        "_aot_claims": "_aot_lock",
+    }
+
     def __init__(
         self,
         apply_fn: Callable[..., Any],
@@ -707,7 +723,9 @@ class ServingEngine:
 
     def _install_pending_params(self) -> None:
         """Worker-only: adopt a staged param tree between micro-batches."""
-        if self._pending_params is None:
+        # lock-free fast path on the per-batch hot loop: a stale None read
+        # just defers the install one micro-batch; the adopt re-reads locked
+        if self._pending_params is None:  # pitlint: ignore[PIT-LOCK] racy-None fast path, install re-reads under the lock
             return
         with self._params_lock:
             pending, self._pending_params = self._pending_params, None
@@ -1412,7 +1430,8 @@ class ServingEngine:
         between-batches install (the replica shim's swap RPC answers only
         once this clears, so a rollout's bake window never watches a
         replica that is still serving the OLD tree)."""
-        return self._pending_params is not None
+        with self._params_lock:
+            return self._pending_params is not None
 
     @property
     def requests_served(self) -> int:

@@ -19,7 +19,8 @@ from typing import Optional
 
 from perceiver_io_tpu.obs.registry import MetricsRegistry, get_registry
 
-__all__ = ["install_process_metrics", "process_rss_bytes", "process_start_time"]
+__all__ = ["install_process_metrics", "process_age_s", "process_rss_bytes",
+           "process_start_time"]
 
 _PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
 
@@ -42,25 +43,45 @@ def process_rss_bytes() -> Optional[float]:
         return None
 
 
+def _boot_relative_start() -> tuple:
+    """``(uptime_s, start_s)`` since boot, both from ``/proc`` — one clock,
+    no wall time involved; raises when ``/proc`` is unreadable."""
+    with open("/proc/self/stat") as f:
+        # field 22 (1-indexed) is starttime in clock ticks since boot;
+        # split after the parenthesized comm, which can contain spaces
+        stat = f.read()
+    start_ticks = float(stat.rsplit(")", 1)[1].split()[19])
+    with open("/proc/uptime") as f:
+        uptime_s = float(f.read().split()[0])
+    return uptime_s, start_ticks / os.sysconf("SC_CLK_TCK")
+
+
 def process_start_time() -> float:
     """Epoch seconds this process started (``/proc`` btime + starttime
     ticks; falls back to this module's import time, which is within the
     interpreter's first imports for every entry point here)."""
     try:
-        with open("/proc/self/stat") as f:
-            # field 22 (1-indexed) is starttime in clock ticks since boot;
-            # split after the parenthesized comm, which can contain spaces
-            stat = f.read()
-        start_ticks = float(stat.rsplit(")", 1)[1].split()[19])
-        with open("/proc/uptime") as f:
-            uptime_s = float(f.read().split()[0])
-        ticks = os.sysconf("SC_CLK_TCK")
-        return time.time() - uptime_s + start_ticks / ticks
+        uptime_s, start_s = _boot_relative_start()
+        # epoch arithmetic, not a duration: converting a boot-relative stamp
+        # to wall time is the one computation that NEEDS the wall clock
+        return time.time() - uptime_s + start_s  # pitlint: ignore[PIT-CLOCK] produces a wall-clock timestamp, not a duration
     except (OSError, IndexError, ValueError):
         return _IMPORT_TIME
 
 
+def process_age_s() -> float:
+    """Seconds this process has been alive, wall-clock-free: both operands
+    come from ``/proc``'s boot-relative clock (an NTP step cannot bend the
+    uptime gauge). Falls back to a monotonic delta from module import."""
+    try:
+        uptime_s, start_s = _boot_relative_start()
+        return uptime_s - start_s
+    except (OSError, IndexError, ValueError):
+        return time.monotonic() - _IMPORT_MONOTONIC
+
+
 _IMPORT_TIME = time.time()
+_IMPORT_MONOTONIC = time.monotonic()
 _INSTALL_LOCK = threading.Lock()
 
 
@@ -88,13 +109,14 @@ def install_process_metrics(registry: Optional[MetricsRegistry] = None):
                      "cumulative garbage collections across generations "
                      "(resampled at scrape)")
     g_fds = reg.gauge("process_open_fds", "open file descriptors")
-    start = process_start_time()
 
     def collect() -> None:
         rss = process_rss_bytes()
         if rss is not None:
             g_rss.set(rss)
-        g_up.set(time.time() - start)
+        # duration, so duration clock: wall-clock subtraction here drifted
+        # the uptime gauge under NTP steps (pitlint PIT-CLOCK)
+        g_up.set(process_age_s())
         g_thr.set(threading.active_count())
         g_gc.set(sum(s.get("collections", 0) for s in gc.get_stats()))
         try:

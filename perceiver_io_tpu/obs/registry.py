@@ -166,6 +166,14 @@ class MetricsRegistry:
     TYPE line in the exposition).
     """
 
+    # pitlint PIT-LOCK: the instrument table and collector list are hit from
+    # every producer thread and every exporter scrape — only under _lock
+    _guarded_by = {
+        "_instruments": "_lock",
+        "_kinds": "_lock",
+        "_collectors": "_lock",
+    }
+
     def __init__(self):
         self._lock = threading.Lock()
         self._instruments: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],

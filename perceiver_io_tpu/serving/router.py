@@ -115,6 +115,15 @@ class Router:
     process replicas and/or in-process :class:`LocalReplica`s — any object
     with the ``call/scrape/drain/resume/update_params`` surface)."""
 
+    # pitlint PIT-LOCK: fleet membership, session pins, and the admission
+    # count are shared between submitters, the dispatch pool, and the scrape
+    # thread — touched only under _lock
+    _guarded_by = {
+        "_slots": "_lock",
+        "_pins": "_lock",
+        "_pending": "_lock",
+    }
+
     def __init__(
         self,
         replicas: Sequence = (),

@@ -1,0 +1,41 @@
+"""The sanctioned stdout channel for ``tools/`` and ``bench.py``.
+
+The driver parses ONE JSON line from each tool's stdout (CLAUDE.md); every
+human-readable table, progress note, and warning rides stderr. pitlint's
+PIT-CONTRACT rule enforces the split statically — :func:`emit_json_line` is
+the only stdout writer it sanctions — and this helper enforces at runtime
+what the AST cannot: the record really serializes, to really one line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, Mapping
+
+
+def emit_json_line(record: Mapping[str, Any]) -> str:
+    """Serialize ``record`` as exactly one JSON line on stdout (flushed).
+
+    Raises ``ValueError`` when the payload would violate the contract (not
+    JSON-serializable, or an embedded newline from a weird string value) —
+    loudly at the emitter, not silently at the driver's parser.
+    """
+    try:
+        line = json.dumps(record)
+    except (TypeError, ValueError) as e:
+        raise ValueError(
+            f"emit_json_line: record is not JSON-serializable: {e}"
+        ) from e
+    if "\n" in line or "\r" in line:
+        raise ValueError(
+            "emit_json_line: serialized record contains a newline — the "
+            "one-JSON-line stdout contract would break"
+        )
+    print(line, file=sys.stdout, flush=True)  # pitlint: ignore[PIT-CONTRACT] the sanctioned emitter itself
+    return line
+
+
+def log(message: str) -> None:
+    """Human-readable tool output (stderr — never the JSON channel)."""
+    print(message, file=sys.stderr, flush=True)

@@ -12,7 +12,10 @@ Call it BEFORE anything touches ``jax.devices()`` / creates arrays.
 
 from __future__ import annotations
 
+import dataclasses
 import os
+import threading
+from typing import Optional
 
 
 def drop_unselected_plugin_backends() -> None:
@@ -95,3 +98,66 @@ def ensure_cpu_only(device_count: int | None = None) -> None:
                 xb._backend_factories.pop(name, None)
     except Exception:
         pass  # private API moved — JAX_PLATFORMS alone may still suffice
+
+
+class BackendProbeTimeout(RuntimeError):
+    """The backend gave no answer within the deadline (wedged tunnel?)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class BackendInfo:
+    """One deadline-bounded snapshot of the selected jax backend."""
+
+    backend: str        # jax.default_backend(): "cpu" / "tpu" / ...
+    device_kind: str    # e.g. "TPU v5 lite"
+    device_count: int
+
+
+_PROBE_CACHE: Optional[BackendInfo] = None
+_PROBE_LOCK = threading.Lock()
+
+
+def probe_backend(deadline_s: float = 60.0) -> BackendInfo:
+    """Resolve the jax backend under a wall-clock deadline.
+
+    On this container any first device touch rides the tunneled PJRT plugin
+    and can hang forever when the tunnel wedges (CLAUDE.md) — so tools never
+    call ``jax.devices()`` / ``jax.default_backend()`` bare (pitlint's
+    PIT-CONTRACT rule enforces it). The probe runs on an abandonable daemon
+    thread (:func:`~perceiver_io_tpu.utils.profiling.call_with_deadline`);
+    on timeout it raises :class:`BackendProbeTimeout` instead of freezing
+    the tool. The first successful answer is cached for the process — a
+    backend does not change identity mid-run, and repeat calls must not
+    spawn probe threads on a hot path.
+
+    ``PIT_BENCH_BACKEND_DEADLINE_S`` overrides ``deadline_s`` when set (the
+    same knob ``bench.py`` honors).
+    """
+    global _PROBE_CACHE
+    if _PROBE_CACHE is not None:
+        return _PROBE_CACHE
+
+    def _probe() -> BackendInfo:
+        import jax
+
+        devices = jax.devices()
+        return BackendInfo(
+            backend=jax.default_backend(),
+            device_kind=getattr(devices[0], "device_kind", "unknown"),
+            device_count=len(devices),
+        )
+
+    from perceiver_io_tpu.utils.profiling import call_with_deadline
+
+    deadline_s = float(
+        os.environ.get("PIT_BENCH_BACKEND_DEADLINE_S", deadline_s))
+    done, info = call_with_deadline(_probe, deadline_s, "backend_probe")
+    if not done:
+        raise BackendProbeTimeout(
+            f"jax backend gave no answer within {deadline_s:g}s "
+            f"(wedged axon tunnel?)"
+        )
+    with _PROBE_LOCK:
+        if _PROBE_CACHE is None:
+            _PROBE_CACHE = info
+    return _PROBE_CACHE

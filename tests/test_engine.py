@@ -135,7 +135,16 @@ def test_engine_bucket_warmup_compiles_once():
     """warmup() compiles one program per power-of-two bucket, and the serving
     stream then NEVER compiles: the traced-call counter (jax traces exactly
     once per compilation) stays at the warmup count across mixed batch
-    sizes, padded buckets, and an oversized chunked request."""
+    sizes, padded buckets, and an oversized chunked request.
+
+    The steady phase runs under BOTH runtime sanitizers (analysis/): the
+    XLA-level ``no_recompile()`` (the trace counter alone cannot see a
+    constant-folding recompile of an unchanged trace) and the armed
+    device→host transfer guard (a silent host fetch on the dispatch or
+    completion path is a per-batch ~100 ms tunnel round trip in
+    production)."""
+    from perceiver_io_tpu.analysis import no_implicit_transfers, no_recompile
+
     traces = [0]
 
     def apply_fn(p, x):
@@ -151,13 +160,15 @@ def test_engine_bucket_warmup_compiles_once():
         assert eng.num_programs == 4
 
         sizes = (1, 2, 3, 5, 8, 19)  # 19 chunks into 8+8+4(padded)
-        futures = [
-            eng.submit(np.full((n, 3), float(n), np.float32)) for n in sizes
-        ]
-        for n, fut in zip(sizes, futures):
-            out = fut.result(timeout=60)
-            assert out.shape == (n, 3)
-            np.testing.assert_allclose(out, n * 2.0 + 1.0)
+        with no_recompile(), no_implicit_transfers():
+            futures = [
+                eng.submit(np.full((n, 3), float(n), np.float32))
+                for n in sizes
+            ]
+            for n, fut in zip(sizes, futures):
+                out = fut.result(timeout=60)
+                assert out.shape == (n, 3)
+                np.testing.assert_allclose(out, n * 2.0 + 1.0)
         assert traces[0] == 4, "steady-state serving must not compile"
 
 

@@ -127,28 +127,38 @@ def test_failover_policy_classification():
 
 def test_router_least_loaded_routing_skewed(x):
     """A replica with an artificially slow dispatch path accumulates queue
-    depth; the router's load score must steer traffic to the fast one."""
-    slow = _make_replica("slowrep")
-    fast = _make_replica("fastrep")
-    prev = faults.install(FaultInjector([
-        FaultSpec(site="engine.dispatch.slowrep-infer", kind="slow",
-                  every=1, delay_s=0.05),
-    ]))
-    try:
-        router = _router([slow, fast])
-        futs = []
-        for _ in range(24):
-            futs.append(router.submit(x))
-            time.sleep(0.005)  # let queue depth become observable
-        for f in futs:
-            f.result(30)
-        served_fast = fast.app.engines["infer"].requests_served
-        served_slow = slow.app.engines["infer"].requests_served
-        assert served_fast + served_slow == 24
-        assert served_fast > served_slow, (served_fast, served_slow)
-        _close(router, slow, fast)
-    finally:
-        faults.install(prev)
+    depth; the router's load score must steer traffic to the fast one.
+
+    Runs under the lock-order sanitizer (analysis/): this traffic crosses
+    the engine worker / submitter / router dispatch-pool / scrape-thread
+    lock soup, and the recorded acquisition graph must stay cycle-free —
+    an inconsistent ordering is a deadlock waiting for the interleaving
+    even when this run never blocks."""
+    from perceiver_io_tpu.analysis import record_lock_order
+
+    with record_lock_order() as lock_rec:
+        slow = _make_replica("slowrep")
+        fast = _make_replica("fastrep")
+        prev = faults.install(FaultInjector([
+            FaultSpec(site="engine.dispatch.slowrep-infer", kind="slow",
+                      every=1, delay_s=0.05),
+        ]))
+        try:
+            router = _router([slow, fast])
+            futs = []
+            for _ in range(24):
+                futs.append(router.submit(x))
+                time.sleep(0.005)  # let queue depth become observable
+            for f in futs:
+                f.result(30)
+            served_fast = fast.app.engines["infer"].requests_served
+            served_slow = slow.app.engines["infer"].requests_served
+            assert served_fast + served_slow == 24
+            assert served_fast > served_slow, (served_fast, served_slow)
+            _close(router, slow, fast)
+        finally:
+            faults.install(prev)
+    assert lock_rec.acquisitions > 0  # the recorder really saw the traffic
 
 
 def test_router_failover_zero_lost_accepted(x):

@@ -103,12 +103,12 @@ def main():
                 times.append(timeit(fn, (q, k, v)))
             except Exception as e:
                 times.append(float("nan"))
-                print(f"{name}: {impl} failed: {type(e).__name__}: {e}")
+                print(f"{name}: {impl} failed: {type(e).__name__}: {e}", file=sys.stderr)
         t_xla, t_pal = times
         # fwd: QKᵀ + PV; bwd adds dq/dk/ds/dp/dv tile matmuls (~2.5x more)
         flops = 4 * b * h * t * s * d * (3.5 if with_grad else 1.0)
         print(f"{name:10s} xla {t_xla*1e3:8.3f} ms ({flops/t_xla/1e12:6.1f} TF/s)   "
-              f"pallas {t_pal*1e3:8.3f} ms ({flops/t_pal/1e12:6.1f} TF/s)")
+              f"pallas {t_pal*1e3:8.3f} ms ({flops/t_pal/1e12:6.1f} TF/s)", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -30,6 +30,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from perceiver_io_tpu.utils.platform import probe_backend
+
 import numpy as np
 
 from bucketed_width_bench import BATCH, BUCKETS, SEQ_CAP, VOCAB, realistic_corpus
@@ -106,7 +108,7 @@ def trainer_rate(dm, label: str) -> float:
     )
     tx, sched = make_optimizer(OptimizerConfig(learning_rate=1e-3))
     state = TrainState.create(variables["params"], tx, jax.random.key(2))
-    head = "pallas" if jax.default_backend() == "tpu" else False
+    head = "pallas" if probe_backend().backend == "tpu" else False
     train_step, eval_step, _ = make_mlm_steps(
         model, sched, loss_gather_capacity=mlm_gather_capacity(SEQ_CAP),
         fused_head=head,
@@ -193,7 +195,7 @@ def trace_ab(root: str) -> None:
         example["token_ids"][:1], example["pad_mask"][:1],
     )
     tx, sched = make_optimizer(OptimizerConfig(learning_rate=1e-3))
-    head = "pallas" if jax.default_backend() == "tpu" else False
+    head = "pallas" if probe_backend().backend == "tpu" else False
     train_step, _, _ = make_mlm_steps(
         model, sched, loss_gather_capacity=mlm_gather_capacity(SEQ_CAP),
         fused_head=head,
@@ -253,14 +255,13 @@ def trace_ab(root: str) -> None:
             )
             print(f"  rep{rep} {which:8s}: share-weighted LQ "
                   f"{weighted * 1e3:.3f} ms/step over {steps} steps ({wd})",
-                  flush=True)
+                  flush=True, file=sys.stderr)
     b = statistics.median(results["buckets"])
     s = statistics.median(results["static"])
     print(
         f"device-trace composed A/B: bucketed {b * 1e3:.3f} vs static "
         f"{s * 1e3:.3f} ms/step -> {s / b:.3f}x ({(s / b - 1) * 100:+.1f}% "
-        f"examples/s)"
-    )
+        f"examples/s)", file=sys.stderr)
 
 
 def main() -> None:
@@ -268,7 +269,7 @@ def main() -> None:
     dm_b = make_module(root, BUCKETS)
     frac, steps_frac = window_stats(dm_b)
     print(f"full {K}-batch windows with buckets {BUCKETS}+cap: {frac:.1%} "
-          f"of windows, {steps_frac:.1%} of steps")
+          f"of windows, {steps_frac:.1%} of steps", file=sys.stderr)
 
     if "--trace-ab" in sys.argv:
         trace_ab(root)
@@ -281,13 +282,12 @@ def main() -> None:
         dm = dm_b if which == "buckets" else dm_s
         r = trainer_rate(dm, which)
         rates[which].append(r)
-        print(f"  {which:8s} K={K}: {r / 1e6:.3f}M tokens/s (trainer loop)")
+        print(f"  {which:8s} K={K}: {r / 1e6:.3f}M tokens/s (trainer loop)", file=sys.stderr)
     b = statistics.median(rates["buckets"])
     s = statistics.median(rates["static"])
     print(
         f"composed win: bucketed {b / 1e6:.3f}M vs static {s / 1e6:.3f}M "
-        f"tokens/s at K={K} -> {b / s:.3f}x ({(b / s - 1) * 100:+.1f}%)"
-    )
+        f"tokens/s at K={K} -> {b / s:.3f}x ({(b / s - 1) * 100:+.1f}%)", file=sys.stderr)
 
 
 if __name__ == "__main__":

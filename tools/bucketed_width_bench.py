@@ -27,6 +27,8 @@ from collections import Counter
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from perceiver_io_tpu.utils.platform import probe_backend
+
 import numpy as np
 
 BUCKETS = [256, 384]  # + the 512 cap appended by the Collator
@@ -117,7 +119,7 @@ def device_step_ms(width: int) -> float:
     )
     tx, sched = make_optimizer(OptimizerConfig(learning_rate=1e-3))
     state = TrainState.create(variables["params"], tx, jax.random.key(2))
-    head = "pallas" if jax.default_backend() == "tpu" else False
+    head = "pallas" if probe_backend().backend == "tpu" else False
     train_step, _, _ = make_mlm_steps(
         model, sched, loss_gather_capacity=mlm_gather_capacity(SEQ_CAP),
         fused_head=head,
@@ -191,7 +193,7 @@ def device_eval_step_ms(width: int) -> float:
     )
     tx, _ = make_optimizer(OptimizerConfig(learning_rate=1e-3))
     state = TrainState.create(variables["params"], tx, jax.random.key(2))
-    head = "pallas" if jax.default_backend() == "tpu" else False
+    head = "pallas" if probe_backend().backend == "tpu" else False
     _, eval_step, _ = make_mlm_steps(
         model, loss_gather_capacity=mlm_gather_capacity(SEQ_CAP),
         fused_head=head,
@@ -212,17 +214,16 @@ def device_eval_step_ms(width: int) -> float:
 def eval_main() -> None:
     shares = eval_width_shares(os.environ.get("PIT_ROOT", ".cache"))
     print("eval bucket shares (r5 width oracle, order preserved):",
-          {w: f"{s:.1%}" for w, s in shares.items()})
+          {w: f"{s:.1%}" for w, s in shares.items()}, file=sys.stderr)
     times = {w: device_eval_step_ms(w) for w in sorted(set(shares) | {SEQ_CAP})}
     for w, ms in times.items():
-        print(f"  width {w}: {ms:.3f} ms/eval-step (device)")
+        print(f"  width {w}: {ms:.3f} ms/eval-step (device)", file=sys.stderr)
     bucketed = sum(shares[w] * times[w] for w in shares)
     static = times[SEQ_CAP]
     print(
         f"eval cost: bucketed {bucketed:.3f} ms/step avg vs static "
         f"{static:.3f} -> {static / bucketed:.3f}x "
-        f"({(static / bucketed - 1) * 100:+.1f}% eval throughput)"
-    )
+        f"({(static / bucketed - 1) * 100:+.1f}% eval throughput)", file=sys.stderr)
 
 
 def main() -> None:
@@ -231,19 +232,18 @@ def main() -> None:
         return
     shares = batch_width_shares(os.environ.get("PIT_ROOT", ".cache"))
     print("bucket shares over one epoch:",
-          {w: f"{s:.1%}" for w, s in shares.items()})
+          {w: f"{s:.1%}" for w, s in shares.items()}, file=sys.stderr)
 
     times = {w: device_step_ms(w) for w in sorted(set(shares) | {SEQ_CAP})}
     for w, ms in times.items():
-        print(f"  width {w}: {ms:.3f} ms/step (device)")
+        print(f"  width {w}: {ms:.3f} ms/step (device)", file=sys.stderr)
 
     bucketed = sum(shares[w] * times[w] for w in shares)
     static = times[SEQ_CAP]
     print(
         f"epoch cost: bucketed {bucketed:.3f} ms/step avg vs static "
         f"{static:.3f} -> {static / bucketed:.3f}x "
-        f"({(static / bucketed - 1) * 100:+.1f}% throughput)"
-    )
+        f"({(static / bucketed - 1) * 100:+.1f}% throughput)", file=sys.stderr)
 
 
 if __name__ == "__main__":

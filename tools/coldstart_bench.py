@@ -46,6 +46,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from perceiver_io_tpu.utils.jsonline import emit_json_line
+from perceiver_io_tpu.utils.platform import probe_backend
+
 import numpy as np
 
 
@@ -78,7 +81,7 @@ def main() -> None:
     from perceiver_io_tpu.models.presets import tiny_mlm
     from perceiver_io_tpu.obs import install_compile_counter
 
-    backend = jax.default_backend()
+    backend = probe_backend().backend
     widths = sorted({int(w) for w in args.widths})
     _log(f"backend: {backend}; widths {widths}; max_batch {args.max_batch}")
 
@@ -148,7 +151,7 @@ def main() -> None:
         if ephemeral:
             shutil.rmtree(cache_dir, ignore_errors=True)
 
-    print(json.dumps({
+    emit_json_line({
         "metric": "coldstart_warmup_speedup",
         "value": round(cold_s / warm_s, 2) if warm_s > 0 else None,
         "unit": "x (cold/warm wall)",
@@ -163,7 +166,7 @@ def main() -> None:
         "compiles_warm": int(compiles_warm),
         "bg_first_result_s": round(first_result_s, 3),
         "bg_family_warm_s": round(bg_warmup_s, 3),
-    }))
+    })
 
 
 if __name__ == "__main__":

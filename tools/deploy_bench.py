@@ -45,6 +45,9 @@ from typing import List, Optional, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from perceiver_io_tpu.utils.jsonline import emit_json_line
+from perceiver_io_tpu.utils.platform import probe_backend
+
 import numpy as np
 
 RECORD_KEYS = (
@@ -105,7 +108,7 @@ def main() -> None:
         record.update(metric="deploy_bench", dry=True,
                       record_keys=list(RECORD_KEYS),
                       per_swap_keys=list(PER_SWAP_KEYS), per_swap=[])
-        print(json.dumps(record))
+        emit_json_line(record)
         return
 
     if args.cpu:
@@ -122,7 +125,7 @@ def main() -> None:
     from perceiver_io_tpu.inference import ServingEngine
     from perceiver_io_tpu.models.presets import flagship_mlm, tiny_mlm
 
-    backend = jax.default_backend()
+    backend = probe_backend().backend
     tiny = args.preset == "tiny" or (args.preset == "auto" and backend != "tpu")
     vocab = 503 if tiny else 10003
     max_seq_len = 64 if tiny else 512
@@ -367,7 +370,7 @@ def main() -> None:
         lr.app.close()
     for e in engines:
         e.close()
-    print(json.dumps(record))
+    emit_json_line(record)
 
 
 if __name__ == "__main__":

@@ -16,6 +16,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from perceiver_io_tpu.utils.platform import probe_backend
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -104,7 +106,7 @@ def config_mlm():
     flash-CE head on TPU)."""
     from perceiver_io_tpu.models.presets import flagship_mlm
 
-    default_head = "pallas" if jax.default_backend() == "tpu" else "none"
+    default_head = "pallas" if probe_backend().backend == "tpu" else "none"
     return _mlm_config(flagship_mlm, 64, default_head)
 
 
@@ -282,7 +284,7 @@ def run(name: str) -> None:
         if u is not None:
             mfu_str = f"   MFU {100 * u:5.1f}%"
     print(f"{name:12s} {seconds * 1e3:9.2f} ms/step   "
-          f"{batch_size / seconds:8.1f} ex/s{mfu_str}")
+          f"{batch_size / seconds:8.1f} ex/s{mfu_str}", file=sys.stderr)
 
 
 def main():
@@ -290,7 +292,7 @@ def main():
     unknown = [n for n in names if n not in CONFIGS]
     if unknown:
         raise SystemExit(f"unknown configs {unknown}; pick from {sorted(CONFIGS)}")
-    print(f"device: {jax.devices()[0].device_kind}, {STEPS} steps per config")
+    print(f"device: {probe_backend().device_kind}, {STEPS} steps per config", file=sys.stderr)
     for name in names:
         run(name)
 

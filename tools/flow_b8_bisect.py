@@ -101,17 +101,17 @@ def main() -> None:
         print(_try_compile(
             "b8-fwd", lambda p, fr: model.apply({"params": p}, fr,
                                                 deterministic=True),
-            params, b8["frames"]), flush=True)
+            params, b8["frames"]), flush=True, file=sys.stderr)
     if want("b8-xla"):
         mx = _model(attn="xla")
         lx = _loss_fn(mx)
         print(_try_compile("b8-xla (grad)", jax.grad(lx), params, _batch(8)),
-              flush=True)
+              flush=True, file=sys.stderr)
     if want("b8-remat"):
         mr = _model(remat=True)
         lr = _loss_fn(mr)
         print(_try_compile("b8-remat (grad)", jax.grad(lr), params, _batch(8)),
-              flush=True)
+              flush=True, file=sys.stderr)
     if want("b8-blocks"):
         import perceiver_io_tpu.ops.pallas_attention as pa
 
@@ -119,15 +119,15 @@ def main() -> None:
         pa.DEFAULT_KV_BLOCK, pa.DEFAULT_Q_BLOCK = 256, 256
         try:
             print(_try_compile("b8-blocks kv256/q256 (grad)", jax.grad(loss),
-                               params, _batch(8)), flush=True)
+                               params, _batch(8)), flush=True, file=sys.stderr)
         finally:
             pa.DEFAULT_KV_BLOCK, pa.DEFAULT_Q_BLOCK = orig_kv, orig_q
     if want("b6"):
         print(_try_compile("b6 (grad)", jax.grad(loss), params, _batch(6)),
-              flush=True)
+              flush=True, file=sys.stderr)
     if want("b8"):
         print(_try_compile("b8 control (grad)", jax.grad(loss), params,
-                           _batch(8)), flush=True)
+                           _batch(8)), flush=True, file=sys.stderr)
 
     if want("accum2x4"):
         # effective batch 8 at b4's compile footprint: scan 2 microbatches,
@@ -150,7 +150,7 @@ def main() -> None:
 
         jitted = jax.jit(accum_step, donate_argnums=(0,))
         res = _try_compile("accum2x4 (train step)", accum_step, state, stacked)
-        print(res, flush=True)
+        print(res, flush=True, file=sys.stderr)
         if "COMPILES" in res:
             import tempfile
 
@@ -167,7 +167,7 @@ def main() -> None:
             sec, n = xplane.device_step_seconds(td, skip_first=2)
             print(f"accum2x4 device step: {sec * 1e3:.2f} ms "
                   f"(= {sec * 1e3 / 8:.2f} ms/example, {8 / sec:.2f} ex/s, "
-                  f"{n} windows)", flush=True)
+                  f"{n} windows)", flush=True, file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -46,7 +46,7 @@ def main() -> None:
     v = jnp.asarray(rng.standard_normal((b, S, H, D)), jnp.bfloat16)
     flops = 4 * b * H * T * S * D * 3.5  # fwd+bwd
 
-    print(f"flow encoder-cross (B={b}, T={T}, S={S}, H={H}, D={D}), fwd+bwd")
+    print(f"flow encoder-cross (B={b}, T={T}, S={S}, H={H}, D={D}), fwd+bwd", file=sys.stderr)
     for kv_blk in KV_BLOCKS:
         for q_blk in Q_BLOCKS:
             attn = functools.partial(
@@ -56,10 +56,10 @@ def main() -> None:
             try:
                 t = timeit(fn, (q, k, v))
                 print(f"  kv {kv_blk:5d} q {q_blk:5d}: {t*1e3:8.2f} ms "
-                      f"({flops/t/1e12:5.1f} TF/s)")
+                      f"({flops/t/1e12:5.1f} TF/s)", file=sys.stderr)
             except Exception as e:
                 print(f"  kv {kv_blk:5d} q {q_blk:5d}: FAILED "
-                      f"{type(e).__name__}: {str(e)[:90]}")
+                      f"{type(e).__name__}: {str(e)[:90]}", file=sys.stderr)
 
 
 if __name__ == "__main__":

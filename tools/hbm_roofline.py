@@ -139,7 +139,7 @@ def model_flops_per_step(config: str) -> float | None:
         )
         return json.loads(out.stdout.strip().splitlines()[-1])["flops"]
     except Exception as e:
-        print(f"(flops subprocess failed: {e}; MFU omitted)")
+        print(f"(flops subprocess failed: {e}; MFU omitted)", file=sys.stderr)
         return None
 
 
@@ -238,25 +238,21 @@ def analyze(trace_dir: str, n_components: int, batch_size: int | None,
     print(
         f"device step: {result['step_ms']:.3f} ms mean / "
         f"{result['step_ms_lower_quartile']:.3f} ms lower-quartile"
-        + (f" ({result['examples_per_sec']:.1f} ex/s)" if batch_size else "")
-    )
+        + (f" ({result['examples_per_sec']:.1f} ex/s)" if batch_size else ""), file=sys.stderr)
     print(
         f"HBM: {result['hbm_gb_per_step']:.2f} GB/step -> "
         f"{result['hbm_gb_s']:.0f} GB/s = {result['hbm_util']*100:.1f}% of "
-        f"{peak_hbm:.0f} GB/s peak; on-chip {result['onchip_gb_s']:.0f} GB/s"
-    )
+        f"{peak_hbm:.0f} GB/s peak; on-chip {result['onchip_gb_s']:.0f} GB/s", file=sys.stderr)
     if "mfu" in result:
         print(
             f"MFU (trace-measured): {result['mfu']*100:.1f}% mean / "
             f"{result['mfu_lower_quartile_step']*100:.1f}% lower-quartile "
             f"({result['model_tf_per_step']:.2f} TF/step vs {peak_tf:.0f} "
-            f"TF/s peak)"
-        )
+            f"TF/s peak)", file=sys.stderr)
     print(
         f"(per-op trace flops sum: {result['trace_op_tf_s']:.1f} TF/s — "
-        f"undercounts Pallas custom-calls)"
-    )
-    print(f"\n{'ms':>7} {'HBM GB/s':>8} {'chip GB/s':>9} {'TF/s':>6}  component")
+        f"undercounts Pallas custom-calls)", file=sys.stderr)
+    print(f"\n{'ms':>7} {'HBM GB/s':>8} {'chip GB/s':>9} {'TF/s':>6}  component", file=sys.stderr)
     rows = sorted(comp.items(), key=lambda kv: -kv[1][0])[:n_components]
     for key, (d, h, o, f) in rows:
         sec = d / 1e12 / n_steps
@@ -264,8 +260,7 @@ def analyze(trace_dir: str, n_components: int, batch_size: int | None,
             continue
         print(
             f"{sec*1e3:7.3f} {h/n_steps/sec/1e9:8.0f} "
-            f"{o/n_steps/sec/1e9:9.0f} {f/n_steps/sec/1e12:6.2f}  {key[:66]}"
-        )
+            f"{o/n_steps/sec/1e9:9.0f} {f/n_steps/sec/1e12:6.2f}  {key[:66]}", file=sys.stderr)
     return result
 
 
@@ -306,23 +301,23 @@ def main() -> None:
         if args.trace_dir is not None:
             if args.flops is None:
                 print("(--trace-dir without --config: MFU omitted — pass "
-                      "the config that produced the trace, or --flops)")
+                      "the config that produced the trace, or --flops)", file=sys.stderr)
         else:
             config = "mlm"
 
     flops = args.flops
     if flops is not None:
-        print(f"(MFU numerator: {flops / 1e12:.2f} TF/step, caller-supplied)")
+        print(f"(MFU numerator: {flops / 1e12:.2f} TF/step, caller-supplied)", file=sys.stderr)
     elif config is not None and not args.no_mfu:
         flops = model_flops_per_step(config)
         if flops:
             print(f"(MFU numerator: {config} config, "
-                  f"{flops / 1e12:.2f} TF/step from XLA cost analysis)")
+                  f"{flops / 1e12:.2f} TF/step from XLA cost analysis)", file=sys.stderr)
     trace_dir = args.trace_dir
     batch_size = args.batch_size
     if trace_dir is None:
         trace_dir = tempfile.mkdtemp(prefix=f"hbm_roofline_{config}_")
-        print(f"capturing {args.steps}-step {config} trace to {trace_dir} ...")
+        print(f"capturing {args.steps}-step {config} trace to {trace_dir} ...", file=sys.stderr)
         batch_size = capture_trace(trace_dir, config, args.steps)
     analyze(trace_dir, args.components, batch_size, flops)
 

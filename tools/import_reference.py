@@ -51,14 +51,13 @@ def _import_ckpt(args: argparse.Namespace) -> None:
     print(
         f"imported {args.checkpoint} -> {args.out}: "
         f"{n_leaves} arrays, {n_params:,} parameters"
-        + (" (encoder subtree only)" if args.encoder_only else "")
-    )
+        + (" (encoder subtree only)" if args.encoder_only else ""), file=sys.stderr)
     if hparams:
         shape_keys = sorted(
             k for k in hparams
             if k.startswith(("num_", "vocab_", "max_seq", "dropout"))
         )
-        print("hparams:", {k: hparams[k] for k in shape_keys})
+        print("hparams:", {k: hparams[k] for k in shape_keys}, file=sys.stderr)
 
 
 def _export_ckpt(args: argparse.Namespace) -> None:
@@ -84,8 +83,7 @@ def _export_ckpt(args: argparse.Namespace) -> None:
     print(
         f"exported {args.checkpoint_dir} (step {step}) -> {args.out}: "
         f"{n_params:,} parameters as a reference-loadable Lightning .ckpt "
-        f"({args.layout} layout)"
-    )
+        f"({args.layout} layout)", file=sys.stderr)
 
 
 def _import_tokenizer(args: argparse.Namespace) -> None:
@@ -94,11 +92,10 @@ def _import_tokenizer(args: argparse.Namespace) -> None:
     tok = WordPieceTokenizer.from_file(args.tokenizer)
     print(
         f"loaded {args.tokenizer}: vocab {tok.get_vocab_size()}, "
-        f"replacements {tok.replacements}"
-    )
+        f"replacements {tok.replacements}", file=sys.stderr)
     if args.out:
         tok.save(args.out, format=args.format)
-        print(f"saved -> {args.out} ({args.format} schema)")
+        print(f"saved -> {args.out} ({args.format} schema)", file=sys.stderr)
 
 
 def main(argv=None) -> None:

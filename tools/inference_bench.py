@@ -59,6 +59,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from perceiver_io_tpu.utils.jsonline import emit_json_line
+from perceiver_io_tpu.utils.platform import probe_backend
+
 # NOTE: jax is imported inside main() AFTER --cpu is handled —
 # utils.platform.ensure_cpu_only must run before any backend initializes.
 import numpy as np
@@ -107,7 +110,7 @@ def _device_per_call(fn, trace_dir: str, calls: int = 12):
         return sec
     except Exception as e:
         print(f"  (device trace unavailable: {type(e).__name__}: "
-              f"{str(e)[:80]})")
+              f"{str(e)[:80]})", file=sys.stderr)
         return None
 
 
@@ -220,7 +223,7 @@ def _engine_mode(args) -> None:
     from perceiver_io_tpu.inference.mlm import encode_masked_texts
 
     log = lambda *a: print(*a, file=sys.stderr)
-    backend = jax.default_backend()
+    backend = probe_backend().backend
     tiny = args.preset == "tiny" or (args.preset == "auto" and backend != "tpu")
     log(f"backend: {backend}; preset {'tiny' if tiny else 'flagship'}; "
         f"dtype {args.dtype}; {args.requests} requests x {args.rounds} rounds")
@@ -324,7 +327,7 @@ def _engine_mode(args) -> None:
                 f"{str(e)[:80]})")
 
     engine.close()
-    print(json.dumps(results))
+    emit_json_line(results)
 
 
 def main() -> None:
@@ -368,8 +371,8 @@ def main() -> None:
         _engine_mode(args)
         return
 
-    backend = jax.default_backend()
-    print(f"backend: {backend}; dtype {args.dtype}")
+    backend = probe_backend().backend
+    print(f"backend: {backend}; dtype {args.dtype}", file=sys.stderr)
     predictor, texts, model, params, vocab, max_seq_len = _build_predictor(
         args.dtype
     )
@@ -377,9 +380,9 @@ def main() -> None:
     trace_root = args.trace_dir or tempfile.mkdtemp(prefix="inference_bench_")
 
     # 1) fill_masks latency/throughput ------------------------------------
-    print("\nfill_masks (2 [MASK] per text, k=5):")
+    print("\nfill_masks (2 [MASK] per text, k=5):", file=sys.stderr)
     print(f"{'batch':>6} {'host ms/call':>13} {'device ms/call':>15} "
-          f"{'texts/s (host)':>15}")
+          f"{'texts/s (host)':>15}", file=sys.stderr)
     for n in (1, 8, 64):
         batch = texts[:n]
         host = _median_latency(lambda: predictor.fill_masks(batch, k=5))
@@ -388,7 +391,7 @@ def main() -> None:
             os.path.join(trace_root, f"fill{n}"),
         )
         print(f"{n:>6} {host * 1e3:>13.2f} {_ms(dev):>15} "
-              f"{n / host:>15.1f}")
+              f"{n / host:>15.1f}", file=sys.stderr)
         results[f"fill_masks_b{n}_host_ms"] = round(host * 1e3, 3)
         if dev is not None:
             results[f"fill_masks_b{n}_device_ms"] = round(dev * 1e3, 4)
@@ -423,12 +426,12 @@ def main() -> None:
     dev_exact5 = _device_per_call(
         lambda: _consume(exact5(params, ids5, pad5, pos5)),
         os.path.join(trace_root, "exact5"))
-    print("\nbucket padding (5 texts -> 8-bucket, gathered decode):")
+    print("\nbucket padding (5 texts -> 8-bucket, gathered decode):", file=sys.stderr)
     print(f"  bucketed@5   host {host_b5 * 1e3:7.2f} ms   device "
-          f"{_ms(dev_b5)} ms")
-    print(f"  native@8     host {host_b8 * 1e3:7.2f} ms")
+          f"{_ms(dev_b5)} ms", file=sys.stderr)
+    print(f"  native@8     host {host_b8 * 1e3:7.2f} ms", file=sys.stderr)
     print(f"  exact-jit@5  host {host_exact5 * 1e3:7.2f} ms   device "
-          f"{_ms(dev_exact5)} ms")
+          f"{_ms(dev_exact5)} ms", file=sys.stderr)
     results.update(
         bucket5_host_ms=round(host_b5 * 1e3, 3),
         native8_host_ms=round(host_b8 * 1e3, 3),
@@ -477,12 +480,12 @@ def main() -> None:
         os.path.join(trace_root, "livejit"))
     size_mb = os.path.getsize(art) / 1e6
     print(f"\nStableHLO export (b8 gathered forward, artifact "
-          f"{size_mb:.1f} MB, export took {export_s:.1f} s):")
+          f"{size_mb:.1f} MB, export took {export_s:.1f} s):", file=sys.stderr)
     print(f"  exported  first-result {exported_first_s:6.1f} s   steady "
           f"host {host_exported * 1e3:7.2f} ms   device "
-          f"{_ms(dev_exported)} ms")
+          f"{_ms(dev_exported)} ms", file=sys.stderr)
     print(f"  live jit  first-result {live_first_s:6.1f} s   steady "
-          f"host {host_live * 1e3:7.2f} ms   device {_ms(dev_live)} ms")
+          f"host {host_live * 1e3:7.2f} ms   device {_ms(dev_live)} ms", file=sys.stderr)
     results.update(
         export_artifact_mb=round(size_mb, 2),
         export_s=round(export_s, 2),
@@ -496,8 +499,8 @@ def main() -> None:
     if dev_live is not None:
         results["live_device_ms"] = round(dev_live * 1e3, 4)
 
-    print()
-    print(json.dumps(results))
+    print(file=sys.stderr)
+    emit_json_line(results)
 
 
 if __name__ == "__main__":

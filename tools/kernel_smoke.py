@@ -44,6 +44,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from perceiver_io_tpu.utils.jsonline import emit_json_line
+from perceiver_io_tpu.utils.platform import probe_backend
+
 import numpy as np
 
 
@@ -162,7 +165,7 @@ def _sp_case():
     q = jnp.asarray(rng.normal(0, 1, (2, 256, 4, 16)), jnp.bfloat16)
     k = jnp.asarray(rng.normal(0, 1, (2, 4096, 4, 16)), jnp.bfloat16)
     v = jnp.asarray(rng.normal(0, 1, (2, 4096, 4, 16)), jnp.bfloat16)
-    mesh = make_mesh(dp=1, tp=1, sp=jax.device_count())
+    mesh = make_mesh(dp=1, tp=1, sp=probe_backend().device_count)
 
     def sp_loss(q, k, v):
         out = seq_parallel_fused_attention(q, k, v, mesh=mesh, axis="seq")
@@ -220,8 +223,7 @@ def run(out_path: str | None, dry: bool = False) -> int:
             "skipped": sorted(CASES),
             "failures": {},
         }
-        line = json.dumps(report)
-        print(line)
+        line = emit_json_line(report)
         if out_path:
             with open(out_path, "w") as f:
                 f.write(line + "\n")
@@ -241,15 +243,14 @@ def run(out_path: str | None, dry: bool = False) -> int:
             failures[name] = f"{type(e).__name__}: {str(e)[:300]}"
     report = {
         "metric": "kernel_smoke",
-        "backend": jax.default_backend(),
-        "device": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "backend": probe_backend().backend,
+        "device": probe_backend().device_kind,
         "passed": len(results),
         "total": len(CASES),
         "cases": results,
         "failures": failures,
     }
-    line = json.dumps(report)
-    print(line)
+    line = emit_json_line(report)
     if out_path:
         with open(out_path, "w") as f:
             f.write(line + "\n")

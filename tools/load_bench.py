@@ -63,6 +63,9 @@ from typing import Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from perceiver_io_tpu.utils.jsonline import emit_json_line
+from perceiver_io_tpu.utils.platform import probe_backend
+
 # NOTE: jax is imported inside the run path AFTER --cpu is handled —
 # utils.platform.ensure_cpu_only must run before any backend initializes.
 import numpy as np
@@ -345,7 +348,7 @@ def main() -> None:
             "fleet_keys": list(FLEET_KEYS), "deploy_keys": list(DEPLOY_KEYS),
             "sweep": [], "capacity": None, "fleet": None, "deploy": None,
         }
-        print(json.dumps(record))
+        emit_json_line(record)
         return
 
     if args.cpu:
@@ -364,7 +367,7 @@ def main() -> None:
 
     assert tuple(PHASES) == PHASE_KEYS, "load_bench PHASE_KEYS drifted"
 
-    backend = jax.default_backend()
+    backend = probe_backend().backend
     tiny = args.preset == "tiny" or (args.preset == "auto" and backend != "tpu")
     _log(f"backend: {backend}; preset {'tiny' if tiny else 'flagship'}; "
          f"arrival {args.arrival}; duration {args.duration_s}s/point"
@@ -679,7 +682,7 @@ def main() -> None:
         sup.stop()
     if engine is not None:
         engine.close()
-    print(json.dumps(record))
+    emit_json_line(record)
 
 
 if __name__ == "__main__":

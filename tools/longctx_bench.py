@@ -21,6 +21,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from perceiver_io_tpu.utils.platform import probe_backend
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -45,7 +47,7 @@ def main() -> None:
     configs = sys.argv[1:] or DEFAULT_CONFIGS
     vocab = 10003
     rng = np.random.default_rng(0)
-    on_tpu = jax.default_backend() == "tpu"
+    on_tpu = probe_backend().backend == "tpu"
     for spec in configs:
         seq_len, batch = (int(x) for x in spec.split(":"))
         model = flagship_mlm(
@@ -83,8 +85,7 @@ def main() -> None:
             method = "host_clock"
         print(
             f"seq {seq_len} batch {batch}: {dev_s * 1e3:7.3f} ms/step  "
-            f"{batch * seq_len / dev_s:9.0f} tokens/s/chip  [{method}]"
-        )
+            f"{batch * seq_len / dev_s:9.0f} tokens/s/chip  [{method}]", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -20,6 +20,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from perceiver_io_tpu.utils.platform import probe_backend
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -157,7 +159,7 @@ def fwd_only_variant():
 
 
 def main():
-    print(f"device: {jax.devices()[0].device_kind}, batch {BATCH}, {STEPS} steps")
+    print(f"device: {probe_backend().device_kind}, batch {BATCH}, {STEPS} steps", file=sys.stderr)
     rows = [
         ("full (bench default)", standard(build())),
         ("full-decode (no gather)", standard(build(), gather=False)),
@@ -169,7 +171,7 @@ def main():
     for name, (step, state, b) in rows:
         ms = time_step(step, state, b) * 1e3
         toks = BATCH * SEQ / (ms / 1e3)
-        print(f"{name:28s} {ms:8.2f} ms/step   {toks/1e6:6.2f}M tokens/s")
+        print(f"{name:28s} {ms:8.2f} ms/step   {toks/1e6:6.2f}M tokens/s", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -12,6 +12,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from perceiver_io_tpu.utils.platform import probe_backend
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -59,7 +61,7 @@ def run(attn_impl: str, batch_size=64, steps=20, gather=None):
     mfu_str = f"  MFU {100 * u:.1f}%" if u is not None else ""
     tag = f"{attn_impl}+g{gather}" if gather else attn_impl
     print(f"{tag:12s} step {dt*1e3:7.2f} ms  {toks/1e6:6.2f} Mtok/s  "
-          f"flops/step {flops/1e9:.1f} G{mfu_str}")
+          f"flops/step {flops/1e9:.1f} G{mfu_str}", file=sys.stderr)
 
 
 if __name__ == "__main__":
@@ -67,7 +69,7 @@ if __name__ == "__main__":
 
     peak = profiling.device_peak_flops()
     peak_str = f", peak {peak/1e12:.0f} TF/s" if peak else " (no known peak: MFU off)"
-    print(f"device: {jax.devices()[0].device_kind}{peak_str}")
+    print(f"device: {probe_backend().device_kind}{peak_str}", file=sys.stderr)
     cap = mlm_gather_capacity(512)
     for impl in ("xla", "pallas"):
         run(impl)

@@ -47,6 +47,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from perceiver_io_tpu.utils.jsonline import emit_json_line
+from perceiver_io_tpu.utils.platform import probe_backend
+
 # jax is imported inside main() AFTER --cpu is handled (ensure_cpu_only must
 # run before any backend initializes)
 import numpy as np
@@ -160,7 +163,7 @@ def main() -> None:
     from perceiver_io_tpu import quant
     from perceiver_io_tpu.inference import ServingEngine
 
-    backend = jax.default_backend()
+    backend = probe_backend().backend
     tiny = args.preset == "tiny" or (args.preset == "auto" and backend != "tpu")
     _log(f"backend: {backend}; preset {'tiny' if tiny else 'flagship'}; "
          f"{args.requests} requests x {args.rounds} rounds")
@@ -277,7 +280,7 @@ def main() -> None:
         for eng in engines.values():
             eng.close()
 
-    print(json.dumps(results))
+    emit_json_line(results)
 
 
 if __name__ == "__main__":

@@ -20,6 +20,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from perceiver_io_tpu.utils.platform import probe_backend
+
 import numpy as np
 
 
@@ -43,8 +45,8 @@ def main() -> None:
         mlm_gather_capacity,
     )
 
-    n = len(jax.devices())
-    print(f"backend={jax.default_backend()} devices={n}")
+    n = probe_backend().device_count
+    print(f"backend={probe_backend().backend} devices={n}", file=sys.stderr)
 
     vocab, seq = 10003, args.seq
     model = flagship_mlm(
@@ -85,8 +87,7 @@ def main() -> None:
         assert np.isfinite(loss), f"non-finite loss {loss}"
         print(
             f"OK mesh(data={dp}, model={tp}, seq={sp}) seq={seq} "
-            f"attn=pallas loss={loss:.4f}"
-        )
+            f"attn=pallas loss={loss:.4f}", file=sys.stderr)
 
 
 if __name__ == "__main__":
