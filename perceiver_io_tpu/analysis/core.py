@@ -185,9 +185,10 @@ def all_rules() -> List[Rule]:
     from perceiver_io_tpu.analysis.rules_faults import FaultSiteRule
     from perceiver_io_tpu.analysis.rules_locks import LockDisciplineRule
     from perceiver_io_tpu.analysis.rules_purity import JitPurityRule
+    from perceiver_io_tpu.analysis.rules_spans import SpanNameRule
 
     return [JitPurityRule(), ToolContractRule(), FaultSiteRule(),
-            LockDisciplineRule(), DurationClockRule()]
+            LockDisciplineRule(), DurationClockRule(), SpanNameRule()]
 
 
 def iter_py_files(paths: Sequence[str]) -> Iterable[str]:

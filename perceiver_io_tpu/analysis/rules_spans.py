@@ -1,0 +1,78 @@
+"""PIT-SPAN: every literal span name at a record_span site is registered.
+
+The PIT-FAULT pattern applied to distributed tracing: span names are string
+literals scattered across router/replica/deploy code, and the assembler
+(``obs.reqtrace.assemble_traces`` / ``tools/trace_assemble.py``), the tests,
+and the docs all match on them — a renamed or typo'd span would silently
+decouple its hop from every assembled trace. The runtime registry is
+:data:`perceiver_io_tpu.obs.reqtrace.SPAN_NAMES` (ONE definition — this rule
+imports it, stdlib-only at import, so the lint stays CPU-safe); the checked
+shapes are ``record_span("name", ...)`` / ``obs.record_span`` /
+``reqtrace.record_span`` string-literal first arguments.
+
+The synthesized assembly-side names (``engine``, ``phase:<name>``) never
+appear at a record site — they exist only inside the assembler — so the
+registry stays exactly the set of *recorded* span names.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from perceiver_io_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    ScopedVisitor,
+    dotted_name,
+)
+
+
+def _span_names():
+    from perceiver_io_tpu.obs.reqtrace import SPAN_NAMES
+
+    return SPAN_NAMES
+
+
+def _name_error(name: str) -> Optional[str]:
+    registered = _span_names()
+    if name in registered:
+        return None
+    return (f"span name {name!r} is not registered in "
+            f"obs.reqtrace.SPAN_NAMES ({', '.join(sorted(registered))})")
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, rule: "SpanNameRule", ctx: FileContext):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf == "record_span" and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                err = _name_error(arg.value)
+                if err:
+                    self.findings.append(self.rule.finding(
+                        self.ctx, arg, self.scope, err))
+        self.generic_visit(node)
+
+
+class SpanNameRule(Rule):
+    rule_id = "PIT-SPAN"
+
+    # the registry module itself (docstring examples) and the lint suite's
+    # fixtures (strings that MUST contain invalid names for negative tests)
+    SELF_EXCLUDED = ("obs/reqtrace.py", "tests/test_lint.py")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.relpath.endswith(self.SELF_EXCLUDED):
+            return ()
+        visitor = _Visitor(self, ctx)
+        visitor.visit(ctx.tree)
+        return visitor.findings

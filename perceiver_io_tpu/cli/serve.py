@@ -309,6 +309,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "write — sample at high request rates; the "
                         "serving_phase_seconds histograms keep the "
                         "full-rate view regardless)")
+    o.add_argument("--trace_sample", type=float, default=1.0,
+                   help="distributed request tracing head-sampling rate: "
+                        "the fraction of requests that mint a TraceContext "
+                        "(router/engine submit) and record spans at every "
+                        "hop into --events_jsonl. In --replicas mode each "
+                        "replica process writes its own "
+                        "<events_jsonl>.<replica> log; assemble "
+                        "per-request trace trees with "
+                        "tools/trace_assemble.py. 0 disables; tail-based "
+                        "retention happens at assembly")
     o.add_argument("--slo_p99_ms", type=float, default=None,
                    help="serving SLO latency target: a request answered "
                         "within this many ms counts good, sheds/errors and "
@@ -489,6 +499,7 @@ def _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint,
         compile_cache=args.compile_cache,
         slo=slo,
         span_every=args.span_every,
+        trace_sample=args.trace_sample,
     ) as server:
         warmup_handle = None
         if not args.no_warmup:
@@ -678,14 +689,35 @@ def _serve_fleet(args, drain_state):
         results.append(line)
         print(json.dumps(line))
 
+    sup_kw = {}
+    if args.events_jsonl:
+        # every fleet process owns its own JSONL (concurrent writers on one
+        # file would tear lines): the router writes args.events_jsonl, each
+        # replica <events_jsonl>.<name> — trace_assemble merges them into
+        # per-request trace trees with cross-process clock alignment. The
+        # rotation bound rides along; --trace_sample deliberately does NOT
+        # (the ROUTER owns the head-sampling decision — replicas default
+        # to never self-minting, so an unsampled request stays unsampled
+        # at every hop instead of double-sampling)
+        from perceiver_io_tpu.serving.supervisor import default_replica_argv
+
+        def _replica_argv(name, port):
+            return default_replica_argv(
+                name, port,
+                extra=[*extra, "--events_jsonl",
+                       f"{args.events_jsonl}.{name}",
+                       "--events_max_mb", str(args.events_max_mb)])
+
+        sup_kw["argv_builder"] = _replica_argv
     with ReplicaSupervisor(count=args.replicas, extra_args=extra,
-                           cpu=args.cpu) as sup:
+                           cpu=args.cpu, **sup_kw) as sup:
         clients = sup.start()
         print(f"serve: spawned {args.replicas} replicas; waiting for warm "
               "pools (engine_ready)", file=sys.stderr, flush=True)
         sup.wait_ready(timeout_s=600.0)
         with Router(clients, name="serve",
-                    queue_limit=args.queue_limit) as router:
+                    queue_limit=args.queue_limit,
+                    trace_sample=args.trace_sample) as router:
             router.refresh()
             deployer = None
             if args.watch_checkpoints:

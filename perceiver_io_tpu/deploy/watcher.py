@@ -399,6 +399,14 @@ class ModelDeployer:
                 record["swap_s"] = time.monotonic() - t_swap
         record["t_done"] = time.monotonic()
         record["seconds"] = record["t_done"] - t0
+        if "t_swap" in record:
+            # the install-start → bake-end interval as a trace-less context
+            # span: trace assembly overlays it on whatever requests were in
+            # flight (the swap-blip window, now attributable per trace)
+            obs.record_span(
+                "deploy_swap", None, record["t_swap"],
+                record["t_done"] - record["t_swap"], deploy=self.name,
+                step=info.step, action=record["action"])
         self.history.append(record)
         obs.event("deploy_result", deploy=self.name, **{
             k: record.get(k) for k in ("action", "step", "reason", "detail")})

@@ -81,14 +81,17 @@ SLO observability (``perceiver_io_tpu.obs.slo``, ``tools/load_bench.py``):
 every request part carries phase timestamps through its whole lifecycle —
 submit → queue → batch assembly → dispatch → device compute → completion —
 exported per phase as ``serving_phase_seconds{phase=...}`` histograms, as
-JSONL ``request_phases`` spans when an event log is configured
-(``span_every=N`` samples them — each span is a synchronous write), and on
-the caller's future (``fut.phases``). The phases are consecutive timestamp
-diffs, so their sum reconciles with the end-to-end
+JSONL spans when an event log is configured (untraced traffic:
+``request_phases`` per part, sampled by ``span_every``; traced requests —
+``submit(trace=)`` or an engine-minted root under ``trace_sample`` — ride
+the compact spooled ``request_phases_batch`` record, assembled into
+distributed trace trees by ``obs.reqtrace``/``tools/trace_assemble.py``),
+and on the caller's future (``fut.phases``). The phases are consecutive
+timestamp diffs, so their sum reconciles with the end-to-end
 ``serving_latency_seconds`` by construction (``serving_phase_sum_ratio`` is
-the live self-check; the test suite pins the p50 reconciliation within 5%).
-Tail latency therefore ATTRIBUTES: "p99 is high" becomes "p99 is high
-because admission wait, not device time". Passing ``slo=obs.SLO(...)``
+the live self-check; the test suite pins the p50 reconciliation within 5%,
+cross-process since r15). Tail latency therefore ATTRIBUTES: "p99 is high"
+becomes "p99 is high because admission wait, not device time". Passing ``slo=obs.SLO(...)``
 additionally classifies every completion/shed against a declarative
 objective — error-budget burn-rate gauges ride ``/statz`` and ``healthz()``,
 and ``tools/load_bench.py`` fits the measured capacity model
@@ -142,6 +145,10 @@ from perceiver_io_tpu.resilience import (
 )
 
 _IDLE_POLL_S = 0.05  # worker wake-up cadence while idle (checks shutdown)
+_TRACE_SPOOL_ROWS = 64  # traced span rows per flushed JSONL record (the
+# spool also flushes at the first idle moment and on worker exit, so span
+# visibility lags only while the engine is saturated — when offline
+# assembly is the consumer anyway)
 
 # per-request lifecycle phases, in order; consecutive timestamp diffs, so the
 # sum reconciles with the end-to-end latency by construction (the self-check
@@ -271,7 +278,8 @@ class _Future:
     """
 
     def __init__(self, num_parts: int = 1,
-                 transform: Optional[Callable[[Any], Any]] = None):
+                 transform: Optional[Callable[[Any], Any]] = None,
+                 trace: Optional[obs.TraceContext] = None):
         self._event = threading.Event()
         self._parts: List[Any] = [None] * num_parts
         self._remaining = num_parts
@@ -281,6 +289,7 @@ class _Future:
         self._assembled = None
         self._has_result = False
         self._phases: List[Dict[str, float]] = []
+        self.trace = trace  # distributed-trace context (None = untraced)
 
     def _note_phases(self, phases: Dict[str, float]) -> None:
         with self._lock:
@@ -437,6 +446,7 @@ class ServingEngine:
         cache_salt: str = "",
         slo: Optional[obs.SLO] = None,
         span_every: int = 1,
+        trace_sample: float = 1.0,
     ):
         import jax
         import jax.numpy as jnp
@@ -626,12 +636,20 @@ class ServingEngine:
         if slo is not None:
             self.slo_tracker = obs.SLOTracker(slo, registry=reg, labels=labels)
 
-        # JSONL request_phases spans are a locked write+flush per emission —
-        # at thousands of req/s that synchronous disk touch sits on the
-        # completion path, so high-rate serving samples every Nth part
-        # (the registry histograms keep the full-rate view regardless)
+        # untraced JSONL request_phases spans sample every Nth part (the
+        # registry histograms keep the full-rate view regardless); TRACED
+        # parts instead spool compact rows that flush as ONE record per
+        # _TRACE_SPOOL_ROWS completions (or at the first idle moment /
+        # worker exit), so full tracing amortizes its serialization the
+        # way the dispatch amortizes everything else
         self._span_every = max(1, int(span_every))
+        self._trace_spool: List[list] = []  # worker-thread-only
         self._span_seq = 0  # worker-thread-only
+        # distributed tracing: requests arriving WITHOUT a propagated
+        # context (single-process serving) mint their own root at this
+        # head-sampling rate once an event log is configured; propagated
+        # contexts (the replica shim) carry the router's decision instead
+        self._trace_sample = float(trace_sample)
 
         self.heartbeat = obs.Heartbeat(
             f"{name}-dispatch", deadline_s=heartbeat_deadline_s,
@@ -757,7 +775,8 @@ class ServingEngine:
                 self.slo_tracker.record(ok=False)
 
     def submit(self, *inputs, transform: Optional[Callable] = None,
-               deadline_s: Optional[float] = None) -> _Future:
+               deadline_s: Optional[float] = None,
+               trace: Optional[obs.TraceContext] = None) -> _Future:
         """Enqueue one request (arrays sharing a leading batch axis); returns
         a future whose ``result()`` is the output pytree sliced to this
         request's rows (numpy, on host).
@@ -768,8 +787,19 @@ class ServingEngine:
         instead of occupying the queue as dead work. Admission can also
         fast-fail with :class:`RejectedError` (queue full) or
         :class:`BreakerOpen` (device presumed down).
+
+        ``trace`` joins this request to a distributed trace (the replica
+        shim propagates the router's context here); with none given and an
+        event log configured, a fresh root is minted (head sampling via the
+        engine's ``trace_sample``) — single-process serving traces too.
+        Traced parts always emit their engine span, riding the compact
+        per-micro-batch ``request_phases_batch`` record (``span_every``
+        sampling applies only to untraced traffic: a tail-sampled trace
+        with a missing engine hop would assemble as a hole).
         """
         t_entry = time.monotonic()
+        if trace is None:
+            trace = obs.maybe_trace(self._trace_sample)
         if self._stop.is_set():
             raise self._closed_error()
         if self._draining.is_set():
@@ -804,7 +834,7 @@ class ServingEngine:
         if any(a.shape[0] != n for a in arrays):
             raise ValueError("all inputs must share the leading batch axis")
         if n == 0:
-            fut = _Future(1, transform)
+            fut = _Future(1, transform, trace=trace)
             fut._deliver(0, self._empty_result(arrays))
             return fut
         starts = list(range(0, n, self.max_batch))
@@ -827,7 +857,7 @@ class ServingEngine:
                 f"engine {self.name!r}: queue full ({backlog} parts "
                 f"backlogged, limit {self.queue_limit}) — request shed"
             )
-        fut = _Future(len(starts), transform)
+        fut = _Future(len(starts), transform, trace=trace)
         deadline = (
             None if deadline_s is None else time.monotonic() + deadline_s
         )
@@ -1019,6 +1049,10 @@ class ServingEngine:
                     self.heartbeat.beat()
                     _note_inflight()
                     continue
+                # idle (nothing in flight, nothing sealed): any spooled
+                # traced span rows land now rather than waiting out the
+                # next saturated stretch — and before worker exit below
+                self._flush_trace_spool()
                 if (self._stop.is_set() and self._queue.empty()
                         and not self._pending):
                     return
@@ -1030,6 +1064,12 @@ class ServingEngine:
             self._crash = e
             self._stop.set()
             self.heartbeat.disarm()
+            try:
+                # completed work's spans are valid telemetry even when the
+                # worker dies — land them (best effort) before failing out
+                self._flush_trace_spool()
+            except Exception:
+                pass
             obs.event("engine_worker_crash", engine=self.name,
                       error=type(e).__name__)
             for _, parts in inflight:
@@ -1048,6 +1088,16 @@ class ServingEngine:
                 self._backlog = 0
                 self._assembling = 0
             raise
+
+    def _flush_trace_spool(self) -> None:
+        """Worker-only: land the spooled traced span rows as one
+        ``request_phases_batch`` record — ``parts`` is the ";"-joined
+        packed rows (the assembler expands each back into an engine span
+        + six phase children)."""
+        if self._trace_spool:
+            rows, self._trace_spool = self._trace_spool, []
+            obs.event("request_phases_batch", engine=self.name,
+                      parts=";".join(rows))
 
     def _shed_expired(self, parts: List[_Part]) -> List[_Part]:
         """Worker-only: drop parts whose deadline passed; their futures fail
@@ -1367,18 +1417,45 @@ class ServingEngine:
             if self.slo_tracker is not None:
                 self.slo_tracker.record(latency_s=e2e, ok=True)
             self._span_seq += 1
-            if emit_spans and self._span_seq % self._span_every == 0:
+            trace = p.future.trace
+            traced = trace is not None and trace.sampled
+            if emit_spans and traced:
+                # each part is one engine span: fresh id under the
+                # propagated context, so the assembler hangs the six
+                # phases (synthesized children) off the right hop. The
+                # row is a PACKED string (comma-separated, integer
+                # microseconds, PHASES order — phases is built in that
+                # order): the flushed record then carries one long string
+                # the writer's json.dumps only escape-scans, instead of
+                # ~12 values x 64 rows it would format element-wise. This
+                # plus the spool is what keeps full tracing inside the
+                # <=2% overhead bar (PERF.md §Tracing)
+                ph_a, ph_q, ph_as, ph_d, ph_dev, ph_c = phases.values()
+                self._trace_spool.append(
+                    f"{trace.trace_id},{obs.new_span_id()},"
+                    f"{trace.span_id},{int(p.t_entry * 1e6)},{p.n},"
+                    f"{int(ph_a * 1e6)},{int(ph_q * 1e6)},"
+                    f"{int(ph_as * 1e6)},{int(ph_d * 1e6)},"
+                    f"{int(ph_dev * 1e6)},{int(ph_c * 1e6)},{bucket}"
+                )
+            elif emit_spans and self._span_seq % self._span_every == 0:
                 obs.event("request_phases", engine=self.name, bucket=bucket,
                           rows=p.n, total_s=round(e2e, 6),
                           **{k: round(v, 6) for k, v in phases.items()})
             latencies.append(e2e)
-            hist.observe(e2e)
+            # an exemplar per 4 observations is plenty of linkage (the
+            # ring keeps 8) and keeps the attach off most completions
+            hist.observe(e2e, exemplar=(
+                trace.trace_id if traced and self._span_seq & 3 == 0
+                else None))
             phase_rows.append(phases)
             o = offset
             p.future._deliver(
                 p.index, jax.tree.map(lambda a: a[o: o + p.n], host)
             )
             offset += p.n
+        if len(self._trace_spool) >= _TRACE_SPOOL_ROWS:
+            self._flush_trace_spool()
         with self._stats_lock:
             # bounded: an engine serves indefinitely — unbounded per-request
             # float lists would grow without limit; the window is plenty for
@@ -1681,6 +1758,7 @@ class MLMServer:
         compile_cache=None,
         slo: Optional[obs.SLO] = None,
         span_every: int = 1,
+        trace_sample: float = 1.0,
     ):
         import jax
 
@@ -1734,6 +1812,7 @@ class MLMServer:
             # separately attributable on /statz and healthz()
             slo=slo,
             span_every=span_every,
+            trace_sample=trace_sample,
             # ONE ExecutableCache (resolved here so a fail-soft warning
             # prints once, not three times) shared by all three program
             # families; their fingerprints differ by apply-fn source/avals

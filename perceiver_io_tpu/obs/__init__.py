@@ -6,7 +6,11 @@ One subsystem, four pieces, every layer wired through it:
   gauges, bounded histograms with p50/p95/p99); the single source of truth
   the serving engine, the Trainer/``MetricsLogger``, and the watchdog all
   publish to.
-- :mod:`tracing` — span/event tracing to JSONL (compiles, warmups, stalls).
+- :mod:`tracing` — span/event tracing to JSONL (compiles, warmups, stalls),
+  every record dual-stamped (wall + monotonic) and pid-labeled.
+- :mod:`reqtrace` — distributed request tracing: ``TraceContext``
+  propagation across router → RPC → replica → engine, span records, and
+  cross-process trace assembly with clock alignment and tail sampling.
 - :mod:`health` — dispatch heartbeats with stall detection + diagnostic
   thread-stack dumps, aggregated by ``healthz()``.
 - :mod:`watchdog` — the in-loop self-profiler: periodic short device traces
@@ -47,6 +51,16 @@ from perceiver_io_tpu.obs.registry import (
     is_export_process,
     sanitize_metric_name,
 )
+from perceiver_io_tpu.obs.reqtrace import (
+    SPAN_NAMES,
+    TraceBuffer,
+    TraceContext,
+    assemble_traces,
+    maybe_trace,
+    new_span_id,
+    record_span,
+    tail_sample,
+)
 from perceiver_io_tpu.obs.slo import SLO, SLOTracker, fit_capacity
 from perceiver_io_tpu.obs.tracing import (
     EventLog,
@@ -69,7 +83,11 @@ __all__ = [
     "ReplicaGauges",
     "SLO",
     "SLOTracker",
+    "SPAN_NAMES",
     "SelfProfiler",
+    "TraceBuffer",
+    "TraceContext",
+    "assemble_traces",
     "configure_event_log",
     "event",
     "fit_capacity",
@@ -79,9 +97,13 @@ __all__ = [
     "install_compile_counter",
     "install_process_metrics",
     "is_export_process",
+    "maybe_trace",
+    "new_span_id",
+    "record_span",
     "register_health_source",
     "sanitize_metric_name",
     "span",
+    "tail_sample",
     "thread_stacks",
     "unregister_health_source",
 ]

@@ -109,13 +109,21 @@ class Gauge(_Instrument):
 
 
 class Histogram(_Instrument):
-    """Bounded observation window with exact count/sum and on-demand
-    percentiles over the window.
+    """Bounded observation window with exact count/sum, on-demand
+    percentiles over the window, and optional *exemplars*.
 
     An engine serves indefinitely — unbounded per-observation lists would grow
     without limit; a 4096-observation window is plenty for p50/p95/p99
     reporting while keeping memory flat. ``count``/``sum`` stay exact over the
     instrument's whole lifetime (they feed Prometheus summary semantics).
+
+    Exemplars (OpenMetrics-style, carried on ``snapshot()``/``/statz``
+    rather than the 0.0.4 text exposition, which predates them): an
+    ``observe(v, exemplar=trace_id)`` attaches a concrete trace id to the
+    observation, and the histogram keeps a small ring of RECENT exemplars
+    plus one sticky slot for the SLOWEST exemplar'd observation ever — so
+    "p99 is high" links directly to an assembled trace even after the slow
+    request scrolls out of the recency ring.
     """
 
     kind = "histogram"
@@ -125,13 +133,32 @@ class Histogram(_Instrument):
         self._window: deque = deque(maxlen=window)
         self._count = 0
         self._sum = 0.0
+        self._exemplars: deque = deque(maxlen=8)
+        self._slowest_exemplar: Optional[Dict[str, Any]] = None
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         v = float(v)
         with self._lock:
             self._window.append(v)
             self._count += 1
             self._sum += v
+            if exemplar is not None:
+                entry = {"value": v, "trace": str(exemplar)}
+                self._exemplars.append(entry)
+                if (self._slowest_exemplar is None
+                        or v >= self._slowest_exemplar["value"]):
+                    self._slowest_exemplar = entry
+
+    def exemplars(self) -> List[Dict[str, Any]]:
+        """(value, trace) exemplars, slowest first: the sticky slowest-ever
+        slot plus the recency ring (deduped) — the p99→trace link
+        ``tools/trace_assemble.py`` resolves."""
+        with self._lock:
+            ex = list(self._exemplars)
+            slowest = self._slowest_exemplar
+        if slowest is not None and slowest not in ex:
+            ex.append(slowest)
+        return sorted(ex, key=lambda e: -e["value"])
 
     @property
     def count(self) -> int:
@@ -261,11 +288,15 @@ class MetricsRegistry:
                 out["gauges"][key] = inst.value
             elif isinstance(inst, Histogram):
                 pcts = inst.percentiles()
-                out["histograms"][key] = {
+                entry = {
                     "count": inst.count,
                     "sum": inst.sum,
                     **{f"p{int(q * 100)}": v for q, v in pcts.items()},
                 }
+                ex = inst.exemplars()
+                if ex:
+                    entry["exemplars"] = ex
+                out["histograms"][key] = entry
         return out
 
     def prometheus_text(self) -> str:

@@ -1,0 +1,408 @@
+"""Distributed request tracing: context propagation, span records, and
+cross-process trace assembly.
+
+The r11 phase tracing attributes a request's lifetime WITHIN one engine; at
+fleet scale most of a tail request's latency lives elsewhere — router queue,
+failover reroutes, the RPC wire, replica queueing, a swap bake. This module
+threads one correlation spine through all of it:
+
+- :class:`TraceContext` — a Dapper-style (trace_id, span_id, parent) triple
+  plus the head-sampling decision, minted at ``Router.submit`` / engine
+  ``submit`` and propagated through the replica RPC as headers
+  (:data:`TRACE_HEADERS`) and into engine request parts.
+- :func:`record_span` — one span = one :func:`~perceiver_io_tpu.obs.tracing.
+  event` record (``event="span"``) carrying the trace triple, a MONOTONIC
+  start stamp and duration (PIT-CLOCK: durations never touch the wall
+  clock), and whatever attribution fields the hop owns. The
+  :class:`~perceiver_io_tpu.obs.tracing.EventLog` stamps every record with
+  dual wall+monotonic clocks and the writer's pid, which is what makes
+  cross-process assembly possible at all.
+- :func:`assemble_traces` — merge per-process JSONL logs into per-request
+  span TREES: per-process clock alignment (each process's monotonic spans
+  are anchored to the wall clock via the median ``wall − mono`` offset over
+  that process's records), parent links joined ACROSS processes, and the
+  engine's existing ``request_phases`` records expanded into six child
+  spans (the r11 phases ride along as children — they are not
+  re-instrumented).
+- :func:`tail_sample` — tail-based retention over assembled traces:
+  flagged traces (any errored span — which covers in-flight deadline
+  expiry and rejection failures — plus failover reroutes and affinity
+  spills) and the slowest percentile are always kept; the boring majority
+  is sampled down. Admission-time sheds mint no trace at all (nothing ran
+  — there is no lifetime to attribute); they remain counted by
+  ``router_shed_total`` / ``serving_shed_total``.
+- :class:`TraceBuffer` — a bounded in-process ring of recently completed
+  trace summaries (the ``/statz``-adjacent "what were my last slow
+  requests" view; exemplar-linked from the latency histograms).
+
+Span names are a closed registry (:data:`SPAN_NAMES`) validated statically
+(pitlint PIT-SPAN, the PIT-FAULT pattern): a renamed hop cannot silently
+decouple its spans from the assembler and docs.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import perceiver_io_tpu.obs.tracing as _tracing
+
+__all__ = [
+    "SPAN_NAMES",
+    "TRACE_HEADERS",
+    "TraceBuffer",
+    "TraceContext",
+    "assemble_traces",
+    "maybe_trace",
+    "new_span_id",
+    "record_span",
+    "tail_sample",
+]
+
+# the closed span-name registry (pitlint PIT-SPAN validates every literal
+# record_span site against it — the PIT-FAULT pattern): one name per hop
+# that owns a timed interval of a request's life, plus the fleet-context
+# spans the assembler overlays (deploy swaps have no trace of their own)
+SPAN_NAMES = frozenset({
+    "router_request",         # root: submit() → delivered/failed (router)
+    "router_attempt",         # one placement: pick → client.call returned
+    "router_reroute",         # failover hop: the backoff gap between attempts
+    "router_affinity_spill",  # a session pin died (caller re-encodes)
+    "replica_serve",          # replica-side: RPC arrival → response built
+    "deploy_swap",            # install start → bake end (fleet context)
+})
+
+# wire propagation (the replica RPC): deliberately minimal — a trace id, the
+# caller's span id (the remote child's parent), and the sampling decision
+TRACE_HEADERS = ("X-Trace-Id", "X-Parent-Span", "X-Sampled")
+
+# id generation: a per-process random prefix + a shared counter. Counter
+# ids cost ~0.3 µs where per-id os.urandom costs ~1.3 µs — on the traced
+# serving path (one trace + several span ids per request) that difference
+# is a measurable slice of the <=2% overhead budget. Uniqueness: trace ids
+# embed the 8-hex process prefix (collision = two processes drawing the
+# same 32-bit prefix); span ids only need uniqueness within one trace's
+# handful of spans, where a randomly-seeded 32-bit counter is plenty.
+# GIL-atomic: itertools.count holds no lock and cannot tear.
+_ID_PREFIX = os.urandom(4).hex()
+_IDS = itertools.count(int.from_bytes(os.urandom(4), "big"))
+
+
+def _span_id() -> str:
+    return f"{next(_IDS) & 0xFFFFFFFF:08x}"
+
+
+def new_span_id() -> str:
+    """A fresh 8-hex span id — the allocation-free alternative to
+    ``ctx.child()`` for hot paths that only need the id triple inline
+    (the engine's per-part batch rows)."""
+    return _span_id()
+
+
+class TraceContext:
+    """One hop's view of a distributed trace: ``trace_id`` names the
+    request fleet-wide, ``span_id`` this hop's span, ``parent_id`` the hop
+    above (None at the root). ``sampled`` is the head-sampling decision the
+    mint made — every hop honors it (tail retention happens at assembly,
+    over whatever was recorded)."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_id: Optional[str] = None, sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.sampled = sampled
+
+    @classmethod
+    def mint(cls, sampled: bool = True) -> "TraceContext":
+        """A fresh root context (16-hex trace id, 8-hex span id)."""
+        return cls(f"{_ID_PREFIX}{next(_IDS) & 0xFFFFFFFF:08x}",
+                   f"{next(_IDS) & 0xFFFFFFFF:08x}",
+                   parent_id=None, sampled=sampled)
+
+    def child(self) -> "TraceContext":
+        """A child context: same trace, fresh span, this span as parent."""
+        return TraceContext(self.trace_id, _span_id(),
+                            parent_id=self.span_id, sampled=self.sampled)
+
+    def to_headers(self) -> Dict[str, str]:
+        """Wire form for the replica RPC: the receiver's ``from_headers``
+        yields a context whose ``span_id`` is THIS span (i.e. the caller's),
+        so the receiver's ``child()`` parents correctly across the hop."""
+        return {
+            "X-Trace-Id": self.trace_id,
+            "X-Parent-Span": self.span_id,
+            "X-Sampled": "1" if self.sampled else "0",
+        }
+
+    @classmethod
+    def from_headers(cls, headers) -> Optional["TraceContext"]:
+        """Reconstruct the CALLER's context from RPC headers (None when the
+        request is untraced)."""
+        trace_id = headers.get("X-Trace-Id")
+        if not trace_id:
+            return None
+        return cls(trace_id, headers.get("X-Parent-Span") or "",
+                   parent_id=None,
+                   sampled=headers.get("X-Sampled", "1") != "0")
+
+    def __repr__(self) -> str:
+        return (f"TraceContext({self.trace_id}/{self.span_id}"
+                f"<-{self.parent_id}, sampled={self.sampled})")
+
+
+def maybe_trace(sample: float = 1.0) -> Optional[TraceContext]:
+    """Mint a root context iff an event log is configured (tracing is free
+    when nothing would record the spans) and the head-sampling coin lands.
+    ``sample`` is the probability a request is traced (1.0 = all)."""
+    if _tracing.get_event_log() is None or sample <= 0.0:
+        return None
+    if sample < 1.0 and random.random() >= sample:
+        return None
+    return TraceContext.mint()
+
+
+def record_span(name: str, ctx: Optional[TraceContext], t0_mono: float,
+                dur_s: float, **fields: Any) -> None:
+    """Append one span record to the process event log.
+
+    ``t0_mono`` is the span start on THIS process's monotonic clock;
+    assembly anchors it to the wall clock via the log's dual stamps.
+    ``ctx=None`` records a trace-less context span (``deploy_swap``) that
+    assembly overlays rather than attaches."""
+    log = _tracing.get_event_log()
+    if log is None:
+        return
+    if ctx is not None and not ctx.sampled:
+        return
+    # written directly (event()'s first positional is the record's "event"
+    # key; a span's own name is a field of the one "span" record shape)
+    log.write({
+        "event": "span", "name": name,
+        "trace": None if ctx is None else ctx.trace_id,
+        "span": None if ctx is None else ctx.span_id,
+        "parent": None if ctx is None else ctx.parent_id,
+        "mono_start": round(t0_mono, 6), "dur_s": round(dur_s, 6), **fields,
+    })
+
+
+class TraceBuffer:
+    """Bounded ring of recently completed trace summaries — the in-process
+    "what were my last requests" view the latency-histogram exemplars link
+    into. One entry per completed root span: ``(trace_id, total_s, flags)``.
+    """
+
+    # pitlint PIT-LOCK: the ring is appended by the dispatch pool's worker
+    # threads and read by stats/statz pollers — touched only under _lock
+    _guarded_by = {"_ring": "_lock"}
+
+    def __init__(self, capacity: int = 256):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+
+    def add(self, trace_id: str, total_s: float, **flags: Any) -> None:
+        with self._lock:
+            self._ring.append({"trace": trace_id,
+                               "total_s": round(float(total_s), 6), **flags})
+
+    def recent(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            items = list(self._ring)
+        return items if n is None else items[-n:]
+
+    def slowest(self, n: int = 5) -> List[Dict[str, Any]]:
+        with self._lock:
+            items = list(self._ring)
+        return sorted(items, key=lambda r: -r["total_s"])[:n]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+# -- assembly -----------------------------------------------------------------
+
+# engine lifecycle phases, mirrored from inference.engine.PHASES (asserted
+# equal by the tier-1 suite) so assembly never imports jax-adjacent modules
+_ENGINE_PHASES = ("admission", "queue", "assembly", "dispatch", "device",
+                  "complete")
+
+def _clock_offsets(records: Iterable[Dict[str, Any]]) -> Dict[Any, float]:
+    """Per-process wall-anchoring offset: median ``wall − mono`` over every
+    dual-stamped record the process wrote. Adding the offset to a monotonic
+    stamp yields an epoch-comparable time; durations stay pure monotonic."""
+    samples: Dict[Any, List[float]] = {}
+    for r in records:
+        if "t" in r and "mono" in r:
+            samples.setdefault(r.get("pid"), []).append(r["t"] - r["mono"])
+    offsets = {}
+    for pid, vals in samples.items():
+        vals.sort()
+        offsets[pid] = vals[len(vals) // 2]
+    return offsets
+
+
+def _engine_rows(base: Dict[str, Any], trace: str, span: str,
+                 parent: Optional[str], start: float, n_rows,
+                 phases_s: List[float], engine, bucket
+                 ) -> List[Dict[str, Any]]:
+    """One engine span + six phase children from one part's phase values
+    (the r11 phases, reused as child spans — never re-instrumented)."""
+    out = [{**base, "name": "engine", "trace": trace, "span": span,
+            "parent": parent, "mono_start": round(start, 6),
+            "dur_s": round(sum(phases_s), 6), "engine": engine,
+            "rows": n_rows, "bucket": bucket}]
+    t = start
+    for i, phase in enumerate(_ENGINE_PHASES):
+        dur = phases_s[i]
+        out.append({**base, "name": f"phase:{phase}", "trace": trace,
+                    "span": f"{span}.{i}", "parent": span,
+                    "mono_start": round(t, 6), "dur_s": round(dur, 6)})
+        t += dur
+    return out
+
+
+def _span_rows(records: Iterable[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Normalize raw event records into span rows: ``event="span"`` records
+    pass through; the engine's compact per-micro-batch
+    ``request_phases_batch`` records (integer-microsecond part rows —
+    serialization amortized over the batch exactly like the dispatch
+    itself) and legacy traced per-part ``request_phases`` records both
+    expand into an ``engine`` span plus six phase children."""
+    rows: List[Dict[str, Any]] = []
+    for r in records:
+        kind = r.get("event")
+        if kind == "span" and r.get("trace"):
+            rows.append(r)
+        elif kind == "request_phases_batch":
+            base = {k: r.get(k) for k in ("pid", "t", "mono")}
+            parts = r.get("parts") or ""
+            # packed form: ";"-joined rows of
+            # "trace,span,parent,t_entry_us,rows,admission_us,queue_us,
+            #  assembly_us,dispatch_us,device_us,complete_us,bucket"
+            # (the producer packs so its writer only escape-scans one
+            # string; parsing cost lives here, offline)
+            for packed in parts.split(";") if parts else ():
+                f = packed.split(",")
+                trace, span, parent = f[0], f[1], f[2] or None
+                phases_s = [int(v) / 1e6 for v in f[5:11]]
+                rows.extend(_engine_rows(
+                    base, trace, span, parent, int(f[3]) / 1e6, int(f[4]),
+                    phases_s, r.get("engine"),
+                    int(f[11]) if len(f) > 11 else r.get("bucket")))
+        elif kind == "request_phases" and r.get("trace"):
+            base = {k: r.get(k) for k in ("pid", "t", "mono")}
+            phases_s = [float(r.get(p, 0.0)) for p in _ENGINE_PHASES]
+            rows.extend(_engine_rows(
+                base, r["trace"], r["span"], r.get("parent"),
+                r.get("mono_start", 0.0), r.get("rows"), phases_s,
+                r.get("engine"), r.get("bucket")))
+    return rows
+
+
+def assemble_traces(records: Iterable[Dict[str, Any]]
+                    ) -> Tuple[Dict[str, Dict[str, Any]],
+                               List[Dict[str, Any]]]:
+    """Merge raw event records (from ANY number of per-process logs) into
+    per-request trace trees.
+
+    Returns ``(traces, context_spans)``: ``traces`` maps trace_id to a dict
+    with ``root`` (the parentless span), ``spans`` (all spans, each with an
+    ``abs_start`` wall-anchored stamp and a ``children`` id list),
+    ``total_s`` (root duration), ``span_sum_s`` (sum of exclusive self
+    times — reconciles with ``total_s`` when the tree is complete), and
+    ``flags`` (error/reroute/spill booleans). ``context_spans`` carries the
+    trace-less fleet spans (deploy swaps) for overlay."""
+    records = list(records)
+    offsets = _clock_offsets(records)
+    rows = _span_rows(records)
+    context = [r for r in records
+               if r.get("event") == "span" and not r.get("trace")]
+
+    by_trace: Dict[str, List[Dict[str, Any]]] = {}
+    for row in rows:
+        off = offsets.get(row.get("pid"), 0.0)
+        row = dict(row)
+        row["abs_start"] = row.get("mono_start", 0.0) + off
+        by_trace.setdefault(row["trace"], []).append(row)
+
+    traces: Dict[str, Dict[str, Any]] = {}
+    for trace_id, spans in by_trace.items():
+        by_id = {s["span"]: s for s in spans}
+        for s in spans:
+            s["children"] = []
+        roots = []
+        for s in spans:
+            parent = by_id.get(s.get("parent"))
+            if parent is not None:
+                parent["children"].append(s["span"])
+            else:
+                roots.append(s)
+        # prefer the declared root span; fall back to the earliest orphan
+        root = next((s for s in roots if s.get("parent") is None), None)
+        if root is None and roots:
+            root = min(roots, key=lambda s: s["abs_start"])
+        if root is None:
+            continue
+
+        def self_time(s: Dict[str, Any]) -> float:
+            child_sum = sum(by_id[c]["dur_s"] for c in s["children"])
+            return max(float(s["dur_s"]) - child_sum, 0.0)
+
+        span_sum = sum(self_time(s) for s in spans
+                       if s is root or s.get("parent") in by_id)
+        flags = {
+            "error": any(s.get("ok") is False or s.get("error")
+                         for s in spans),
+            "reroute": any(s["name"] == "router_reroute" for s in spans),
+            "spill": any(s["name"] == "router_affinity_spill"
+                         for s in spans),
+        }
+        traces[trace_id] = {
+            "trace": trace_id,
+            "root": root,
+            "spans": sorted(spans, key=lambda s: s["abs_start"]),
+            "total_s": float(root["dur_s"]),
+            "span_sum_s": round(span_sum, 6),
+            "processes": sorted({str(s.get("pid")) for s in spans}),
+            "flags": flags,
+        }
+    context = [
+        dict(r, abs_start=r.get("mono_start", 0.0)
+             + offsets.get(r.get("pid"), 0.0))
+        for r in context
+    ]
+    return traces, context
+
+
+def tail_sample(traces: Dict[str, Dict[str, Any]],
+                slow_pct: float = 0.95,
+                sample: float = 0.1,
+                seed: int = 0) -> Dict[str, Dict[str, Any]]:
+    """Tail-based retention: ALWAYS keep flagged traces (error / reroute /
+    spill — the failure tails an investigation needs) and the slowest
+    ``1 - slow_pct`` fraction by total duration; keep a ``sample`` fraction
+    of the rest (deterministic per trace id hash, so reruns agree)."""
+    if not traces:
+        return {}
+    durs = sorted(t["total_s"] for t in traces.values())
+    cut = durs[min(len(durs) - 1, int(slow_pct * len(durs)))]
+    rng = random.Random(seed)
+    kept: Dict[str, Dict[str, Any]] = {}
+    for trace_id in sorted(traces):
+        t = traces[trace_id]
+        if any(t["flags"].values()) or t["total_s"] >= cut:
+            kept[trace_id] = dict(t, kept_for=(
+                "flag" if any(t["flags"].values()) else "slow"))
+        elif rng.random() < sample:
+            kept[trace_id] = dict(t, kept_for="sample")
+    return kept

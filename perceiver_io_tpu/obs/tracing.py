@@ -6,10 +6,26 @@ stalled) append one JSON object per line to a configured file. Unconfigured,
 ``event``/``span`` are near-free no-ops — library code calls them
 unconditionally and only entry points opt into a sink.
 
-Thread-safe (one lock around write+flush); timestamps are wall-clock epoch
-seconds so lines correlate with external logs. Multi-host: configure the sink
-on process 0 only (the helpers never check — the caller owns that policy,
+Every record carries DUAL clock stamps plus the writer's pid: ``t``
+(wall-clock epoch seconds — external log correlation and cross-process
+alignment anchoring) and ``mono`` (the process's monotonic clock — the only
+clock durations may be computed from, PIT-CLOCK). The pair is what lets
+``obs.reqtrace.assemble_traces`` anchor one process's monotonic span stamps
+against another's: per process, the median ``t − mono`` offset maps
+monotonic onto the shared wall timeline. Multi-host: configure the sink on
+process 0 only (the helpers never check — the caller owns that policy,
 mirroring ``MetricsLogger``).
+
+Writes are ASYNCHRONOUS (r15): ``write()`` stamps the clocks and enqueues;
+a writer thread serializes, rotates, and flushes off the caller's path —
+per-request span emission costs the producer ~2 µs instead of a ~25 µs
+serialize+write+flush (the measured difference between tracing overhead
+above and below the 2% acceptance bar at CPU serving rates). The bounded
+queue DROPS (counted, reported once) rather than blocks when the writer
+falls behind — telemetry must never stall the loop it observes. ``close()``
+(and ``configure_event_log(None)``) drains the queue before closing, so the
+every-record-visible-after-close contract the tests and the serve CLI's
+drain path rely on still holds.
 
 Bounded by construction: the sink rotates at ``max_bytes`` (keeping
 ``backups`` numbered segments, newest first: ``events.jsonl.1`` is the most
@@ -25,6 +41,7 @@ import json
 import os
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, Iterator, Optional
 
 __all__ = ["EventLog", "configure_event_log", "event", "get_event_log", "span"]
@@ -34,12 +51,19 @@ __all__ = ["EventLog", "configure_event_log", "event", "get_event_log", "span"]
 DEFAULT_MAX_BYTES = 64 * 1024 * 1024
 DEFAULT_BACKUPS = 3
 
+# producer-side bound: at the measured ~25 µs/record drain rate this absorbs
+# multi-second bursts; past it, records drop (counted) rather than block
+DEFAULT_QUEUE_DEPTH = 8192
+
 
 class EventLog:
-    """Append-only JSONL event sink with size-capped rotation."""
+    """Append-only JSONL event sink with size-capped rotation and an
+    asynchronous writer thread (producers enqueue; serialization, rotation,
+    and flushing happen off the hot path)."""
 
     def __init__(self, path: str, max_bytes: Optional[int] = DEFAULT_MAX_BYTES,
-                 backups: int = DEFAULT_BACKUPS):
+                 backups: int = DEFAULT_BACKUPS,
+                 queue_depth: int = DEFAULT_QUEUE_DEPTH):
         if max_bytes is not None and max_bytes < 1:
             raise ValueError(f"max_bytes must be positive, got {max_bytes}")
         if backups < 0:
@@ -47,36 +71,143 @@ class EventLog:
         self.path = path
         self.max_bytes = max_bytes
         self.backups = backups
+        self._pid = os.getpid()  # per-record process label (trace assembly
+        # merges logs from many processes; pid keys the clock alignment)
         self._lock = threading.Lock()
         self._f = open(path, "a")
         self._size = self._f.tell()  # append mode: tell() is the file size
         self._closed = False
         self._write_error_reported = False
+        self._drop_reported = False
+        self.dropped = 0  # records the full buffer refused (never blocks)
+        self._depth = max(1, int(queue_depth))
+        # a plain deque, NOT queue.Queue: append is GIL-atomic and lock-free
+        # and — decisively — does not notify a condition variable per
+        # record. Waking the writer thread per span put a context-switch +
+        # GIL hand-off on every completion; polling amortizes it to zero
+        # (measured: the difference between ~10% and <2% tracing overhead)
+        self._buf: deque = deque()
+        self._writing = False  # a popped batch is in flight to disk
+        self._stop = threading.Event()
+        self._writer = threading.Thread(
+            target=self._drain_loop, name="event-log-writer", daemon=True)
+        self._writer.start()
 
     def write(self, record: Dict[str, Any]) -> None:
-        line = json.dumps({"t": time.time(), **record}, default=str) + "\n"
-        with self._lock:
-            if self._f is None:
-                if self._closed:
-                    return
-                # a FAILED rotation left the log fileless (not closed):
-                # retry the reopen so a transient disk condition degrades
-                # the log only while it lasts, symmetric with plain write
-                # failures which also self-recover
+        """Buffer one record (~2 µs, no lock, no thread wakeup). Clock
+        stamps are captured HERE — the record's times are submission times,
+        however far behind the writer runs. A full buffer drops the record
+        (counted, reported once): telemetry must never stall the loop it
+        observes."""
+        if self._closed:
+            return
+        if len(self._buf) >= self._depth:  # racy read: the bound is soft
+            self.dropped += 1
+            if not self._drop_reported:
+                self._drop_reported = True
+                import sys
+
+                print(f"[obs] event log buffer full — dropping records "
+                      f"(writer behind on {self.path!r}; drops are counted "
+                      f"on EventLog.dropped)", file=sys.stderr)
+            return
+        # dual stamps: wall for correlation/alignment anchoring, monotonic
+        # for durations (PIT-CLOCK — never subtract wall clocks)
+        self._buf.append(
+            {"t": time.time(), "mono": time.monotonic(),
+             "pid": self._pid, **record})
+
+    def _drain_loop(self) -> None:
+        """Writer thread: poll → drain the buffer in batches → ONE write +
+        flush per batch (a flush-per-record writer measurably steals
+        serving throughput through the GIL). Exits once stopped AND
+        drained, so ``close()`` sees every record accepted before the stop
+        on disk."""
+        while True:
+            if not self._buf:
+                if self._stop.wait(0.02):
+                    if not self._buf:
+                        return
+                continue
+            # flagged BEFORE popping: flush() must not observe an empty
+            # deque while a popped batch is still unwritten
+            self._writing = True
+            batch = []
+            while len(batch) < 512:
                 try:
-                    self._f = open(self.path, "a")
-                    self._size = self._f.tell()
-                except OSError:
-                    return
+                    batch.append(self._buf.popleft())
+                except IndexError:
+                    break
             try:
-                if (self.max_bytes is not None
-                        and self._size + len(line) > self.max_bytes
-                        and self._size > 0):
-                    self._rotate_locked()
-                self._f.write(line)
+                self._write_batch(batch)
+            except Exception as e:  # the writer thread is immortal: any
+                # surprise drops the batch (counted), never the sink
+                self.dropped += len(batch)
+                if not self._write_error_reported:
+                    self._write_error_reported = True
+                    import sys
+
+                    print(f"[obs] event log writer error "
+                          f"({type(e).__name__}: {e}) — batch dropped",
+                          file=sys.stderr)
+            finally:
+                self._writing = False
+
+    def _write_batch(self, records) -> None:
+        """Serialize and land a batch: rotation is checked per record (the
+        size cap stays exact), but the flush is per batch."""
+        with self._lock:
+            for record in records:
+                self._write_one_locked(record, flush=False)
+            if self._f is not None:
+                try:
+                    self._f.flush()
+                except OSError:
+                    pass  # the per-record handler already reported
+
+    def _write_line(self, record: Dict[str, Any]) -> None:
+        with self._lock:
+            self._write_one_locked(record, flush=True)
+
+    def _write_one_locked(self, record: Dict[str, Any],
+                          flush: bool) -> None:
+        try:
+            line = json.dumps(record, default=str) + "\n"
+        except (TypeError, ValueError) as e:
+            # default=str does not cover every shape (non-scalar dict
+            # keys, circular refs); one bad record must DROP, not kill
+            # the writer thread and silently end all event logging
+            self.dropped += 1
+            if not self._write_error_reported:
+                self._write_error_reported = True
+                import sys
+
+                print(f"[obs] event log record not serializable ({e}) — "
+                      f"dropped (counted on EventLog.dropped)",
+                      file=sys.stderr)
+            return
+        if self._f is None:
+            if self._closed:
+                return
+            # a FAILED rotation left the log fileless (not closed):
+            # retry the reopen so a transient disk condition degrades
+            # the log only while it lasts, symmetric with plain write
+            # failures which also self-recover
+            try:
+                self._f = open(self.path, "a")
+                self._size = self._f.tell()
+            except OSError:
+                return
+        try:
+            if (self.max_bytes is not None
+                    and self._size + len(line) > self.max_bytes
+                    and self._size > 0):
+                self._rotate_locked()
+            self._f.write(line)
+            if flush:
                 self._f.flush()
-                self._size += len(line)
-            except OSError as e:
+            self._size += len(line)
+        except OSError as e:
                 # telemetry must never crash the loop it observes (events
                 # are emitted from the engine worker / trainer hot paths);
                 # a full disk degrades the log, reported once
@@ -87,6 +218,16 @@ class EventLog:
                     print(f"[obs] event log write failed ({e}) — further "
                           f"events to {self.path!r} may be dropped",
                           file=sys.stderr)
+
+    def flush(self, timeout_s: float = 10.0) -> bool:
+        """Block until every record buffered so far is on disk (bounded).
+        Returns False if the writer did not catch up in time."""
+        deadline = time.monotonic() + timeout_s
+        while self._buf or self._writing:
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.002)
+        return True
 
     def _rotate_locked(self) -> None:
         """Shift ``path.(N-1)`` → ``path.N`` … ``path`` → ``path.1`` and
@@ -109,8 +250,20 @@ class EventLog:
         self._size = 0
 
     def close(self) -> None:
+        """Stop accepting records, DRAIN the queue to disk, close the file —
+        the flush half of the serve CLI's drain contract."""
+        self._closed = True  # write() refuses new records from here on
+        self._stop.set()
+        self._writer.join(timeout=10.0)
+        # a writer wedged past the join bound is abandoned (daemon); any
+        # records it left behind are drained synchronously so close() keeps
+        # its everything-accepted-is-on-disk promise
+        while True:
+            try:
+                self._write_line(self._buf.popleft())
+            except IndexError:
+                break
         with self._lock:
-            self._closed = True
             if self._f is not None:
                 self._f.close()
                 self._f = None

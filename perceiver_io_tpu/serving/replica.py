@@ -189,7 +189,39 @@ class ReplicaApp:
 
     def call(self, kind: str, arrays: List[np.ndarray],
              session: Optional[str] = None,
-             timeout_s: Optional[float] = None) -> List[np.ndarray]:
+             timeout_s: Optional[float] = None,
+             trace: Optional[obs.TraceContext] = None,
+             meta: Optional[Dict[str, Any]] = None) -> List[np.ndarray]:
+        """Serve one RPC verb. ``trace`` (the caller's propagated context)
+        attaches a ``replica_serve`` span and flows into the engine;
+        ``meta``, when a dict is passed, is filled with the engine future's
+        per-part ``phases`` — the attribution that previously died at the
+        engine boundary now crosses the RPC (the HTTP shim rides it back as
+        the ``X-Phases`` response header; ``LocalReplica`` fills it
+        directly — parity pinned by the fabric tests)."""
+        if trace is None:  # untraced: no span bookkeeping at all
+            return self._call_inner(kind, arrays, session, timeout_s,
+                                    None, meta)
+        t0 = time.monotonic()
+        serve_ctx = trace.child()
+        try:
+            out = self._call_inner(kind, arrays, session, timeout_s,
+                                   serve_ctx, meta)
+        except BaseException as e:
+            obs.record_span("replica_serve", serve_ctx, t0,
+                            time.monotonic() - t0, replica=self.name,
+                            kind=kind, ok=False, error=type(e).__name__)
+            raise
+        obs.record_span("replica_serve", serve_ctx, t0,
+                        time.monotonic() - t0, replica=self.name, kind=kind,
+                        ok=True)
+        return out
+
+    def _call_inner(self, kind: str, arrays: List[np.ndarray],
+                    session: Optional[str],
+                    timeout_s: Optional[float],
+                    trace: Optional[obs.TraceContext],
+                    meta: Optional[Dict[str, Any]]) -> List[np.ndarray]:
         import jax
 
         engine = self.engines.get(kind)
@@ -206,7 +238,10 @@ class ReplicaApp:
                     f"{self.name!r} (encoded elsewhere, or lost to a restart)"
                 )
             arrays = [latents, *arrays]
-        out = engine.submit(*arrays).result(timeout=timeout_s)
+        fut = engine.submit(*arrays, trace=trace)
+        out = fut.result(timeout=timeout_s)
+        if meta is not None:
+            meta["phases"] = fut.phases
         if kind == "encode" and session is not None:
             with self._sessions_lock:
                 self._sessions[session] = out
@@ -375,10 +410,14 @@ class ReplicaServer:
                 pass  # RPC traffic must not spam the replica's stderr
 
             def _reply(self, code: int, body: bytes,
-                       ctype: str = "application/json") -> None:
+                       ctype: str = "application/json",
+                       extra_headers: Optional[Dict[str, str]] = None,
+                       ) -> None:
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -417,11 +456,29 @@ class ReplicaServer:
                         kind = path[len("/rpc/"):]
                         timeout_s = (float(q["timeout_s"])
                                      if "timeout_s" in q else None)
+                        # the propagated trace context rides the request
+                        # headers; the engine's per-part phase attribution
+                        # rides BACK as a response header (the npz body
+                        # stays pure arrays)
+                        trace = obs.TraceContext.from_headers(self.headers)
+                        meta: Dict[str, Any] = {}
                         out = app.call(kind, unpack_arrays(self._body()),
                                        session=q.get("session"),
-                                       timeout_s=timeout_s)
+                                       timeout_s=timeout_s, trace=trace,
+                                       meta=meta)
+                        extra = {}
+                        if meta.get("phases"):
+                            # headers must stay under http.client's 64 KB
+                            # line limit: a many-part request (hundreds of
+                            # engine parts) would otherwise fail an
+                            # ALREADY-SERVED rpc at the router's response
+                            # parse — cap the attribution, never the result
+                            body_json = json.dumps(meta["phases"][:64])
+                            if len(body_json) <= 32768:
+                                extra["X-Phases"] = body_json
                         self._reply(200, pack_arrays(out),
-                                    "application/octet-stream")
+                                    "application/octet-stream",
+                                    extra_headers=extra)
                     elif path == "/admin/drain":
                         timeout_s = (float(q["timeout_s"])
                                      if "timeout_s" in q else None)
@@ -477,19 +534,29 @@ class HttpReplicaClient:
         self.timeout_s = timeout_s
 
     def _request(self, method: str, path: str, body: Optional[bytes] = None,
-                 timeout_s: Optional[float] = None) -> bytes:
+                 timeout_s: Optional[float] = None,
+                 headers: Optional[Dict[str, str]] = None,
+                 meta: Optional[Dict[str, Any]] = None) -> bytes:
         import urllib.error
         import urllib.request
 
         req = urllib.request.Request(
             self.base_url + path, data=body, method=method,
-            headers={"Content-Type": "application/octet-stream"},
+            headers={"Content-Type": "application/octet-stream",
+                     **(headers or {})},
         )
         try:
             with urllib.request.urlopen(
                 req, timeout=timeout_s if timeout_s is not None
                 else self.timeout_s
             ) as resp:
+                if meta is not None:
+                    phases = resp.headers.get("X-Phases")
+                    if phases:
+                        try:
+                            meta["phases"] = json.loads(phases)
+                        except ValueError:
+                            pass  # a torn header degrades attribution only
                 return resp.read()
         except urllib.error.HTTPError as e:
             raise_wire_error(e.read(), self.name)
@@ -502,7 +569,13 @@ class HttpReplicaClient:
 
     def call(self, kind: str, arrays: Sequence[np.ndarray],
              session: Optional[str] = None,
-             timeout_s: Optional[float] = None) -> List[np.ndarray]:
+             timeout_s: Optional[float] = None,
+             trace: Optional[obs.TraceContext] = None,
+             meta: Optional[Dict[str, Any]] = None) -> List[np.ndarray]:
+        """One RPC verb. ``trace`` propagates the caller's span context to
+        the replica as headers; ``meta`` (a dict, filled in place) receives
+        the replica engine's per-part ``phases`` from the response header —
+        the router surfaces them on its futures."""
         q = []
         if session is not None:
             q.append(f"session={session}")
@@ -510,7 +583,10 @@ class HttpReplicaClient:
             q.append(f"timeout_s={timeout_s:g}")
         path = f"/rpc/{kind}" + ("?" + "&".join(q) if q else "")
         out = self._request("POST", path, pack_arrays(arrays),
-                            timeout_s=timeout_s)
+                            timeout_s=timeout_s,
+                            headers=(trace.to_headers()
+                                     if trace is not None else None),
+                            meta=meta)
         return unpack_arrays(out)
 
     def scrape(self, timeout_s: float = 5.0) -> Dict[str, Any]:
@@ -571,10 +647,15 @@ class LocalReplica:
 
     def call(self, kind: str, arrays: Sequence[np.ndarray],
              session: Optional[str] = None,
-             timeout_s: Optional[float] = None) -> List[np.ndarray]:
+             timeout_s: Optional[float] = None,
+             trace: Optional[obs.TraceContext] = None,
+             meta: Optional[Dict[str, Any]] = None) -> List[np.ndarray]:
         self._check_dead()
+        # same trace/meta surface as HttpReplicaClient (parity pinned by
+        # the fabric tests): the context flows into the app, the engine's
+        # phase attribution flows back through meta
         out = self.app.call(kind, list(arrays), session=session,
-                            timeout_s=timeout_s)
+                            timeout_s=timeout_s, trace=trace, meta=meta)
         # a kill LANDING mid-request: the work may have run, but the
         # response never reached the router (at-most-once delivery is about
         # responses, not executions)
@@ -665,10 +746,29 @@ def build_parser() -> argparse.ArgumentParser:
     eng.add_argument("--heartbeat_deadline_s", type=float, default=None)
     eng.add_argument("--slo_p99_ms", type=float, default=None)
     eng.add_argument("--slo_availability", type=float, default=0.999)
+    eng.add_argument("--trace_sample", type=float, default=0.0,
+                     help="head-sampling rate for engine-MINTED traces, "
+                          "i.e. requests arriving without a propagated "
+                          "router context. Default 0: behind a router the "
+                          "sampling decision belongs to the router (an "
+                          "unsampled request arrives context-less, and a "
+                          "replica re-minting for it would double-sample); "
+                          "raise only for standalone replica use")
     parser.add_argument("--drain_timeout_s", type=float, default=60.0,
                         help="graceful-exit bound: SIGTERM/SIGINT stop "
                              "admission and wait this long for accepted "
                              "work before exiting")
+    parser.add_argument("--events_jsonl", default=None,
+                        help="append THIS replica's runtime events and "
+                             "request-trace spans as JSON lines here (each "
+                             "fleet process writes its own log; "
+                             "tools/trace_assemble.py merges them into "
+                             "per-request trace trees)")
+    parser.add_argument("--events_max_mb", type=float, default=64.0,
+                        help="rotate the events file past this size "
+                             "(3 numbered segments kept); 0 disables "
+                             "rotation. serve.py --replicas forwards its "
+                             "--events_max_mb here")
     return parser
 
 
@@ -745,6 +845,7 @@ def _build_app(args):
         heartbeat_deadline_s=args.heartbeat_deadline_s,
         compile_cache=args.compile_cache,
         slo=slo,
+        trace_sample=args.trace_sample,
     )
     fns = mlm_apply_fns(model)
     engines = {
@@ -784,6 +885,11 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         from perceiver_io_tpu.utils.platform import ensure_cpu_only
 
         ensure_cpu_only()
+    if args.events_jsonl:
+        obs.configure_event_log(
+            args.events_jsonl,
+            max_bytes=(int(args.events_max_mb * 1024 * 1024)
+                       if args.events_max_mb > 0 else None))
 
     app, max_seq_len = _build_app(args)
     server = ReplicaServer(app, port=args.port)

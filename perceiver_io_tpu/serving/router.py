@@ -64,7 +64,7 @@ class RouterFuture:
     replica's output arrays (a single array when there is exactly one).
     ``replica`` / ``attempts`` record where and how it was finally served."""
 
-    def __init__(self):
+    def __init__(self, trace: Optional[obs.TraceContext] = None):
         self._event = threading.Event()
         self._result: Any = None
         self._error: Optional[BaseException] = None
@@ -73,6 +73,10 @@ class RouterFuture:
         self.t_done: Optional[float] = None  # monotonic completion stamp
         # (the open-loop load harness computes latency as t_done - t_submit
         # without the collect-loop skew a post-result() clock read has)
+        self.trace = trace  # distributed-trace context (None = untraced)
+        self.phases: List[dict] = []  # the replica engine's per-part phase
+        # attribution, returned through the RPC (engine-future parity: the
+        # load harness reads fut.phases on either future kind)
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -136,12 +140,19 @@ class Router:
         burn_degrade: Optional[float] = 2.0,
         min_serving: int = 1,
         request_timeout_s: float = 120.0,
+        trace_sample: float = 1.0,
     ):
         self.name = name
         self.policy = policy if policy is not None else FailoverPolicy()
         self.queue_limit = queue_limit
         self.burn_degrade = burn_degrade
         self.request_timeout_s = request_timeout_s
+        # distributed tracing: submit() mints the root TraceContext at this
+        # head-sampling rate (free while no event log is configured); the
+        # context crosses the replica RPC as headers, and completed roots
+        # land in the trace buffer (exemplar-linked from router_latency)
+        self.trace_sample = trace_sample
+        self.traces = obs.TraceBuffer()
         self._lock = threading.Lock()
         self._slots: Dict[str, _Slot] = {}
         self._pins: Dict[str, str] = {}  # session -> replica name
@@ -324,6 +335,7 @@ class Router:
              pin_on_success: bool, deadline: Optional[float]) -> None:
         tried: set = set()
         attempt = 0
+        tr = fut.trace  # None = untraced (no event log / sampled out)
         try:
             while True:
                 attempt += 1
@@ -341,10 +353,22 @@ class Router:
                             "router deadline expired before placement"
                         )
                 self._note_inflight(slot, 1)
+                # one span per placement attempt; its context crosses the
+                # RPC as headers, so the replica's spans parent under it
+                attempt_ctx = tr.child() if tr is not None else None
+                meta: Dict[str, Any] = {}
+                t_attempt = time.monotonic()
                 try:
                     out = slot.client.call(
-                        kind, arrays, session=session, timeout_s=timeout_s)
+                        kind, arrays, session=session, timeout_s=timeout_s,
+                        trace=attempt_ctx, meta=meta)
                 except BaseException as e:
+                    if attempt_ctx is not None:
+                        obs.record_span(
+                            "router_attempt", attempt_ctx, t_attempt,
+                            time.monotonic() - t_attempt, router=self.name,
+                            replica=slot.name, kind=kind, attempt=attempt,
+                            ok=False, error=type(e).__name__)
                     slot.failures += 1
                     obs.event("router_request_failed", router=self.name,
                               replica=slot.name, kind=kind,
@@ -359,8 +383,18 @@ class Router:
                         tried.add(slot.name)
                         self._m_reroutes.inc()
                         pause = self.policy.backoff.backoff_s(attempt)
+                        t_hop = time.monotonic()
                         if pause > 0:
                             time.sleep(pause)
+                        if tr is not None:
+                            # the failover hop itself: the displaced
+                            # request's backoff gap, attributable in the
+                            # assembled trace (the chaos drill's pin)
+                            obs.record_span(
+                                "router_reroute", tr.child(), t_hop,
+                                time.monotonic() - t_hop, router=self.name,
+                                from_replica=slot.name, attempt=attempt,
+                                error=type(e).__name__)
                         continue
                     if session is not None and isinstance(
                             e, (ConnectionError, OSError)) and not pin_on_success:
@@ -369,6 +403,11 @@ class Router:
                         with self._lock:
                             self._pins.pop(session, None)
                         self._m_spills.inc()
+                        if tr is not None:
+                            obs.record_span(
+                                "router_affinity_spill", tr.child(),
+                                time.monotonic(), 0.0, router=self.name,
+                                session=session, replica=slot.name)
                         raise AffinityLost(
                             f"session {session!r}: replica {slot.name!r} "
                             f"died mid-request — re-encode to re-pin"
@@ -376,11 +415,18 @@ class Router:
                     raise
                 finally:
                     self._note_inflight(slot, -1)
+                if attempt_ctx is not None:
+                    obs.record_span(
+                        "router_attempt", attempt_ctx, t_attempt,
+                        time.monotonic() - t_attempt, router=self.name,
+                        replica=slot.name, kind=kind, attempt=attempt,
+                        ok=True)
                 slot.failures = 0
                 if pin_on_success and session is not None:
                     with self._lock:
                         self._pins[session] = slot.name
                 fut.replica = slot.name
+                fut.phases = meta.get("phases") or []
                 fut._deliver(out[0] if len(out) == 1 else out)
                 self._m_completed.inc()
                 return
@@ -418,7 +464,8 @@ class Router:
                 f"(limit {self.queue_limit}) — request shed"
             )
         self._m_requests.inc()
-        fut = RouterFuture()
+        tr = obs.maybe_trace(self.trace_sample)
+        fut = RouterFuture(trace=tr)
         t0 = time.monotonic()
         deadline = None if deadline_s is None else t0 + deadline_s
         arrays = [np.asarray(a) for a in arrays]
@@ -426,8 +473,24 @@ class Router:
 
         def run_and_time():
             self._run(fut, kind, arrays, session, pin, deadline)
-            if fut._error is None:
-                self._m_latency.observe(time.monotonic() - t0)
+            ok = fut._error is None
+            if ok:
+                self._m_latency.observe(
+                    time.monotonic() - t0,
+                    exemplar=tr.trace_id if tr is not None else None)
+            if tr is not None:
+                # the root span: the whole routed lifetime, recorded by the
+                # router process (its duration IS the e2e latency the
+                # histogram + exemplar observe)
+                dur = (fut.t_done if fut.t_done is not None
+                       else time.monotonic()) - t0
+                obs.record_span(
+                    "router_request", tr, t0, dur, router=self.name,
+                    kind=kind, attempts=fut.attempts, replica=fut.replica,
+                    ok=ok, **({} if ok
+                              else {"error": type(fut._error).__name__}))
+                self.traces.add(tr.trace_id, dur, ok=ok, kind=kind,
+                                attempts=fut.attempts, replica=fut.replica)
 
         self._pool.submit(run_and_time)
         return fut

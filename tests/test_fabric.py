@@ -483,6 +483,186 @@ def test_load_bench_dry_fleet_schema():
     assert record["fleet_keys"] == [
         "replicas", "mode", "killed", "kill_at_frac", "kill_point",
         "reroutes", "affinity_spills", "lost_accepted", "restarts"]
+    # r15: the tracing-overhead A/B block is declared in the schema
+    assert record["trace"] is None
+    assert record["trace_keys"] == [
+        "ab_waves", "untraced_rps", "traced_rps", "overhead_pct",
+        "spans_recorded"]
+
+
+# -- distributed request tracing (r15) ----------------------------------------
+
+
+def test_rpc_phase_attribution_and_trace_cross_the_wire(x, tmp_path):
+    """Satellite pin: the replica returns the engine future's ``phases``
+    through the RPC (response header) and the router-side clients surface
+    them — HTTP and LocalReplica in parity — while the propagated
+    TraceContext parents the replica's spans under the caller's."""
+    from perceiver_io_tpu.inference.engine import PHASES
+
+    events = tmp_path / "ev.jsonl"
+    obs.configure_event_log(str(events))
+    rep = _make_replica("wire")
+    server = ReplicaServer(rep.app)
+    url = server.start()
+    client = HttpReplicaClient("wire", url, timeout_s=30)
+    try:
+        ctx = obs.TraceContext.mint()
+        meta = {}
+        out = client.call("infer", [x], trace=ctx, meta=meta)
+        assert np.allclose(out[0], 2.0)
+        assert meta["phases"] and set(meta["phases"][0]) == set(PHASES)
+        assert all(v >= 0 for v in meta["phases"][0].values())
+        # LocalReplica parity: same meta/trace surface, same phase keys
+        meta_local = {}
+        LocalReplica(rep.app).call("infer", [x],
+                                   trace=obs.TraceContext.mint(),
+                                   meta=meta_local)
+        assert meta_local["phases"] \
+            and set(meta_local["phases"][0]) == set(PHASES)
+        # attribution is unconditional — untraced calls carry phases too
+        meta_untraced = {}
+        client.call("infer", [x], meta=meta_untraced)
+        assert meta_untraced["phases"]
+    finally:
+        server.close()
+        rep.app.close()
+        obs.configure_event_log(None)
+    rows = [json.loads(l) for l in open(events)]
+    serves = [r for r in rows if r.get("event") == "span"
+              and r.get("name") == "replica_serve"]
+    mine = [s for s in serves if s["trace"] == ctx.trace_id]
+    assert mine and mine[0]["parent"] == ctx.span_id  # header roundtrip
+    traces, _ = obs.assemble_traces(rows)
+    engine_spans = [s for s in traces[ctx.trace_id]["spans"]
+                    if s["name"] == "engine"]
+    assert engine_spans and engine_spans[0]["parent"] == mine[0]["span"]
+
+
+def test_fleet_tracing_assembles_and_reconciles(x, tmp_path):
+    """THE r15 acceptance pin: every routed request's spans — router root,
+    placement attempt, replica serve, engine + six phases — assemble into
+    one tree whose durations reconcile with the latency histograms the SLO
+    machinery already exports (the r11 5%-at-p50 bar, now cross-process),
+    and the histograms' exemplars resolve to assembled traces."""
+    import statistics
+
+    events = tmp_path / "ev.jsonl"
+    obs.configure_event_log(str(events))
+    try:
+        reg = obs.MetricsRegistry()  # shared by engines AND router so the
+        # reconciliation reads histograms and exemplars from one place
+        reps = [_make_replica(f"tr{i}", registry=reg) for i in range(2)]
+        router = _router(reps, registry=reg)
+        router.refresh()
+        futs = [router.submit(x) for _ in range(24)]
+        for f in futs:
+            assert np.allclose(f.result(30), 2.0)
+        # every router future carries a trace and the replica's phases
+        from perceiver_io_tpu.inference.engine import PHASES
+
+        assert all(f.trace is not None for f in futs)
+        assert all(f.phases and set(f.phases[0]) == set(PHASES)
+                   for f in futs)
+        # close() joins the dispatch pool — the post-delivery root-span
+        # bookkeeping (buffer add, exemplar) is complete after it
+        _close(router, *reps)
+        assert len(router.traces) == 24  # the exemplar-linked ring
+    finally:
+        obs.configure_event_log(None)
+
+    traces, _ = obs.assemble_traces([json.loads(l) for l in open(events)])
+    for f in futs:
+        t = traces[f.trace.trace_id]
+        names = [s["name"] for s in t["spans"]]
+        assert t["root"]["name"] == "router_request"
+        assert "router_attempt" in names and "replica_serve" in names
+        assert "engine" in names
+        assert sum(n.startswith("phase:") for n in names) >= 6
+        # exclusive self-times reconcile with the root duration (5% bar)
+        assert abs(t["span_sum_s"] - t["total_s"]) <= 0.05 * t["total_s"]
+        # nesting: attempt within root, serve within attempt (one clock
+        # here — the cross-clock alignment case is pinned in test_reqtrace)
+        by = {s["name"]: s for s in t["spans"]}
+        assert by["router_attempt"]["dur_s"] <= t["total_s"]
+        assert by["replica_serve"]["dur_s"] \
+            <= by["router_attempt"]["dur_s"] + 1e-6
+
+    # root durations vs the router latency histogram: the SAME e2e the SLO
+    # machinery measures, within 5% at p50
+    hist = reg.histogram("router_latency_seconds",
+                         labels={"router": "router"})
+    assert hist.count == 24
+    p50_hist = statistics.median(hist.values())
+    p50_root = statistics.median(
+        traces[f.trace.trace_id]["total_s"] for f in futs)
+    assert abs(p50_root - p50_hist) <= 0.05 * p50_hist, (p50_root, p50_hist)
+
+    # engine span (phase sum, assembled from the replica side of the RPC)
+    # vs serving_latency_seconds: the r11 reconciliation, now cross-process
+    engine_durs = []
+    for f in futs:
+        engine_durs.extend(
+            s["dur_s"] for s in traces[f.trace.trace_id]["spans"]
+            if s["name"] == "engine")
+    served = []
+    for i in range(2):
+        for bucket in (1, 2, 4):
+            served.extend(reg.histogram(
+                "serving_latency_seconds",
+                labels={"engine": f"tr{i}-infer",
+                        "bucket": str(bucket)}).values())
+    assert len(served) == 24
+    p50_engine = statistics.median(engine_durs)
+    p50_served = statistics.median(served)
+    assert abs(p50_engine - p50_served) <= 0.05 * p50_served, \
+        (p50_engine, p50_served)
+
+    # exemplars: the p99-gauge → concrete-trace link
+    exemplars = hist.exemplars()
+    assert exemplars
+    assert all(e["trace"] in traces for e in exemplars)
+
+
+def test_chaos_kill_trace_shows_reroute_hop_zero_lost(x, tmp_path):
+    """Chaos drill with tracing: kill one of three replicas under traffic —
+    zero accepted requests lost, and every rerouted request's ASSEMBLED
+    trace shows the failover hop (failed attempt on the victim, reroute
+    span, successful attempt elsewhere)."""
+    events = tmp_path / "ev.jsonl"
+    obs.configure_event_log(str(events))
+    try:
+        reps = [_make_replica(f"ck{i}") for i in range(3)]
+        router = _router(reps)
+        futs = [router.submit(x) for _ in range(10)]
+        reps[0].kill()
+        futs += [router.submit(x) for _ in range(30)]
+        for f in futs:
+            assert np.allclose(f.result(30), 2.0)
+        stats = router.stats()
+        assert stats["failed"] == 0  # lost_accepted = 0
+        assert stats["reroutes"] >= 1
+        rerouted = [f for f in futs if f.attempts > 1]
+        assert rerouted, "the kill never displaced a request"
+        _close(router, *reps)
+    finally:
+        obs.configure_event_log(None)
+    traces, _ = obs.assemble_traces([json.loads(l) for l in open(events)])
+    for f in rerouted:
+        t = traces[f.trace.trace_id]
+        assert t["flags"]["reroute"], t["trace"]
+        names = [s["name"] for s in t["spans"]]
+        assert "router_reroute" in names
+        attempts = [s for s in t["spans"] if s["name"] == "router_attempt"]
+        assert any(s.get("ok") is False and s.get("replica") == "ck0"
+                   for s in attempts), attempts
+        ok_attempts = [s for s in attempts if s.get("ok")]
+        assert ok_attempts and all(s["replica"] != "ck0"
+                                   for s in ok_attempts)
+        assert t["root"]["ok"] and t["root"]["replica"] != "ck0"
+    # tail sampling always retains the failover traces
+    kept = obs.tail_sample(traces, slow_pct=1.0, sample=0.0)
+    assert {f.trace.trace_id for f in rerouted} <= set(kept)
 
 
 # -- real-process drills (slow tier) ------------------------------------------
@@ -604,12 +784,37 @@ def test_serve_cli_fleet_matches_single_process(tmp_path):
             "--max_batch", "4", "--k", "3", "--no_warmup"]
     texts = ["a [MASK] b", "no mask here"]
 
+    events = str(tmp_path / "fleet_events.jsonl")
     single = serve.main(base + ["--texts", *texts])
     fleet = serve.main(base + ["--replicas", "2", "--drain_timeout_s", "30",
                                "--rolling_swap_step", "2",
                                "--rolling_bake_s", "0.2",
+                               "--events_jsonl", events,
                                "--texts", *texts])
     assert [l["fills"] for l in fleet] == [l["fills"] for l in single]
+
+    # r15 tracing e2e: the router's log plus each replica process's own
+    # <events>.<name> log assemble into CROSS-PROCESS traces for the served
+    # requests (one text has a mask -> one routed request)
+    import glob as _glob
+
+    log_paths = sorted(_glob.glob(events + "*"))
+    assert events in log_paths and len(log_paths) >= 3, log_paths
+    records = []
+    for p in log_paths:
+        records.extend(json.loads(l) for l in open(p) if l.strip())
+    traces, _ = obs.assemble_traces(records)
+    assert traces, "no traces assembled from the fleet run"
+    routed = [t for t in traces.values()
+              if t["root"]["name"] == "router_request"]
+    assert routed
+    full = [t for t in routed
+            if len(t["processes"]) > 1
+            and any(s["name"] == "replica_serve" for s in t["spans"])
+            and any(s["name"] == "engine" for s in t["spans"])]
+    assert full, "no cross-process trace with replica+engine spans"
+    for t in full:  # the reconciliation bar holds over the real RPC too
+        assert abs(t["span_sum_s"] - t["total_s"]) <= 0.05 * t["total_s"]
 
     cached = serve.main(base + ["--replicas", "2", "--cached",
                                 "--drain_timeout_s", "30",

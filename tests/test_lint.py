@@ -284,6 +284,41 @@ def test_pragma_suppresses_a_rule_on_its_line():
     assert _check(DurationClockRule(), src) == []
 
 
+# -- PIT-SPAN -----------------------------------------------------------------
+
+
+def test_span_rule_validates_literal_names_against_the_registry():
+    """The PIT-FAULT pattern for tracing: a record_span site naming an
+    unregistered span cannot reach HEAD — a typo'd hop would silently
+    decouple from the assembler."""
+    from perceiver_io_tpu.analysis.rules_spans import SpanNameRule
+
+    src = """
+    import perceiver_io_tpu.obs as obs
+    from perceiver_io_tpu.obs.reqtrace import record_span
+
+    def good(ctx, t0):
+        obs.record_span("router_request", ctx, t0, 0.1)
+        record_span("replica_serve", ctx, t0, 0.1, replica="r0")
+
+    def bad(ctx, t0):
+        obs.record_span("router_requests_typo", ctx, t0, 0.1)
+
+    def dynamic(ctx, t0, name):
+        record_span(name, ctx, t0, 0.1)  # non-literal: runtime's problem
+    """
+    found = _check(SpanNameRule(), src)
+    assert len(found) == 1
+    assert found[0].scope == "bad"
+    assert "router_requests_typo" in found[0].message
+    assert "SPAN_NAMES" in found[0].message
+
+    # the registry module itself and the lint fixtures are excluded
+    assert SpanNameRule().check(
+        FileContext("x", "perceiver_io_tpu/obs/reqtrace.py",
+                    'record_span("not_a_span", None, 0, 0)')) == ()
+
+
 # -- baseline -----------------------------------------------------------------
 
 

@@ -1,0 +1,319 @@
+"""Distributed request tracing core (obs/reqtrace + the r15 EventLog and
+registry extensions): context propagation, dual-clock span records,
+cross-process assembly with clock alignment, tail-based sampling, histogram
+exemplars, and the trace buffer.
+
+The fleet-level end-to-end (router → RPC → replica → engine, reconciliation
+against the latency histograms, the chaos reroute span) lives in
+``tests/test_fabric.py`` — this file pins the building blocks in isolation.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import perceiver_io_tpu.obs as obs
+from perceiver_io_tpu.obs.reqtrace import (
+    SPAN_NAMES,
+    TraceBuffer,
+    TraceContext,
+    assemble_traces,
+    maybe_trace,
+    record_span,
+    tail_sample,
+)
+
+
+# -- TraceContext -------------------------------------------------------------
+
+
+def test_trace_context_mint_child_and_header_roundtrip():
+    root = TraceContext.mint()
+    assert len(root.trace_id) == 16 and len(root.span_id) == 8
+    assert root.parent_id is None and root.sampled
+
+    child = root.child()
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    assert child.span_id != root.span_id
+
+    # wire roundtrip: the receiver reconstructs the CALLER's context, and
+    # its child() parents under the caller's span — the cross-process link
+    headers = child.to_headers()
+    assert set(headers) == {"X-Trace-Id", "X-Parent-Span", "X-Sampled"}
+    remote = TraceContext.from_headers(headers)
+    assert remote.trace_id == root.trace_id
+    assert remote.span_id == child.span_id
+    remote_child = remote.child()
+    assert remote_child.parent_id == child.span_id
+
+    # unsampled decisions survive the hop
+    cold = TraceContext.mint(sampled=False)
+    assert not TraceContext.from_headers(cold.to_headers()).sampled
+    # untraced request: no headers -> no context
+    assert TraceContext.from_headers({}) is None
+
+
+def test_maybe_trace_requires_event_log_and_honors_sampling(tmp_path):
+    obs.configure_event_log(None)
+    assert maybe_trace() is None  # free when nothing would record
+    obs.configure_event_log(str(tmp_path / "ev.jsonl"))
+    try:
+        assert maybe_trace(1.0) is not None
+        assert maybe_trace(0.0) is None
+        got = sum(maybe_trace(0.5) is not None for _ in range(400))
+        assert 100 < got < 300  # the coin is real on both sides
+    finally:
+        obs.configure_event_log(None)
+
+
+# -- EventLog dual stamps (the schema the assembler's alignment needs) --------
+
+
+def test_event_log_dual_stamp_schema_roundtrip(tmp_path):
+    """Every record carries wall (``t``), monotonic (``mono``), and ``pid``
+    stamps — durations come from mono (PIT-CLOCK), alignment anchors mono
+    onto wall, pid keys the per-process offset."""
+    path = str(tmp_path / "events.jsonl")
+    obs.configure_event_log(path)
+    try:
+        obs.event("first", k=1)
+        obs.event("second", k=2)
+        record_span("deploy_swap", None, time.monotonic(), 0.25, step=7)
+    finally:
+        obs.configure_event_log(None)
+    rows = [json.loads(l) for l in open(path)]
+    assert len(rows) == 3
+    for r in rows:
+        assert {"t", "mono", "pid"} <= set(r)
+        assert r["pid"] == os.getpid()
+        assert abs(r["t"] - time.time()) < 60  # wall epoch, not monotonic
+    assert rows[0]["mono"] <= rows[1]["mono"] <= rows[2]["mono"]
+    span = rows[2]
+    assert span["event"] == "span" and span["name"] == "deploy_swap"
+    assert span["trace"] is None and span["dur_s"] == 0.25
+    assert span["step"] == 7
+    # sampled-out contexts record nothing
+    obs.configure_event_log(path)
+    try:
+        record_span("deploy_swap",
+                    TraceContext.mint(sampled=False), 0.0, 0.1)
+    finally:
+        obs.configure_event_log(None)
+    assert len(open(path).readlines()) == 3
+
+
+# -- histogram exemplars ------------------------------------------------------
+
+
+def test_histogram_exemplars_ride_snapshot_and_stay_bounded():
+    reg = obs.MetricsRegistry()
+    h = reg.histogram("lat_seconds", "x", {"engine": "e"})
+    h.observe(0.5)  # exemplar-less observations stay exemplar-less
+    assert h.exemplars() == []
+    for i in range(20):
+        h.observe(float(i), exemplar=f"trace{i}")
+    ex = h.exemplars()
+    assert len(ex) == 8  # bounded ring
+    assert ex[0] == {"value": 19.0, "trace": "trace19"}  # slowest first
+    snap = reg.snapshot()
+    entry = snap["histograms"]['lat_seconds{engine="e"}']
+    assert entry["exemplars"][0]["trace"] == "trace19"
+    # a histogram with no exemplars doesn't grow the snapshot key
+    reg.histogram("plain_seconds", "y").observe(1.0)
+    assert "exemplars" not in reg.snapshot()["histograms"]["plain_seconds"]
+    # the sticky slot: the slowest exemplar'd observation survives any
+    # amount of faster traffic scrolling the recency ring
+    h2 = reg.histogram("tail_seconds", "z")
+    h2.observe(9.0, exemplar="the_slow_one")
+    for i in range(100):
+        h2.observe(0.001, exemplar=f"fast{i}")
+    ex2 = h2.exemplars()
+    assert len(ex2) == 9  # ring of 8 + the sticky slowest
+    assert ex2[0] == {"value": 9.0, "trace": "the_slow_one"}
+
+
+# -- TraceBuffer --------------------------------------------------------------
+
+
+def test_trace_buffer_bounded_and_thread_safe():
+    buf = TraceBuffer(capacity=8)
+    threads = [
+        threading.Thread(target=lambda b: [
+            buf.add(f"t{b}_{i}", i / 100.0, ok=True) for i in range(50)
+        ], args=(t,))
+        for t in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(buf) == 8  # capacity, not 200
+    slow = buf.slowest(3)
+    assert len(slow) == 3
+    assert slow[0]["total_s"] >= slow[1]["total_s"] >= slow[2]["total_s"]
+    assert buf.recent(2) == buf.recent()[-2:]
+    with pytest.raises(ValueError):
+        TraceBuffer(capacity=0)
+
+
+# -- assembly + clock alignment ----------------------------------------------
+
+
+def _rec(pid, wall, mono, **fields):
+    """A raw event record as a process's EventLog would write it."""
+    return {"t": wall, "mono": mono, "pid": pid, **fields}
+
+
+def _span_rec(pid, wall, mono, name, trace, span, parent, start, dur,
+              **fields):
+    return _rec(pid, wall, mono, event="span", name=name, trace=trace,
+                span=span, parent=parent, mono_start=start, dur_s=dur,
+                **fields)
+
+
+def test_assemble_aligns_clocks_across_processes():
+    """Two processes with WILDLY different monotonic bases (boot times): the
+    wall anchors recover a consistent timeline — the replica's span lands
+    inside the router's attempt window."""
+    wall = 1_700_000_000.0
+    # router process: mono base 1000; replica process: mono base 500000
+    router_pid, replica_pid = 11, 22
+    records = [
+        # each process writes a few ordinary events (the alignment anchors)
+        _rec(router_pid, wall + 0.0, 1000.0, event="x"),
+        _rec(router_pid, wall + 1.0, 1001.0, event="x"),
+        _rec(replica_pid, wall + 0.5, 500000.5, event="x"),
+        _rec(replica_pid, wall + 1.5, 500001.5, event="x"),
+        # the trace: root (router) -> attempt (router) -> serve (replica)
+        _span_rec(router_pid, wall + 2.0, 1002.0, "router_request",
+                  "T1", "R", None, start=1001.0, dur=1.0, ok=True),
+        _span_rec(router_pid, wall + 1.9, 1001.9, "router_attempt",
+                  "T1", "A", "R", start=1001.1, dur=0.8, replica="r0"),
+        _span_rec(replica_pid, wall + 1.8, 500001.8, "replica_serve",
+                  "T1", "S", "A", start=500001.2, dur=0.6),
+    ]
+    traces, context = assemble_traces(records)
+    assert context == []
+    t = traces["T1"]
+    assert t["root"]["name"] == "router_request"
+    assert t["processes"] == ["11", "22"]
+    by_name = {s["name"]: s for s in t["spans"]}
+    root, attempt, serve = (by_name["router_request"],
+                            by_name["router_attempt"],
+                            by_name["replica_serve"])
+    assert attempt["span"] in root["children"]
+    assert serve["span"] in attempt["children"]
+    # the alignment claim: despite a ~499000s monotonic skew, the replica
+    # span sits INSIDE the router attempt's absolute window
+    assert (attempt["abs_start"] - 0.01 <= serve["abs_start"]
+            <= attempt["abs_start"] + attempt["dur_s"])
+    # exclusive self-times telescope back to the root duration
+    assert t["total_s"] == 1.0
+    assert abs(t["span_sum_s"] - 1.0) < 1e-6
+
+
+def test_assemble_expands_request_phases_into_engine_child_spans():
+    from perceiver_io_tpu.inference.engine import PHASES
+
+    wall, pid = 1_700_000_000.0, 7
+    phases = {"admission": 0.01, "queue": 0.02, "assembly": 0.005,
+              "dispatch": 0.015, "device": 0.04, "complete": 0.01}
+    records = [
+        _rec(pid, wall, 100.0, event="request_phases", engine="e",
+             bucket=2, rows=1, trace="T2", span="E", parent="S",
+             mono_start=99.0, total_s=0.1, **phases),
+        # an UNTRACED request_phases record must not assemble
+        _rec(pid, wall, 101.0, event="request_phases", engine="e",
+             bucket=2, rows=1, total_s=0.1, **phases),
+    ]
+    traces, _ = assemble_traces(records)
+    assert list(traces) == ["T2"]
+    spans = traces["T2"]["spans"]
+    engine = next(s for s in spans if s["name"] == "engine")
+    assert engine["dur_s"] == pytest.approx(sum(phases.values()))
+    kids = [s for s in spans if s["parent"] == "E"]
+    assert [s["name"] for s in kids] == [f"phase:{p}" for p in PHASES]
+    # phase children tile the engine span contiguously
+    t = engine["mono_start"]
+    for s in kids:
+        assert s["mono_start"] == pytest.approx(t, abs=1e-6)
+        t += s["dur_s"]
+
+
+def test_assemble_expands_batch_records_per_part():
+    """The engine's compact spooled span record (";"-joined packed
+    integer-µs rows — the serialization-amortized form full tracing
+    actually emits) expands into one engine span + six phase children PER
+    PART."""
+    from perceiver_io_tpu.inference.engine import PHASES
+
+    wall, pid = 1_700_000_000.0, 9
+    part = lambda i: (f"T{i},S{i},P{i},{99_000_000 + i},1,"
+                      f"100,200,50,150,400,100,4")
+    records = [
+        _rec(pid, wall, 100.0, event="request_phases_batch", engine="e",
+             parts=";".join([part(0), part(1)])),
+    ]
+    traces, _ = assemble_traces(records)
+    assert sorted(traces) == ["T0", "T1"]
+    for i in (0, 1):
+        spans = traces[f"T{i}"]["spans"]
+        engine = next(s for s in spans if s["name"] == "engine")
+        assert engine["span"] == f"S{i}" and engine["parent"] == f"P{i}"
+        assert engine["dur_s"] == pytest.approx(1e-3)  # 1000 µs summed
+        assert engine["mono_start"] == pytest.approx(99.0 + i * 1e-6)
+        assert engine["bucket"] == 4 and engine["rows"] == 1
+        kids = [s for s in spans if s["parent"] == f"S{i}"]
+        assert [s["name"] for s in kids] == [f"phase:{p}" for p in PHASES]
+        assert kids[4]["dur_s"] == pytest.approx(400e-6)  # device
+
+
+def test_assemble_orphan_falls_back_to_earliest_span():
+    """An engine-minted root (single-process serving) has no recorded parent
+    span: the earliest orphan becomes the root instead of the trace being
+    dropped."""
+    records = [
+        _span_rec(1, 100.0, 10.0, "replica_serve", "T3", "S", "GHOST",
+                  start=9.0, dur=0.5),
+    ]
+    traces, _ = assemble_traces(records)
+    assert traces["T3"]["root"]["name"] == "replica_serve"
+    assert traces["T3"]["total_s"] == 0.5
+
+
+def test_tail_sample_keeps_flags_and_slow_tail_deterministically():
+    def trace(i, total, **flags):
+        return {"trace": f"t{i:03d}", "total_s": total,
+                "flags": {"error": False, "reroute": False, "spill": False,
+                          **flags}}
+
+    traces = {f"t{i:03d}": trace(i, 0.01 + i * 1e-4) for i in range(100)}
+    traces["t000"]["flags"]["reroute"] = True  # fastest, but flagged
+    traces["t001"]["flags"]["error"] = True
+
+    kept = tail_sample(traces, slow_pct=0.95, sample=0.0)
+    reasons = {k: v["kept_for"] for k, v in kept.items()}
+    assert reasons["t000"] == "flag" and reasons["t001"] == "flag"
+    slow = [k for k, r in reasons.items() if r == "slow"]
+    assert len(slow) >= 5  # the top 5%
+    assert all(k >= "t095" for k in slow), slow
+    # sample=0 keeps nothing else; determinism across calls
+    assert tail_sample(traces, slow_pct=0.95, sample=0.3, seed=1) \
+        == tail_sample(traces, slow_pct=0.95, sample=0.3, seed=1)
+    assert tail_sample({}) == {}
+
+
+# -- the span-name registry ---------------------------------------------------
+
+
+def test_span_names_registry_covers_recorded_sites():
+    """Every name the runtime records is registered (the PIT-SPAN rule
+    enforces the converse statically at every literal site)."""
+    assert {"router_request", "router_attempt", "router_reroute",
+            "router_affinity_spill", "replica_serve",
+            "deploy_swap"} <= set(SPAN_NAMES)

@@ -38,9 +38,16 @@ drill: at FRAC of sweep point ``--kill_point``'s offered window one replica
 dies (``kill -9`` in process mode; the supervisor restarts it and it
 rejoins once warm), and the record's ``fleet`` block carries the drill's
 verdict — ``lost_accepted`` MUST be 0 (accepted requests re-route, never
-drop). The per-request phase attribution is engine-side and does not cross
-the RPC boundary, so fleet points carry end-to-end latency with empty
+drop). Per-request phase attribution crosses the RPC since r15 (the replica
+returns the engine future's phases; router futures surface them), so fleet
+points carry BOTH router-measured end-to-end latency and the replica-side
 phase breakdowns.
+
+``--trace_ab`` measures the r15 distributed-tracing overhead the honest way
+(PERF.md discipline: same-process, interleaved): closed-loop waves alternate
+traced (event log + span emission at every hop) and untraced in ONE process,
+and the record's ``trace`` block reports both throughputs and the overhead
+ratio — the acceptance bar is <= 2% on CPU.
 
 Usage::
 
@@ -90,6 +97,11 @@ FLEET_KEYS = ("replicas", "mode", "killed", "kill_at_frac", "kill_point",
 DEPLOY_KEYS = ("publish_every_s", "publishes", "swaps", "rejects",
                "rollbacks", "p99_steady_ms", "p99_swap_ms", "blip_ratio",
                "per_swap_p99_ms")
+# the trace block of a --trace_ab run (null otherwise): same-process
+# interleaved traced-vs-untraced closed-loop waves; overhead_pct is the
+# throughput cost of full tracing (PERF.md §Tracing bar: <= 2% on CPU)
+TRACE_KEYS = ("ab_waves", "untraced_rps", "traced_rps", "overhead_pct",
+              "spans_recorded")
 
 
 def _pct(values: List[float], q: float) -> Optional[float]:
@@ -119,15 +131,17 @@ def _build_requests(max_seq_len: int, vocab: int, n: int, seed: int):
 
 
 def _fut_latencies(fut, t_submit: float):
-    """(end-to-end latencies, phase records) for one completed future —
-    engine futures carry per-part phase attribution; router futures carry a
-    completion stamp (phases stay replica-side)."""
+    """(end-to-end latencies, phase records) for one completed future.
+    Router futures carry a completion stamp (the honest e2e, including
+    RPC + routing) AND, since r15, the replica engine's phase records
+    returned through the RPC; engine futures carry phases only, whose sum
+    IS the e2e (the r11 reconciliation)."""
     recs = getattr(fut, "phases", None) or []
-    if recs:
-        return [sum(r.values()) for r in recs], recs
     t_done = getattr(fut, "t_done", None)
     if t_done is not None:
-        return [t_done - t_submit], []
+        return [t_done - t_submit], recs
+    if recs:
+        return [sum(r.values()) for r in recs], recs
     return [], []
 
 
@@ -151,6 +165,67 @@ def _calibrate(submit, reqs, waves: int, wave_size: int):
     rates.sort()
     lat = _pct(lats, 0.5)
     return rates[len(rates) // 2], lat if lat is not None else 0.01
+
+
+def _trace_ab(submit, reqs, waves: int, wave_size: int,
+              drain_timeout_s: float) -> Dict:
+    """Same-process INTERLEAVED traced-vs-untraced A/B (the PERF.md
+    measurement discipline — a cross-run comparison would measure host
+    drift, not tracing): closed-loop waves alternate with the event log
+    (and therefore trace minting + span emission at every hop) on and off;
+    the reported overhead is the median of per-adjacent-PAIR ratios, so
+    slow host drift cancels instead of inflating the arm medians."""
+    import tempfile
+
+    import perceiver_io_tpu.obs as obs
+
+    tmp = tempfile.NamedTemporaryFile(prefix="load_bench_trace_",
+                                      suffix=".jsonl", delete=False)
+    tmp.close()
+    rates: Dict[bool, List[float]] = {False: [], True: []}
+    spans = 0
+    try:
+        for w in range(2 * waves):
+            # interleaved AND order-alternating per pair (U,T then T,U):
+            # a null-control run (both arms identical) measured the
+            # second-of-pair wave systematically ~0.5% slower on this
+            # host, so a fixed order would bias the paired estimate by
+            # exactly that much
+            traced = bool(w % 2) ^ bool((w // 2) % 2)
+            obs.configure_event_log(tmp.name if traced else None)
+            t0 = time.monotonic()
+            futs = [submit(reqs[i % len(reqs)]) for i in range(wave_size)]
+            for f in futs:
+                f.result(timeout=drain_timeout_s)
+            rates[traced].append(wave_size / (time.monotonic() - t0))
+        obs.configure_event_log(None)
+        with open(tmp.name) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("event") == "span":
+                    spans += 1
+                elif rec.get("event") == "request_phases_batch":
+                    # parts is the ";"-joined packed row string
+                    parts = rec.get("parts") or ""
+                    spans += parts.count(";") + 1 if parts else 0
+    finally:
+        # unhook FIRST: a wave that raised mid-A/B must not leave the
+        # process-wide log writing into the inode unlinked below
+        obs.configure_event_log(None)
+        os.unlink(tmp.name)
+    med = lambda v: sorted(v)[len(v) // 2]
+    untraced, traced_rps = med(rates[False]), med(rates[True])
+    # each traced wave paired with the untraced wave adjacent in time:
+    # the per-pair ratio cancels the slow drift a shared host smears
+    # across the run (arm medians would absorb it as ±severalx the signal)
+    paired = med([1.0 - t / u for u, t in zip(rates[False], rates[True])])
+    return {
+        "ab_waves": waves,
+        "untraced_rps": round(untraced, 3),
+        "traced_rps": round(traced_rps, 3),
+        "overhead_pct": round(100.0 * paired, 3),
+        "spans_recorded": spans,
+    }
 
 
 def _arrival_gaps(arrival: str, rate: float, duration: float, burst: int,
@@ -337,6 +412,20 @@ def main() -> None:
                           "blip vs steady). Default: off")
     dep.add_argument("--blip_window_s", type=float, default=0.5,
                      help="half-width of the per-swap p99 attribution window")
+    trc = parser.add_argument_group(
+        "distributed tracing (perceiver_io_tpu.obs.reqtrace)")
+    trc.add_argument("--events_jsonl", default=None,
+                     help="configure the event log here for the whole run: "
+                          "every request mints a TraceContext and records "
+                          "spans at each hop — assemble with "
+                          "tools/trace_assemble.py. Default: off")
+    trc.add_argument("--trace_ab", action="store_true",
+                     help="measure tracing overhead: same-process "
+                          "INTERLEAVED traced/untraced closed-loop waves; "
+                          "the record gains a 'trace' block "
+                          "(overhead_pct must stay <= 2 on CPU)")
+    trc.add_argument("--trace_ab_waves", type=int, default=6,
+                     help="waves per arm of the A/B")
     args = parser.parse_args()
 
     if args.dry:
@@ -346,7 +435,9 @@ def main() -> None:
             "duration_s": args.duration_s,
             "point_keys": list(POINT_KEYS), "phase_keys": list(PHASE_KEYS),
             "fleet_keys": list(FLEET_KEYS), "deploy_keys": list(DEPLOY_KEYS),
+            "trace_keys": list(TRACE_KEYS),
             "sweep": [], "capacity": None, "fleet": None, "deploy": None,
+            "trace": None,
         }
         emit_json_line(record)
         return
@@ -480,6 +571,17 @@ def main() -> None:
         submit, reqs, args.calibration_waves, args.calibration_wave_size)
     _log(f"calibrated closed-loop capacity ~{cal_rps:.1f} req/s, "
          f"median latency {cal_lat_s * 1e3:.2f} ms")
+
+    trace_record = None
+    if args.trace_ab:
+        trace_record = _trace_ab(submit, reqs, args.trace_ab_waves,
+                                 args.calibration_wave_size,
+                                 args.drain_timeout_s)
+        _log(f"trace A/B: {json.dumps(trace_record)}")
+    if args.events_jsonl:
+        # configured AFTER the A/B (which owns the global log while it
+        # runs): the sweep itself records spans at every hop
+        obs.configure_event_log(args.events_jsonl)
 
     # -- continuous-deployment ride-along (--publish_every_s) ----------------
     deploy_stack = None
@@ -672,7 +774,10 @@ def main() -> None:
         "capacity": capacity,
         "fleet": fleet_record,
         "deploy": deploy_record,
+        "trace": trace_record,
     }
+    if args.events_jsonl:
+        obs.configure_event_log(None)  # flush + release the sweep's log
     if router is not None:
         router.drain(args.drain_timeout_s)
         router.close()

@@ -1,0 +1,171 @@
+"""Merge per-process event logs into per-request distributed trace trees.
+
+The fleet writes one JSONL per process (router: ``--events_jsonl``, each
+replica: ``<events_jsonl>.<name>``; every record dual-stamped wall+monotonic
+and pid-labeled). This tool performs the offline half of the r15 tracing
+story (``perceiver_io_tpu.obs.reqtrace``):
+
+1. **cross-process clock alignment** — each process's monotonic span stamps
+   are anchored onto the shared wall timeline via that process's median
+   ``wall − mono`` offset;
+2. **trace assembly** — span records (and the engine's ``request_phases``
+   records, expanded into six phase child spans) join across processes into
+   one tree per trace id;
+3. **tail-based sampling** — error / reroute / affinity-spill traces and the
+   slowest ``1 − slow_pct`` fraction are always kept; the boring majority is
+   kept at ``--sample`` rate;
+4. **reconciliation** — per trace, the sum of exclusive span self-times is
+   compared with the root duration (the e2e latency the router histogram
+   observed): the ``reconcile_p50`` ratio is the cross-process extension of
+   the r11 phase-sum self-check.
+
+Kept traces are written (one JSON tree per line) to ``--out``; the stdout is
+exactly ONE JSON summary line (tool contract). ``--trace ID`` pretty-prints
+one assembled tree to stderr — the "show me my p99 request" workflow, fed a
+trace id from a latency histogram's ``exemplars`` (``/statz``).
+
+Usage::
+
+    python tools/trace_assemble.py events.jsonl events.jsonl.r0 \
+        [--out traces.jsonl] [--slow_pct 0.95] [--sample 0.1] [--trace ID]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from perceiver_io_tpu.obs.reqtrace import assemble_traces, tail_sample
+from perceiver_io_tpu.utils.jsonline import emit_json_line, log
+
+
+def read_records(paths: List[str]) -> List[Dict[str, Any]]:
+    """Every parseable JSON line across ``paths`` (rotated segments welcome:
+    pass ``events.jsonl*``). Torn lines (a crashed writer's last write) are
+    skipped, counted, never fatal."""
+    records: List[Dict[str, Any]] = []
+    torn = 0
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except ValueError:
+                    torn += 1
+    if torn:
+        log(f"trace_assemble: skipped {torn} unparseable line(s)")
+    return records
+
+
+def render_trace(trace: Dict[str, Any]) -> str:
+    """Human tree view of one assembled trace (stderr)."""
+    by_id = {s["span"]: s for s in trace["spans"]}
+    children: Dict[str, List[str]] = {s["span"]: list(s["children"])
+                                      for s in trace["spans"]}
+    lines = [f"trace {trace['trace']}  total {trace['total_s'] * 1e3:.3f} ms"
+             f"  span_sum {trace['span_sum_s'] * 1e3:.3f} ms"
+             f"  processes {','.join(trace['processes'])}"
+             f"  flags {trace['flags']}"]
+
+    def walk(span_id: str, depth: int) -> None:
+        s = by_id[span_id]
+        extra = " ".join(
+            f"{k}={s[k]}" for k in ("replica", "engine", "attempt", "error")
+            if s.get(k) is not None)
+        lines.append(f"  {'  ' * depth}{s['name']:<24} "
+                     f"{s['dur_s'] * 1e3:9.3f} ms  pid={s.get('pid')}"
+                     + (f"  {extra}" if extra else ""))
+        for c in sorted(children.get(span_id, ()),
+                        key=lambda cid: by_id[cid]["abs_start"]):
+            walk(c, depth + 1)
+
+    walk(trace["root"]["span"], 0)
+    return "\n".join(lines)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        description="assemble per-process event logs into request traces")
+    parser.add_argument("paths", nargs="+",
+                        help="event JSONL files (globs ok: events.jsonl*)")
+    parser.add_argument("--out", default=None,
+                        help="write kept assembled traces here, one JSON "
+                             "tree per line")
+    parser.add_argument("--slow_pct", type=float, default=0.95,
+                        help="always keep traces at/above this duration "
+                             "percentile (the tail)")
+    parser.add_argument("--sample", type=float, default=0.1,
+                        help="retention rate for unflagged, non-tail traces")
+    parser.add_argument("--all", action="store_true",
+                        help="keep every assembled trace (skip tail "
+                             "sampling)")
+    parser.add_argument("--trace", default=None, metavar="ID",
+                        help="pretty-print this assembled trace to stderr "
+                             "(e.g. an exemplar trace id from /statz)")
+    args = parser.parse_args()
+
+    paths = sorted({p for pattern in args.paths
+                    for p in (glob.glob(pattern) or [pattern])})
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        raise SystemExit(f"trace_assemble: no such file(s): {missing}")
+    records = read_records(paths)
+    traces, context = assemble_traces(records)
+
+    kept = (dict(traces) if args.all
+            else tail_sample(traces, slow_pct=args.slow_pct,
+                             sample=args.sample))
+    kept_for: Dict[str, int] = {}
+    for t in kept.values():
+        reason = t.get("kept_for", "all")
+        kept_for[reason] = kept_for.get(reason, 0) + 1
+
+    # the cross-process extension of the r11 reconciliation self-check:
+    # exclusive span self-times should partition the root's duration
+    ratios = sorted(t["span_sum_s"] / t["total_s"]
+                    for t in traces.values() if t["total_s"] > 0)
+    reconcile_p50 = (ratios[len(ratios) // 2] if ratios else None)
+    cross = sum(1 for t in traces.values() if len(t["processes"]) > 1)
+
+    if args.trace is not None:
+        t = traces.get(args.trace)
+        if t is None:
+            log(f"trace_assemble: trace {args.trace!r} not found "
+                f"({len(traces)} assembled)")
+        else:
+            log(render_trace(t))
+
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            for trace_id in sorted(kept):
+                f.write(json.dumps(kept[trace_id], default=str) + "\n")
+        log(f"trace_assemble: wrote {len(kept)} trace(s) -> {args.out}")
+
+    emit_json_line({
+        "tool": "trace_assemble",
+        "files": len(paths),
+        "records": len(records),
+        "traces": len(traces),
+        "cross_process_traces": cross,
+        "kept": len(kept),
+        "kept_for": dict(sorted(kept_for.items())),
+        "context_spans": len(context),
+        "reconcile_p50": (None if reconcile_p50 is None
+                          else round(reconcile_p50, 4)),
+        "slow_pct": args.slow_pct,
+        "sample": args.sample,
+        "ok": True,
+    })
+
+
+if __name__ == "__main__":
+    main()
