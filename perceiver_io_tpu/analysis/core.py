@@ -184,11 +184,13 @@ def all_rules() -> List[Rule]:
     from perceiver_io_tpu.analysis.rules_contract import ToolContractRule
     from perceiver_io_tpu.analysis.rules_faults import FaultSiteRule
     from perceiver_io_tpu.analysis.rules_locks import LockDisciplineRule
+    from perceiver_io_tpu.analysis.rules_metrics import MetricNameRule
     from perceiver_io_tpu.analysis.rules_purity import JitPurityRule
     from perceiver_io_tpu.analysis.rules_spans import SpanNameRule
 
     return [JitPurityRule(), ToolContractRule(), FaultSiteRule(),
-            LockDisciplineRule(), DurationClockRule(), SpanNameRule()]
+            LockDisciplineRule(), DurationClockRule(), SpanNameRule(),
+            MetricNameRule()]
 
 
 def iter_py_files(paths: Sequence[str]) -> Iterable[str]:

@@ -1,0 +1,139 @@
+"""PIT-METRIC: alert-rule and series-key metric-name literals resolve
+against the registry's known instrument names.
+
+The PIT-SPAN pattern applied to the time-series/alerting layer: an
+``AlertRule(metric=...)`` or ``series_key("...")`` literal that names an
+instrument nothing registers would build a rule that silently never fires
+(the store's ``match()`` returns nothing forever) — exactly the failure
+class a page-class alert cannot afford. Unlike span names there is no
+single hand-maintained registry to import: instrument names ARE their
+registration sites (``reg.counter("...")`` / ``.gauge`` / ``.histogram``
+string literals scattered across the package), so the rule derives the
+known set by scanning ``perceiver_io_tpu/`` once per process (cached) and
+collecting every literal first argument of those calls.
+
+Checked shapes: ``AlertRule(metric="...")`` (keyword or second positional)
+and ``series_key("...")`` first arguments. Resolution strips the
+``{label="v"}`` suffix and a trailing ``:p50``/``:p95``/``:p99``/``:count``
+histogram field. Non-literal metrics (runtime-loaded rule files, dynamic
+names like the trainer's sanitized scalar keys) are the runtime's problem —
+``AlertEngine.health_status`` surfaces rules that never matched a series.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Set
+
+from perceiver_io_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    ScopedVisitor,
+    dotted_name,
+    iter_py_files,
+)
+
+_REGISTRATION_LEAVES = {"counter", "gauge", "histogram"}
+
+_KNOWN: Optional[Set[str]] = None
+
+
+def _package_root() -> str:
+    # analysis/ sits inside the package; instruments register in package
+    # code only, so the scan stays bounded to perceiver_io_tpu/
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def known_metric_names(root: Optional[str] = None) -> Set[str]:
+    """Every literal instrument name registered anywhere in the package —
+    the set a metric literal must resolve against. Cached per process
+    (the lint pass visits every file; re-deriving per file would square
+    the parse cost)."""
+    global _KNOWN
+    if _KNOWN is not None and root is None:
+        return _KNOWN
+    names: Set[str] = set()
+    for path in iter_py_files([root or _package_root()]):
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+        except (SyntaxError, UnicodeDecodeError, OSError):
+            continue  # PIT-PARSE owns unparseable files
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = dotted_name(node.func) or ""
+            if "." not in name:  # bare counter()/gauge() is not the registry
+                continue
+            if name.rsplit(".", 1)[-1] not in _REGISTRATION_LEAVES:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                names.add(arg.value)
+    if root is None:
+        _KNOWN = names
+    return names
+
+
+def strip_series_key(literal: str) -> str:
+    """Reduce a series key to the bare instrument name — ONE parse of the
+    key grammar, imported lazily from its definition (the PIT-SPAN
+    pattern; obs.timeseries is stdlib-only at import, so the lint pass
+    stays CPU-safe)."""
+    from perceiver_io_tpu.obs.timeseries import split_series_key
+
+    return split_series_key(literal)[0]
+
+
+def _name_error(literal: str) -> Optional[str]:
+    base = strip_series_key(literal)
+    if base in known_metric_names():
+        return None
+    return (f"metric {literal!r} does not resolve: no registry instrument "
+            f"named {base!r} is registered anywhere in the package — a "
+            f"typo'd rule would silently never fire")
+
+
+class _Visitor(ScopedVisitor):
+    def __init__(self, rule: "MetricNameRule", ctx: FileContext):
+        super().__init__()
+        self.rule = rule
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+
+    def _check_literal(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            err = _name_error(node.value)
+            if err:
+                self.findings.append(self.rule.finding(
+                    self.ctx, node, self.scope, err))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted_name(node.func) or ""
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf == "AlertRule":
+            metric = next((kw.value for kw in node.keywords
+                           if kw.arg == "metric"), None)
+            if metric is None and len(node.args) >= 2:
+                metric = node.args[1]  # (name, metric, ...) positionally
+            if metric is not None:
+                self._check_literal(metric)
+        elif leaf == "series_key" and node.args:
+            self._check_literal(node.args[0])
+        self.generic_visit(node)
+
+
+class MetricNameRule(Rule):
+    rule_id = "PIT-METRIC"
+
+    # the lint suite's fixtures deliberately contain unresolvable names
+    SELF_EXCLUDED = ("tests/test_lint.py",)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.relpath.endswith(self.SELF_EXCLUDED):
+            return ()
+        visitor = _Visitor(self, ctx)
+        visitor.visit(ctx.tree)
+        return visitor.findings

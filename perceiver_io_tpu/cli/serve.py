@@ -85,6 +85,17 @@ including process self-metrics RSS/uptime/threads/GC at every scrape);
 ``--selfprofile_every`` turns on the in-loop device-trace watchdog. All
 telemetry output rides stderr/HTTP — stdout stays one JSON line per text.
 
+``--series`` adds the historical half (``perceiver_io_tpu.obs.timeseries``,
+PERF.md §Timeseries): every registry instrument sampled into a bounded
+ring-buffer store each ``--series_interval_s``, served live as
+``/seriesz`` and optionally persisted as rotating JSONL
+(``--series_jsonl``). ``--alert_rules FILE`` evaluates declarative alert
+rules (threshold / rate-of-change / absence over a window, with hold-down
+and hysteresis) over those series: transitions land in the event log
+(exemplar trace-linked), ``alert_state{rule=}`` rides ``/metrics``, and a
+firing page-class alert degrades ``/healthz`` through the same aggregation
+as stalls, breakers, and SLO burn.
+
 Self-healing (``perceiver_io_tpu.resilience``, PERF.md §Reliability):
 ``--request_deadline_s`` sheds requests whose deadline expires before
 dispatch, ``--queue_limit`` bounds the queue with fast-fail load shedding,
@@ -319,6 +330,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "per-request trace trees with "
                         "tools/trace_assemble.py. 0 disables; tail-based "
                         "retention happens at assembly")
+    o.add_argument("--series", action="store_true",
+                   help="sample every registry instrument into a bounded "
+                        "in-memory time-series store at --series_interval_s "
+                        "(counters as cumulative values, gauges as values, "
+                        "histograms as windowed p50/p95/p99+count) and "
+                        "serve it live as /seriesz on the --metrics_port "
+                        "sidecar (?window_s=60 bounds the returned points). "
+                        "Implied by --series_jsonl / --alert_rules")
+    o.add_argument("--series_interval_s", type=float, default=1.0,
+                   help="sampling cadence (PERF.md §Timeseries: overhead "
+                        "at the 1 s default is below the CPU noise floor)")
+    o.add_argument("--series_jsonl", default=None, metavar="PATH",
+                   help="persist one series_sample JSON line per sweep "
+                        "here (size-capped rotation like --events_jsonl) — "
+                        "the on-disk history next to the event log")
+    o.add_argument("--alert_rules", default=None, metavar="FILE",
+                   help="JSON alert rules (a list of AlertRule objects: "
+                        "name/metric/kind=threshold|rate|absence/op/"
+                        "threshold/window_s/for_s/resolve_threshold/"
+                        "severity) evaluated over the sampled series every "
+                        "--series_interval_s: transitions emit alert_firing/"
+                        "alert_resolved events into --events_jsonl, "
+                        "alert_state{rule=} rides /metrics, and a firing "
+                        "page-severity rule degrades /healthz")
     o.add_argument("--slo_p99_ms", type=float, default=None,
                    help="serving SLO latency target: a request answered "
                         "within this many ms counts good, sheds/errors and "
@@ -364,6 +399,8 @@ def main(argv: Optional[Sequence[str]] = None):
                        if args.events_max_mb > 0 else None),
         )
     obs_server = None
+    sampler = None
+    alert_engine = None
     if args.metrics_port is not None:
         # started BEFORE the checkpoint load / warmup so probes can watch a
         # slow bring-up; counters stay zero until requests arrive. stdout is
@@ -374,8 +411,33 @@ def main(argv: Optional[Sequence[str]] = None):
         obs_server = obs.ObsServer(port=args.metrics_port)
         url = obs_server.start()
         if url is not None:
-            print(f"serve: metrics on {url}/metrics (also /healthz /statz)",
+            print(f"serve: metrics on {url}/metrics (also /healthz /statz"
+                  + ("/seriesz" if (args.series or args.series_jsonl
+                                    or args.alert_rules) else "") + ")",
                   file=sys.stderr, flush=True)
+
+    if args.series or args.series_jsonl or args.alert_rules:
+        # the historical half: a bounded store sampled on a cadence,
+        # installed as the process default so /seriesz serves it live;
+        # optional JSONL persistence rides the same rotation contract as
+        # the event log. Alert rules evaluate over the same store.
+        store = obs.SeriesStore()
+        obs.install_series_store(store)
+        sampler = obs.Sampler(
+            store=store, interval_s=args.series_interval_s,
+            jsonl_path=args.series_jsonl, name="serve").start()
+        print(f"serve: sampling series every {args.series_interval_s:g}s"
+              + (f" -> {args.series_jsonl}" if args.series_jsonl else ""),
+              file=sys.stderr, flush=True)
+        if args.alert_rules:
+            rules = obs.load_alert_rules(args.alert_rules)
+            alert_engine = obs.AlertEngine(
+                store, rules, interval_s=args.series_interval_s,
+                name="serve").start()
+            print(f"serve: {len(rules)} alert rule(s) active "
+                  f"({', '.join(r.name for r in rules)}) — firing "
+                  "page-class alerts degrade /healthz", file=sys.stderr,
+                  flush=True)
 
     try:
         if args.replicas > 0:
@@ -395,6 +457,19 @@ def main(argv: Optional[Sequence[str]] = None):
         # tests/other tools). configure_event_log(None) FLUSHES and closes
         # the JSONL stream — the drain contract's "flush the event log".
         restore_handlers()
+        if alert_engine is not None:
+            # one last evaluation so an episode that ended during drain
+            # still resolves into the event log before it closes
+            try:
+                alert_engine.evaluate()
+            except Exception:
+                pass
+            print(f"serve: alerts {json.dumps(alert_engine.stats())}",
+                  file=sys.stderr, flush=True)
+            alert_engine.close()
+        if sampler is not None:
+            sampler.close()  # drains --series_jsonl to disk
+            obs.install_series_store(None)
         if obs_server is not None:
             obs_server.close()
         if args.events_jsonl:

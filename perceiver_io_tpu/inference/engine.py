@@ -445,6 +445,7 @@ class ServingEngine:
         compile_cache=None,
         cache_salt: str = "",
         slo: Optional[obs.SLO] = None,
+        slo_window: int = 4096,
         span_every: int = 1,
         trace_sample: float = 1.0,
     ):
@@ -634,7 +635,12 @@ class ServingEngine:
         # burn-rate gauges ride the registry and healthz() (obs/slo.py)
         self.slo_tracker: Optional[obs.SLOTracker] = None
         if slo is not None:
-            self.slo_tracker = obs.SLOTracker(slo, registry=reg, labels=labels)
+            # slo_window bounds the classification window (burn rate =
+            # recent behavior): a smaller window makes the burn gauge — and
+            # any alert rule over it — track episode boundaries faster
+            self.slo_tracker = obs.SLOTracker(slo, registry=reg,
+                                              labels=labels,
+                                              window=slo_window)
 
         # untraced JSONL request_phases spans sample every Nth part (the
         # registry histograms keep the full-rate view regardless); TRACED
@@ -1406,8 +1412,18 @@ class ServingEngine:
                 "complete": now - t_fetched,
             }
             e2e = now - p.t_submit
+            self._span_seq += 1
+            trace = p.future.trace
+            traced = trace is not None and trace.sampled
+            # an exemplar per 4 observations is plenty of linkage (the
+            # ring keeps 8) and keeps the attach off most completions;
+            # the SAME trace id lands on the latency histogram and every
+            # phase histogram, so a phase-level alert ("p99 queue time is
+            # burning") links to the identical assembled trace
+            exemplar = (trace.trace_id
+                        if traced and self._span_seq & 3 == 0 else None)
             for k, v in phases.items():
-                self._m_phase[k].observe(v)
+                self._m_phase[k].observe(v, exemplar=exemplar)
             if e2e > 0:
                 self._m_phase_ratio.set(sum(phases.values()) / e2e)
             # record BEFORE delivering: result() waking the caller is the
@@ -1416,9 +1432,6 @@ class ServingEngine:
             p.future._note_phases(phases)
             if self.slo_tracker is not None:
                 self.slo_tracker.record(latency_s=e2e, ok=True)
-            self._span_seq += 1
-            trace = p.future.trace
-            traced = trace is not None and trace.sampled
             if emit_spans and traced:
                 # each part is one engine span: fresh id under the
                 # propagated context, so the assembler hangs the six
@@ -1443,11 +1456,7 @@ class ServingEngine:
                           rows=p.n, total_s=round(e2e, 6),
                           **{k: round(v, 6) for k, v in phases.items()})
             latencies.append(e2e)
-            # an exemplar per 4 observations is plenty of linkage (the
-            # ring keeps 8) and keeps the attach off most completions
-            hist.observe(e2e, exemplar=(
-                trace.trace_id if traced and self._span_seq & 3 == 0
-                else None))
+            hist.observe(e2e, exemplar=exemplar)
             phase_rows.append(phases)
             o = offset
             p.future._deliver(

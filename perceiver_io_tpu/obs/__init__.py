@@ -27,6 +27,17 @@ One subsystem, four pieces, every layer wired through it:
   source (one replica's open breaker degrades that replica's label, never
   the router's status code while other replicas serve) and the per-replica
   labeled gauges the router publishes from its scrape loop.
+- :mod:`timeseries` — the historical half: a bounded ring-buffer
+  ``SeriesStore`` with windowed ``last``/``rate``/``delta`` queries, a
+  cadenced ``Sampler`` over every registry instrument (rotating-JSONL
+  persistence, served live as ``/seriesz``), and per-replica fleet
+  ingestion from the router's scrape loop.
+- :mod:`alerts` — declarative alerting over the series store:
+  ``AlertRule`` (threshold / rate-of-change / absence over a window, with
+  ``for_s`` hold-down and hysteresis), evaluated into EventLog
+  firing/resolved events (exemplar trace-linked), ``alert_state{rule=}``
+  gauges, and a ``healthz()`` source — a firing page-class alert degrades
+  ``/healthz`` like a stall, a breaker, or SLO burn.
 
 Importing this package never initializes a jax backend — entry points stay
 free to pick their platform (``ensure_cpu_only``) first.
@@ -61,7 +72,19 @@ from perceiver_io_tpu.obs.reqtrace import (
     record_span,
     tail_sample,
 )
+from perceiver_io_tpu.obs.alerts import (
+    AlertEngine,
+    AlertRule,
+    load_rules as load_alert_rules,
+)
 from perceiver_io_tpu.obs.slo import SLO, SLOTracker, fit_capacity
+from perceiver_io_tpu.obs.timeseries import (
+    Sampler,
+    SeriesStore,
+    get_series_store,
+    install_series_store,
+    series_key,
+)
 from perceiver_io_tpu.obs.tracing import (
     EventLog,
     configure_event_log,
@@ -72,6 +95,8 @@ from perceiver_io_tpu.obs.tracing import (
 from perceiver_io_tpu.obs.watchdog import SelfProfiler, install_compile_counter
 
 __all__ = [
+    "AlertEngine",
+    "AlertRule",
     "Counter",
     "EventLog",
     "FleetHealth",
@@ -84,7 +109,9 @@ __all__ = [
     "SLO",
     "SLOTracker",
     "SPAN_NAMES",
+    "Sampler",
     "SelfProfiler",
+    "SeriesStore",
     "TraceBuffer",
     "TraceContext",
     "assemble_traces",
@@ -93,7 +120,11 @@ __all__ = [
     "fit_capacity",
     "get_event_log",
     "get_registry",
+    "get_series_store",
     "healthz",
+    "install_series_store",
+    "load_alert_rules",
+    "series_key",
     "install_compile_counter",
     "install_process_metrics",
     "is_export_process",

@@ -1,0 +1,449 @@
+"""Declarative alerting over the metrics time-series.
+
+:class:`AlertRule` declares a condition over a :class:`SeriesStore` window —
+threshold (windowed aggregate vs a bound), rate-of-change, or absence (the
+series stopped arriving) — plus the two pieces that keep a flapping gauge
+from flapping the alert:
+
+- ``for_s`` **hold-down**: the condition must hold continuously this long
+  before the alert fires (a one-sample spike never pages);
+- **hysteresis**: once firing, the alert resolves only after the signal has
+  stayed on the *resolve* side — ``resolve_threshold``, which for a ``>``
+  rule sits at or below the firing threshold — continuously for
+  ``resolve_for_s``. A gauge oscillating between the two thresholds keeps
+  the alert FIRING (one page, not a page storm).
+
+:class:`AlertEngine` evaluates the rules (``evaluate()`` directly, or on a
+cadence thread via ``start()``), and on every transition:
+
+- emits ``alert_firing``/``alert_resolved`` events into the EventLog,
+  trace-linked: when the rule's base metric is a histogram carrying r15
+  exemplars, the firing event lists the exemplar trace ids (``"p99 is
+  burning" → the assembled traces that burned it``);
+- exports ``alert_state{rule=}`` gauges (1 = firing) plus fired/resolved
+  counters;
+- serves as a ``healthz()`` source: a firing ``page``-severity alert
+  degrades ``/healthz`` through the same aggregation as a stalled
+  heartbeat, an open breaker, or a burning SLO (``warn`` alerts ride the
+  detail body only).
+
+Metric-name literals in ``AlertRule(metric=...)`` are statically resolved
+against the registry's known instrument names by pitlint's PIT-METRIC rule —
+a typo'd rule fails lint instead of silently never firing. Rules loaded at
+runtime (``load_rules``) get the dynamic complement: the engine's health
+detail reports rules whose metric has never matched a series.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from perceiver_io_tpu.obs import health as _health
+from perceiver_io_tpu.obs import tracing as _tracing
+from perceiver_io_tpu.obs.registry import MetricsRegistry, get_registry
+from perceiver_io_tpu.obs.timeseries import SeriesStore, split_series_key
+
+__all__ = ["AlertEngine", "AlertRule", "load_rules"]
+
+KINDS = ("threshold", "rate", "absence")
+OPS = (">", ">=", "<", "<=")
+SEVERITIES = ("page", "warn")
+AGGS = ("last", "mean", "max", "min")
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertRule:
+    """One declarative alert.
+
+    ``metric`` is a series key (``series_key()`` form). A bare instrument
+    name (no ``{label}`` suffix) matches EVERY label set of that instrument
+    — one rule alerts per replica / per engine, each labeled series with
+    its own independent fire/resolve state.
+
+    Kinds: ``threshold`` compares the ``agg`` of the last ``window_s`` of
+    samples against ``threshold`` with ``op``; ``rate`` compares the
+    per-second rate of change over the window (counter-reset-aware);
+    ``absence`` breaches when the series has no sample within ``window_s``
+    (threshold/op ignored). A threshold/rate rule with NO in-window data
+    does not breach — silence is absence's job, not a phantom breach.
+    """
+
+    name: str
+    metric: str
+    kind: str = "threshold"
+    op: str = ">"
+    threshold: float = 0.0
+    window_s: float = 30.0
+    agg: str = "last"
+    for_s: float = 0.0
+    resolve_threshold: Optional[float] = None
+    resolve_for_s: Optional[float] = None
+    severity: str = "page"
+    description: str = ""
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("alert rule needs a name")
+        if not self.metric:
+            raise ValueError(f"rule {self.name!r}: metric is required")
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"rule {self.name!r}: kind {self.kind!r} not in {KINDS}")
+        if self.op not in OPS:
+            raise ValueError(
+                f"rule {self.name!r}: op {self.op!r} not in {OPS}")
+        if self.agg not in AGGS:
+            raise ValueError(
+                f"rule {self.name!r}: agg {self.agg!r} not in {AGGS}")
+        if self.severity not in SEVERITIES:
+            raise ValueError(
+                f"rule {self.name!r}: severity {self.severity!r} "
+                f"not in {SEVERITIES}")
+        if self.window_s <= 0:
+            raise ValueError(f"rule {self.name!r}: window_s must be positive")
+        if self.for_s < 0 or (self.resolve_for_s is not None
+                              and self.resolve_for_s < 0):
+            raise ValueError(f"rule {self.name!r}: hold-downs must be >= 0")
+        if self.resolve_threshold is not None:
+            # hysteresis must open AGAINST the firing direction, or the
+            # resolve condition would be stricter than not-firing and the
+            # alert could resolve while still past the firing threshold
+            widens = (self.resolve_threshold <= self.threshold
+                      if self.op in (">", ">=")
+                      else self.resolve_threshold >= self.threshold)
+            if not widens:
+                raise ValueError(
+                    f"rule {self.name!r}: resolve_threshold "
+                    f"{self.resolve_threshold} must sit on the resolved side "
+                    f"of threshold {self.threshold} for op {self.op!r}")
+
+    @property
+    def effective_resolve_threshold(self) -> float:
+        return (self.threshold if self.resolve_threshold is None
+                else self.resolve_threshold)
+
+    @property
+    def effective_resolve_for_s(self) -> float:
+        return self.for_s if self.resolve_for_s is None else self.resolve_for_s
+
+
+def _cmp(value: float, op: str, bound: float) -> bool:
+    if op == ">":
+        return value > bound
+    if op == ">=":
+        return value >= bound
+    if op == "<":
+        return value < bound
+    return value <= bound
+
+
+def load_rules(path: str) -> List[AlertRule]:
+    """Rules from a JSON file: a list of rule objects, or ``{"rules":
+    [...]}``. Unknown fields are rejected loudly — a misspelled
+    ``for_s`` must not silently become a no-hold-down rule."""
+    with open(path) as f:
+        body = json.load(f)
+    if isinstance(body, dict):
+        if "rules" not in body:
+            raise ValueError(
+                f"{path}: dict form needs a 'rules' key (found "
+                f"{sorted(body)}) — a top-level typo must not silently "
+                f"disable all alerting")
+        body = body["rules"]
+    if not isinstance(body, list):
+        raise ValueError(f"{path}: expected a list of rules")
+    if not body:
+        raise ValueError(f"{path}: zero rules — an explicitly-passed "
+                         f"rules file with nothing in it is a mistake")
+    fields = {f.name for f in dataclasses.fields(AlertRule)}
+    rules = []
+    for i, entry in enumerate(body):
+        unknown = set(entry) - fields
+        if unknown:
+            raise ValueError(
+                f"{path}: rule #{i} has unknown fields {sorted(unknown)}")
+        rules.append(AlertRule(**entry))
+    names = [r.name for r in rules]
+    if len(set(names)) != len(names):
+        raise ValueError(f"{path}: duplicate rule names")
+    return rules
+
+
+class _InstanceState:
+    __slots__ = ("firing", "bad_since", "ok_since", "value", "fired_at")
+
+    def __init__(self):
+        self.firing = False
+        self.bad_since: Optional[float] = None
+        self.ok_since: Optional[float] = None
+        self.value: Optional[float] = None
+        self.fired_at: Optional[float] = None
+
+
+class AlertEngine:
+    """Evaluates :class:`AlertRule`\\ s against one :class:`SeriesStore`.
+
+    Call ``evaluate()`` per tick (or ``start()`` a cadence thread); each
+    call returns the transitions it produced (``[{"rule", "metric",
+    "action": "firing"|"resolved", "value"}]``). State is per (rule,
+    matched series key), so one bare-name rule pages per replica.
+    """
+
+    # pitlint PIT-LOCK: instance states are written by the evaluation tick
+    # and read by health probes / stats from other threads
+    _guarded_by = {"_states": "_lock", "_never_matched": "_lock"}
+
+    def __init__(self, store: SeriesStore, rules: Sequence[AlertRule],
+                 registry: Optional[MetricsRegistry] = None,
+                 interval_s: float = 1.0, name: str = "alerts"):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be positive, got {interval_s}")
+        names = [r.name for r in rules]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate alert rule names in {names}")
+        self.store = store
+        self.rules = list(rules)
+        self.name = name
+        self.interval_s = interval_s
+        self.registry = registry if registry is not None else get_registry()
+        self._lock = threading.Lock()
+        # evaluate() is one logical tick: the cadence thread and explicit
+        # final-tick callers (serve drain, load_bench teardown) must not
+        # interleave inside a state machine or transitions double-emit
+        self._eval_lock = threading.Lock()
+        self._states: Dict[Tuple[str, str], _InstanceState] = {}
+        self._never_matched: Dict[str, bool] = {r.name: True for r in rules}
+        self._start_mono = time.monotonic()
+        self._m_state = {
+            r.name: self.registry.gauge(
+                "alert_state", "1 = rule firing (any matched series)",
+                {"rule": r.name})
+            for r in self.rules
+        }
+        self._m_fired = {
+            r.name: self.registry.counter(
+                "alerts_fired_total", "rule transitions into firing",
+                {"rule": r.name})
+            for r in self.rules
+        }
+        self._m_resolved = {
+            r.name: self.registry.counter(
+                "alerts_resolved_total", "rule transitions out of firing",
+                {"rule": r.name})
+            for r in self.rules
+        }
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._registered = True
+        _health.register_health_source(self)
+
+    # -- evaluation ----------------------------------------------------------
+
+    def _instances(self, rule: AlertRule, now: float) -> List[str]:
+        keys = self.store.match(rule.metric)
+        if keys:
+            with self._lock:
+                self._never_matched[rule.name] = False
+        elif rule.kind == "absence":
+            # an absence rule's series may have NEVER arrived — that is
+            # itself the alert, once the engine has watched a full window
+            if now - self._start_mono >= rule.window_s:
+                keys = [rule.metric]
+        return keys
+
+    def _signal(self, rule: AlertRule, key: str,
+                now: float) -> Tuple[Optional[float], Optional[bool], bool]:
+        """``(value, breached, resolvable)`` for one instance; breached None
+        = no data (state holds). ``resolvable`` carries the hysteresis-side
+        verdict for a currently-firing instance."""
+        if rule.kind == "absence":
+            age = self.store.age_s(key, now=now)
+            value = age if age is not None else float("inf")
+            breached = value > rule.window_s
+            return value, breached, not breached
+        if rule.kind == "rate":
+            value = self.store.rate(key, rule.window_s, now=now)
+        else:
+            value = self.store.window_agg(key, rule.window_s, rule.agg,
+                                          now=now)
+        if value is None:
+            return None, None, False
+        breached = _cmp(value, rule.op, rule.threshold)
+        resolvable = not _cmp(value, rule.op,
+                              rule.effective_resolve_threshold)
+        return value, breached, resolvable
+
+    def evaluate(self, now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """One evaluation tick over every rule × matched series; returns
+        the transitions. ``now`` (monotonic) is injectable for tests.
+        Serialized: a caller's explicit tick and the cadence thread never
+        interleave inside a state machine."""
+        with self._eval_lock:
+            return self._evaluate_locked(
+                time.monotonic() if now is None else now)
+
+    def _evaluate_locked(self, now: float) -> List[Dict[str, Any]]:
+        transitions: List[Dict[str, Any]] = []
+        for rule in self.rules:
+            any_firing = False
+            keys = self._instances(rule, now)
+            # a PHANTOM absence instance (keyed by the rule's bare metric,
+            # minted while NOTHING matched) must resolve once real labeled
+            # series arrive — match() will never return it again, so
+            # without this sweep it would page forever
+            if rule.kind == "absence" and keys and rule.metric not in keys:
+                with self._lock:
+                    st = self._states.get((rule.name, rule.metric))
+                if st is not None and st.firing:
+                    st.firing = False
+                    st.bad_since = None
+                    st.fired_at = None
+                    self._m_resolved[rule.name].inc()
+                    transitions.append(self._transition(
+                        rule, rule.metric, "resolved", None))
+            for key in keys:
+                with self._lock:
+                    st = self._states.get((rule.name, key))
+                    if st is None:
+                        st = self._states[(rule.name, key)] = _InstanceState()
+                value, breached, resolvable = self._signal(rule, key, now)
+                st.value = value
+                if breached is None:
+                    any_firing = any_firing or st.firing
+                    continue
+                if not st.firing:
+                    if breached:
+                        if st.bad_since is None:
+                            st.bad_since = now
+                        if now - st.bad_since >= rule.for_s:
+                            st.firing = True
+                            st.fired_at = now
+                            st.ok_since = None
+                            self._m_fired[rule.name].inc()
+                            transitions.append(
+                                self._transition(rule, key, "firing", value))
+                    else:
+                        st.bad_since = None
+                else:
+                    if resolvable:
+                        if st.ok_since is None:
+                            st.ok_since = now
+                        if (now - st.ok_since
+                                >= rule.effective_resolve_for_s):
+                            st.firing = False
+                            st.bad_since = None
+                            st.fired_at = None
+                            self._m_resolved[rule.name].inc()
+                            transitions.append(self._transition(
+                                rule, key, "resolved", value))
+                    else:
+                        st.ok_since = None
+                any_firing = any_firing or st.firing
+            self._m_state[rule.name].set(1.0 if any_firing else 0.0)
+        return transitions
+
+    def _transition(self, rule: AlertRule, key: str, action: str,
+                    value: Optional[float]) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {
+            "rule": rule.name, "metric": key, "action": action,
+            "value": None if value is None else round(float(value), 6),
+            "threshold": (rule.threshold if action == "firing"
+                          else rule.effective_resolve_threshold),
+            "severity": rule.severity,
+        }
+        if action == "firing":
+            exemplars = self._exemplar_traces(key)
+            if exemplars:
+                rec["trace_exemplars"] = exemplars
+        _tracing.event(f"alert_{action}", engine=self.name,
+                       **{k: v for k, v in rec.items() if k != "action"})
+        return rec
+
+    def _exemplar_traces(self, key: str) -> List[str]:
+        """Trace ids from the underlying histogram's exemplar ring, when the
+        alerted metric derives from one — the firing event links straight
+        to the assembled traces that breached it."""
+        name, label_suffix, field = split_series_key(key)
+        if not field or field == "count":
+            return []
+        from perceiver_io_tpu.obs.registry import Histogram
+
+        inst = self.registry.instruments_by_key().get(name + label_suffix)
+        if not isinstance(inst, Histogram):
+            return []
+        return [e["trace"] for e in inst.exemplars()[:4]]
+
+    # -- introspection -------------------------------------------------------
+
+    def firing(self) -> Dict[str, List[str]]:
+        """``{rule_name: [series keys currently firing]}``."""
+        with self._lock:
+            out: Dict[str, List[str]] = {}
+            for (rule, key), st in self._states.items():
+                if st.firing:
+                    out.setdefault(rule, []).append(key)
+        return {r: sorted(ks) for r, ks in sorted(out.items())}
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "rules": len(self.rules),
+            "fired": int(sum(c.value for c in self._m_fired.values())),
+            "resolved": int(
+                sum(c.value for c in self._m_resolved.values())),
+            "firing": self.firing(),
+        }
+
+    # -- healthz() source ----------------------------------------------------
+
+    def health_status(self) -> Tuple[str, bool, Dict[str, Any]]:
+        firing = self.firing()
+        by_sev = {r.name: r.severity for r in self.rules}
+        paging = sorted(r for r in firing if by_sev.get(r) == "page")
+        with self._lock:
+            never = sorted(r for r, nm in self._never_matched.items() if nm)
+        detail: Dict[str, Any] = {
+            "rules": len(self.rules),
+            "firing": firing,
+            "paging": paging,
+        }
+        if never:
+            # a rule whose metric never matched any series is not wrong by
+            # itself (the instrument may not have produced yet) but is the
+            # runtime shadow of what PIT-METRIC checks statically — surface it
+            detail["never_matched"] = never
+        return f"alerts:{self.name}", not paging, detail
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "AlertEngine":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name=f"{self.name}-alerts", daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate()
+            except Exception:
+                pass  # telemetry must never kill its own thread
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._registered:
+            _health.unregister_health_source(self)
+            self._registered = False
+
+    def __enter__(self) -> "AlertEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

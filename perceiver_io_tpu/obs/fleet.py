@@ -167,6 +167,15 @@ class ReplicaGauges:
                 "slo_burn": self._reg.gauge(
                     "fleet_replica_slo_burn",
                     "max scraped SLO error-budget burn rate", labels),
+                "requests_total": self._reg.gauge(
+                    "fleet_replica_requests_total",
+                    "scraped replica lifetime request count (gauge: the "
+                    "router republishes the replica's counter)", labels),
+                "scrape_age_s": self._reg.gauge(
+                    "fleet_scrape_age_s",
+                    "seconds since this replica's last completed scrape — "
+                    "staleness beyond N intervals degrades the slot for "
+                    "placement", labels),
             }
             self._per[replica] = g
         return g

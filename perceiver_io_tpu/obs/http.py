@@ -1,10 +1,14 @@
-"""Localhost HTTP sidecar: ``/metrics`` (Prometheus), ``/healthz``, ``/statz``.
+"""Localhost HTTP sidecar: ``/metrics`` (Prometheus), ``/healthz``,
+``/statz``, ``/seriesz``.
 
 A daemon thread running a ``ThreadingHTTPServer`` bound to loopback — the
 serving process's observability surface. ``/metrics`` is the registry's text
 exposition; ``/healthz`` aggregates the live heartbeats (200 when every
 dispatch loop is beating, 503 with detail when one stalled); ``/statz`` is
-the JSON snapshot (registry + health) for humans and scripts.
+the JSON snapshot (registry + health) for humans and scripts; ``/seriesz``
+is the windowed time-series view over the installed
+:class:`~perceiver_io_tpu.obs.timeseries.SeriesStore` (``?window_s=60``
+bounds the returned points; 404 until a store is installed).
 
 Multi-host: ``start()`` is a no-op off process 0 (``is_export_process``) —
 one exporter per job, the same policy as ``MetricsLogger``.
@@ -16,8 +20,10 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs
 
 from perceiver_io_tpu.obs import health as _health
+from perceiver_io_tpu.obs import timeseries as _timeseries
 from perceiver_io_tpu.obs.registry import (
     MetricsRegistry,
     get_registry,
@@ -35,8 +41,12 @@ class ObsServer:
         registry: Optional[MetricsRegistry] = None,
         host: str = "127.0.0.1",
         port: int = 0,
+        series_store=None,
     ):
         self._registry = registry or get_registry()
+        # explicit store wins; otherwise /seriesz follows the process
+        # default (installed by the serve CLI / tools when sampling is on)
+        self._series_store = series_store
         self._host = host
         self._port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -60,6 +70,7 @@ class ObsServer:
         if not is_export_process():
             return None
         registry = self._registry
+        explicit_store = self._series_store
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args) -> None:
@@ -85,6 +96,32 @@ class ObsServer:
                 elif path == "/statz":
                     ok, detail = _health.healthz()
                     body = {"health": detail, **registry.snapshot()}
+                    self._reply(200, json.dumps(body).encode() + b"\n",
+                                "application/json")
+                elif path == "/seriesz":
+                    store = (explicit_store
+                             if explicit_store is not None
+                             else _timeseries.get_series_store())
+                    if store is None:
+                        self._reply(
+                            404,
+                            b"no series store installed (enable sampling: "
+                            b"serve --series / install_series_store)\n",
+                            "text/plain")
+                        return
+                    qs = parse_qs(self.path.partition("?")[2])
+                    window = None
+                    try:
+                        if qs.get("window_s"):
+                            window = float(qs["window_s"][0])
+                    except ValueError:
+                        pass  # malformed window: serve the full rings
+                    # ?points=0 returns summaries only (kind/n/last) — a
+                    # mature store's full rings are a multi-MB body
+                    want_points = qs.get("points", ["1"])[0] not in ("0",
+                                                                    "false")
+                    body = store.snapshot(window_s=window,
+                                          points=want_points)
                     self._reply(200, json.dumps(body).encode() + b"\n",
                                 "application/json")
                 else:

@@ -274,6 +274,15 @@ class MetricsRegistry:
         with self._lock:
             return [self._instruments[k] for k in sorted(self._instruments)]
 
+    def instruments_by_key(self) -> Dict[str, _Instrument]:
+        """Every instrument keyed by its ``name{labels}`` exposition key —
+        how the time-series/alerting layer resolves a series key back to
+        the live instrument (e.g. to read a histogram's exemplars)."""
+        return {
+            inst.name + _label_suffix(inst.labels): inst
+            for inst in self._sorted_instruments()
+        }
+
     # -- exporters -----------------------------------------------------------
 
     def snapshot(self) -> Dict[str, Any]:

@@ -1,0 +1,461 @@
+"""Windowed metrics time-series: the historical half of observability.
+
+Every exporter so far (``/metrics``, ``/statz``) answers "what is the value
+NOW"; nothing answers "what has it been doing for the last two minutes" —
+yet that is the question autoscaling policies, rollout bakes, and alert
+rules actually ask. This module adds it without unbounding memory:
+
+- :class:`SeriesStore` — a bounded ring-buffer store of samples per series
+  key (``name{labels}`` exactly as the registry's ``snapshot()`` keys them,
+  plus a ``:p50``/``:p99``/``:count`` field suffix for histogram-derived
+  series). Fixed memory by construction: ``max_samples`` per series,
+  ``max_series`` keys total (overflow counted, never grown). Queries are
+  windowed: ``last``/``points``/``window_agg`` for gauges, counter-reset-
+  aware ``delta``/``rate`` for counters, ``age_s`` for absence detection.
+- :class:`Sampler` — snapshots every registry instrument at a configurable
+  cadence through the registry's collector hook (``snapshot()`` runs
+  collectors first, so sampled values — RSS, eventlog queue depth — are
+  fresh): counters as cumulative values (the store derives deltas/rates),
+  gauges as values, histograms as their windowed p50/p95/p99 + count.
+  Optionally persists one ``series_sample`` JSONL record per sweep through
+  a dedicated :class:`~perceiver_io_tpu.obs.tracing.EventLog` (size-capped
+  rotation, async writer, drop-not-block — the same bounded-telemetry
+  contract as the event log it sits alongside).
+- **fleet ingestion** (:meth:`SeriesStore.ingest_scrape`) — the Router's
+  scrape loop feeds per-replica scrape bodies into one fleet store under
+  ``replica=`` labels, so rollout bakes and placement judge against a
+  *history* instead of a point read.
+
+Series keys are built with :func:`series_key`; pitlint's PIT-METRIC rule
+statically resolves its (and ``AlertRule``'s) metric-name literals against
+the registry's known instrument names, so a typo'd key fails lint instead
+of silently never matching.
+
+Dual clock stamps per sample (PIT-CLOCK): ``t`` (wall — display, JSONL
+correlation) and ``mono`` (monotonic — the only clock windows are computed
+from). Importable before jax initializes a backend, like the rest of
+``obs``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from perceiver_io_tpu.obs.registry import (
+    MetricsRegistry,
+    _label_suffix,
+    get_registry,
+    sanitize_metric_name,
+)
+
+__all__ = [
+    "Sampler",
+    "SeriesStore",
+    "get_series_store",
+    "install_series_store",
+    "series_key",
+    "split_series_key",
+]
+
+# histogram-derived per-series fields (the ``:FIELD`` key suffix); count is
+# counter-kind (rate-able), the percentiles are gauge-kind
+HISTOGRAM_FIELDS = ("p50", "p95", "p99", "count")
+
+# a sample rate/delta needs two points at least this far apart to divide by
+_MIN_SPAN_S = 1e-6
+
+
+def series_key(name: str, labels: Optional[Dict[str, str]] = None,
+               field: Optional[str] = None) -> str:
+    """The canonical series key for one instrument (+ optional histogram
+    field): ``name{k="v",...}:field`` — byte-identical to the registry
+    ``snapshot()`` key so sampled series and hand-built queries meet.
+
+    The ``name`` literal at call sites is statically checked against the
+    registry's known instrument names (pitlint PIT-METRIC)."""
+    key = sanitize_metric_name(name) + _label_suffix(
+        tuple(sorted((str(k), str(v)) for k, v in (labels or {}).items())))
+    return f"{key}:{field}" if field else key
+
+
+def split_series_key(key: str) -> Tuple[str, str, str]:
+    """``(base_name, label_suffix, field)`` — the inverse of
+    :func:`series_key` (field may be empty)."""
+    field = ""
+    base = key
+    if ":" in key.rsplit("}", 1)[-1]:
+        base, field = key.rsplit(":", 1)
+        if field not in HISTOGRAM_FIELDS:
+            base, field = key, ""
+    name, sep, rest = base.partition("{")
+    return name, (sep + rest if sep else ""), field
+
+
+class _Series:
+    __slots__ = ("kind", "points")
+
+    def __init__(self, kind: str, max_samples: int):
+        self.kind = kind
+        # (t_wall, mono, value) rings; maxlen bounds memory per series
+        self.points: deque = deque(maxlen=max_samples)
+
+
+class SeriesStore:
+    """Bounded in-memory time-series over ``(key -> ring of samples)``.
+
+    Thread-safe; writers (``record``/``ingest_scrape``) and readers (the
+    query surface, ``/seriesz``) may race freely. Memory is fixed by
+    construction — ``max_samples`` per series, ``max_series`` series; a
+    sample for a key past the cap is DROPPED (counted on
+    :attr:`dropped_series`), never grown into."""
+
+    # pitlint PIT-LOCK: the series table is hit from the sampler thread,
+    # the router scrape loop, and every query — only under _lock
+    _guarded_by = {"_series": "_lock"}
+
+    def __init__(self, max_samples: int = 512, max_series: int = 4096):
+        if max_samples < 2:
+            raise ValueError(f"max_samples must be >= 2, got {max_samples}")
+        if max_series < 1:
+            raise ValueError(f"max_series must be >= 1, got {max_series}")
+        self.max_samples = max_samples
+        self.max_series = max_series
+        self.dropped_series = 0  # keys refused at the max_series cap
+        self._lock = threading.Lock()
+        self._series: Dict[str, _Series] = {}
+
+    # -- writing -------------------------------------------------------------
+
+    def record(self, key: str, value: float, kind: str = "gauge",
+               t: Optional[float] = None,
+               mono: Optional[float] = None) -> bool:
+        """Append one sample; returns False when the key was refused at the
+        ``max_series`` cap. Explicit ``t``/``mono`` stamps are for tests and
+        replayed ingestion — live producers omit them."""
+        t = time.time() if t is None else t
+        mono = time.monotonic() if mono is None else mono
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                if len(self._series) >= self.max_series:
+                    self.dropped_series += 1
+                    return False
+                s = self._series[key] = _Series(kind, self.max_samples)
+            s.points.append((t, mono, float(value)))
+        return True
+
+    # the scrape fields the fleet history keeps, and the instrument name
+    # each lands under (the same names ReplicaGauges publishes, so the
+    # sampled router registry and the directly-ingested store agree)
+    _SCRAPE_FIELDS = (
+        ("up", "fleet_replica_up", "gauge"),
+        ("ready", "fleet_replica_ready", "gauge"),
+        ("queue_depth", "fleet_replica_queue_depth", "gauge"),
+        ("inflight", "fleet_replica_inflight", "gauge"),
+        ("breaker_open", "fleet_replica_breaker_open", "gauge"),
+        ("slo_burn", "fleet_replica_slo_burn", "gauge"),
+        ("requests_total", "fleet_replica_requests_total", "counter"),
+    )
+
+    def ingest_scrape(self, fleet: str, replica: str,
+                      scrape: Dict[str, Any],
+                      scrape_age_s: Optional[float] = None) -> None:
+        """One replica scrape body → per-replica labeled series (the fleet
+        aggregation hook the router's scrape loop calls)."""
+        labels = {"fleet": fleet, "replica": replica}
+        for field, name, kind in self._SCRAPE_FIELDS:
+            v = scrape.get(field)
+            if v is None and field != "up":
+                continue
+            self.record(series_key(name, labels),
+                        float(bool(v)) if isinstance(v, bool) or v is None
+                        else float(v), kind)
+        if scrape_age_s is not None:
+            self.record(series_key("fleet_scrape_age_s", labels),
+                        float(scrape_age_s), "gauge")
+
+    # -- reading -------------------------------------------------------------
+
+    def keys(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def n_series(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+    def kind(self, key: str) -> Optional[str]:
+        with self._lock:
+            s = self._series.get(key)
+            return s.kind if s is not None else None
+
+    def match(self, metric: str) -> List[str]:
+        """Keys a rule's ``metric`` selects: an exact key (or anything
+        carrying a ``{`` label suffix) matches itself; a bare
+        ``name``/``name:field`` matches every label set of that
+        instrument."""
+        with self._lock:
+            if "{" in metric or metric in self._series:
+                return [metric] if metric in self._series else []
+            want_name, _, want_field = split_series_key(metric)
+            out = []
+            for key in self._series:
+                name, _, field = split_series_key(key)
+                if name == want_name and field == want_field:
+                    out.append(key)
+            return sorted(out)
+
+    def points(self, key: str, window_s: Optional[float] = None,
+               now: Optional[float] = None) -> List[Tuple[float, float]]:
+        """``(t_wall, value)`` samples within the window (all when None)."""
+        with self._lock:
+            s = self._series.get(key)
+            pts = list(s.points) if s is not None else []
+        if window_s is not None:
+            now = time.monotonic() if now is None else now
+            cutoff = now - window_s
+            pts = [p for p in pts if p[1] >= cutoff]
+        return [(t, v) for t, _, v in pts]
+
+    def last(self, key: str, window_s: Optional[float] = None,
+             now: Optional[float] = None) -> Optional[float]:
+        pts = self.points(key, window_s, now)
+        return pts[-1][1] if pts else None
+
+    def age_s(self, key: str, now: Optional[float] = None) -> Optional[float]:
+        """Seconds since the newest sample (None = never seen) — the
+        absence-detection primitive."""
+        with self._lock:
+            s = self._series.get(key)
+            if s is None or not s.points:
+                return None
+            last_mono = s.points[-1][1]
+        return (time.monotonic() if now is None else now) - last_mono
+
+    def _window(self, key: str, window_s: float,
+                now: Optional[float]) -> List[Tuple[float, float, float]]:
+        now = time.monotonic() if now is None else now
+        cutoff = now - window_s
+        with self._lock:
+            s = self._series.get(key)
+            pts = list(s.points) if s is not None else []
+        return [p for p in pts if p[1] >= cutoff]
+
+    @staticmethod
+    def _delta_of(pts, kind: str) -> float:
+        """Change over one in-window point list: reset-aware increment sum
+        for counters (a restarted process re-publishing from zero starts a
+        new segment instead of going negative), last − first for gauges."""
+        if kind == "gauge":
+            return pts[-1][2] - pts[0][2]
+        total = 0.0
+        for (_, _, a), (_, _, b) in zip(pts, pts[1:]):
+            if b >= a:
+                total += b - a
+        return total
+
+    def delta(self, key: str, window_s: float,
+              now: Optional[float] = None) -> Optional[float]:
+        """Counter increase (gauge change) over the window; None below two
+        in-window samples."""
+        pts = self._window(key, window_s, now)
+        if len(pts) < 2:
+            return None
+        return self._delta_of(pts, self.kind(key))
+
+    def rate(self, key: str, window_s: float,
+             now: Optional[float] = None) -> Optional[float]:
+        """Per-second rate of change over the window (delta / observed
+        span), computed from ONE ring read — a concurrent append between
+        two reads would pair a delta with a mismatched span. None below
+        two in-window samples."""
+        pts = self._window(key, window_s, now)
+        if len(pts) < 2:
+            return None
+        span = pts[-1][1] - pts[0][1]
+        if span < _MIN_SPAN_S:
+            return None
+        return self._delta_of(pts, self.kind(key)) / span
+
+    def window_agg(self, key: str, window_s: float, agg: str = "last",
+                   now: Optional[float] = None) -> Optional[float]:
+        """``last``/``mean``/``max``/``min`` over the in-window samples
+        (None when the window is empty)."""
+        pts = self._window(key, window_s, now)
+        if not pts:
+            return None
+        vals = [v for _, _, v in pts]
+        if agg == "last":
+            return vals[-1]
+        if agg == "mean":
+            return sum(vals) / len(vals)
+        if agg == "max":
+            return max(vals)
+        if agg == "min":
+            return min(vals)
+        raise ValueError(f"unknown agg {agg!r} (last|mean|max|min)")
+
+    def snapshot(self, window_s: Optional[float] = None,
+                 points: bool = True) -> Dict[str, Any]:
+        """JSON-able view (the ``/seriesz`` body): per key its kind, sample
+        count, latest value, and — with ``points`` — the windowed
+        ``[t_wall, value]`` pairs.
+
+        The lock is taken per ring, never across the whole table: a full
+        snapshot of a mature store (thousands of rings) must not stall the
+        scrape loop and the sampler tick behind one observability read."""
+        cutoff = None
+        if window_s is not None:
+            cutoff = time.monotonic() - window_s
+        series: Dict[str, Any] = {}
+        keys = self.keys()
+        for key in keys:
+            with self._lock:
+                s = self._series.get(key)
+                if s is None:
+                    continue  # removed between the key list and now
+                kind, pts = s.kind, list(s.points)
+            if cutoff is not None:
+                pts = [p for p in pts if p[1] >= cutoff]
+            entry: Dict[str, Any] = {
+                "kind": kind, "n": len(pts),
+                "last": pts[-1][2] if pts else None,
+            }
+            if points:
+                entry["points"] = [[round(t, 3), v] for t, _, v in pts]
+            series[key] = entry
+        return {
+            "series": series,
+            "series_total": len(keys),
+            "dropped_series": self.dropped_series,
+            "window_s": window_s,
+        }
+
+
+class Sampler:
+    """Cadenced registry → :class:`SeriesStore` snapshotter with optional
+    rotating-JSONL persistence.
+
+    ``sample_once()`` is the deterministic unit tests and tools drive
+    directly; ``start()`` runs it on a daemon thread every ``interval_s``.
+    One registry ``snapshot()`` per tick (collectors run — sampled values
+    are fresh), every instrument recorded: counters cumulative (query with
+    ``rate``/``delta``), gauges as-is, histograms as ``:p50``/``:p95``/
+    ``:p99`` gauges + a ``:count`` counter over the instrument's bounded
+    observation window as of the tick."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 store: Optional[SeriesStore] = None,
+                 interval_s: float = 1.0,
+                 jsonl_path: Optional[str] = None,
+                 jsonl_max_bytes: Optional[int] = 16 * 1024 * 1024,
+                 jsonl_backups: int = 3,
+                 name: str = "sampler"):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be positive, got {interval_s}")
+        self.registry = registry if registry is not None else get_registry()
+        self.store = store if store is not None else SeriesStore()
+        self.interval_s = interval_s
+        self.name = name
+        self._log = None
+        if jsonl_path:
+            from perceiver_io_tpu.obs.tracing import EventLog
+
+            # the log's drop/queue instruments land in THIS registry, so
+            # the sampler's own sweeps see its persistence losses
+            self._log = EventLog(jsonl_path, max_bytes=jsonl_max_bytes,
+                                 backups=jsonl_backups,
+                                 registry=self.registry)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # self-observability (and the PIT-METRIC known-name registrations)
+        self._m_sweeps = self.registry.counter(
+            "series_sweeps_total", "sampler sweeps performed",
+            {"sampler": name})
+        self._m_series = self.registry.gauge(
+            "series_count", "distinct series keys in the store",
+            {"sampler": name})
+
+    def sample_once(self) -> int:
+        """One sweep over every registry instrument; returns the number of
+        series keys written."""
+        snap = self.registry.snapshot()
+        flat: Dict[str, float] = {}
+        for key, v in snap["counters"].items():
+            flat[key] = float(v)
+            self.store.record(key, v, "counter")
+        for key, v in snap["gauges"].items():
+            flat[key] = float(v)
+            self.store.record(key, v, "gauge")
+        for key, entry in snap["histograms"].items():
+            for field in HISTOGRAM_FIELDS:
+                v = entry.get(field)
+                if v is None:
+                    continue
+                fkey = f"{key}:{field}"
+                flat[fkey] = float(v)
+                self.store.record(
+                    fkey, v, "counter" if field == "count" else "gauge")
+        self._m_sweeps.inc()
+        self._m_series.set(self.store.n_series())
+        if self._log is not None:
+            self._log.write(
+                {"event": "series_sample", "sampler": self.name,
+                 "n": len(flat), "series": flat})
+        return len(flat)
+
+    @property
+    def sweeps(self) -> int:
+        return int(self._m_sweeps.value)
+
+    def start(self) -> "Sampler":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name=f"{self.name}-series", daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sample_once()
+            except Exception:
+                pass  # telemetry must never kill its own thread
+
+    def close(self) -> None:
+        """Stop the cadence thread and drain the JSONL sink (every sample
+        accepted before close lands on disk)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._log is not None:
+            self._log.close()
+
+    def __enter__(self) -> "Sampler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# -- the process-default store (what /seriesz serves) -------------------------
+
+_DEFAULT_STORE: Optional[SeriesStore] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def install_series_store(store: Optional[SeriesStore]) -> Optional[SeriesStore]:
+    """Install (or with None remove) the process-default series store —
+    the one ``ObsServer``'s ``/seriesz`` endpoint serves."""
+    global _DEFAULT_STORE
+    with _DEFAULT_LOCK:
+        _DEFAULT_STORE = store
+        return store
+
+
+def get_series_store() -> Optional[SeriesStore]:
+    return _DEFAULT_STORE

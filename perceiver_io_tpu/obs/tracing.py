@@ -41,6 +41,7 @@ import json
 import os
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Any, Dict, Iterator, Optional
 
@@ -63,7 +64,8 @@ class EventLog:
 
     def __init__(self, path: str, max_bytes: Optional[int] = DEFAULT_MAX_BYTES,
                  backups: int = DEFAULT_BACKUPS,
-                 queue_depth: int = DEFAULT_QUEUE_DEPTH):
+                 queue_depth: int = DEFAULT_QUEUE_DEPTH,
+                 registry=None):
         if max_bytes is not None and max_bytes < 1:
             raise ValueError(f"max_bytes must be positive, got {max_bytes}")
         if backups < 0:
@@ -88,10 +90,51 @@ class EventLog:
         # (measured: the difference between ~10% and <2% tracing overhead)
         self._buf: deque = deque()
         self._writing = False  # a popped batch is in flight to disk
+        # record loss must be VISIBLE, not just counted on the object:
+        # eventlog_dropped_total / eventlog_queue_depth ride /metrics (and
+        # therefore the time-series + alerting layer) via a registry
+        # collector, refreshed at every scrape. The collector holds only a
+        # weakref and raises once the log is gone, which drops it from
+        # subsequent exports (the registry's documented removal path).
+        # ``registry`` lets an owner on a private registry (a Sampler's
+        # series log) keep its drop signal sampleable by that owner.
+        if registry is None:
+            from perceiver_io_tpu.obs.registry import get_registry
+
+            registry = get_registry()
+        reg = registry
+        labels = {"log": os.path.basename(path)}
+        self._m_dropped = reg.counter(
+            "eventlog_dropped_total",
+            "records the bounded writer queue (or a write failure) refused",
+            labels)
+        self._m_queue = reg.gauge(
+            "eventlog_queue_depth",
+            "records buffered for the async writer", labels)
+        self._dropped_synced = 0
+        ref = weakref.ref(self)
+
+        def _sync_collector():
+            log = ref()
+            if log is None or log._closed:
+                raise LookupError("event log gone — drop this collector")
+            log._sync_metrics()
+
+        reg.register_collector(_sync_collector)
         self._stop = threading.Event()
         self._writer = threading.Thread(
             target=self._drain_loop, name="event-log-writer", daemon=True)
         self._writer.start()
+
+    def _sync_metrics(self) -> None:
+        """Publish drop/queue state into the registry instruments (counter
+        semantics: only the delta since the last sync increments, so many
+        EventLog lifetimes sharing one instrument aggregate correctly)."""
+        d = self.dropped
+        if d > self._dropped_synced:
+            self._m_dropped.inc(d - self._dropped_synced)
+            self._dropped_synced = d
+        self._m_queue.set(len(self._buf))
 
     def write(self, record: Dict[str, Any]) -> None:
         """Buffer one record (~2 µs, no lock, no thread wakeup). Clock
@@ -267,6 +310,10 @@ class EventLog:
             if self._f is not None:
                 self._f.close()
                 self._f = None
+        # the collector stops reporting for a closed log — push the final
+        # drop tally and zero the queue gauge while we still can
+        self._sync_metrics()
+        self._m_queue.set(0)
 
 
 _LOG: Optional[EventLog] = None

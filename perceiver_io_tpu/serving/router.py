@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -109,6 +110,15 @@ class _Slot:
         self.draining = False      # router-side admission stop
         self.scrape: Dict[str, Any] = {"up": True, "ready": False}
         self.failures = 0          # consecutive call failures (suspicion)
+        # when the current scrape body was OBSERVED (any completed scrape,
+        # up or not, is a fresh observation): placement must know whether
+        # the gauges it steers by describe the replica now or N intervals
+        # ago — a wedged scrape loop otherwise keeps steering least-loaded
+        # dispatch by a snapshot of the past
+        self.last_scrape_mono = time.monotonic()
+
+    def scrape_age(self) -> float:
+        return time.monotonic() - self.last_scrape_mono
 
     def load(self) -> float:
         return self.inflight + float(self.scrape.get("queue_depth", 0) or 0)
@@ -141,12 +151,25 @@ class Router:
         min_serving: int = 1,
         request_timeout_s: float = 120.0,
         trace_sample: float = 1.0,
+        stale_after_intervals: Optional[float] = 8.0,
+        series_store: Optional[obs.SeriesStore] = None,
     ):
         self.name = name
         self.policy = policy if policy is not None else FailoverPolicy()
         self.queue_limit = queue_limit
         self.burn_degrade = burn_degrade
         self.request_timeout_s = request_timeout_s
+        # scrape-staleness bound: a slot whose view is older than this many
+        # scrape intervals is DEGRADED for placement (routed around while
+        # any fresh replica serves, last resort otherwise). None disables.
+        self._stale_after_s = (
+            None if stale_after_intervals is None
+            else max(stale_after_intervals * scrape_interval_s, 0.5))
+        # the fleet time-series: every scrape sweep lands per-replica
+        # labeled samples here, so rollout bakes and post-mortems judge a
+        # HISTORY instead of whatever the latest poll happened to catch
+        self.series = (series_store if series_store is not None
+                       else obs.SeriesStore(max_samples=512))
         # distributed tracing: submit() mints the root TraceContext at this
         # head-sampling rate (free while no event log is configured); the
         # context crosses the replica RPC as headers, and completed roots
@@ -182,6 +205,19 @@ class Router:
             "router_latency_seconds", "submit → result via the router",
             labels)
         self._gauges = _fleet.ReplicaGauges(fleet=name, registry=reg)
+        # fleet_scrape_age_s is computed at EXPORT time (registry collector,
+        # weakref so a closed router's collector drops itself): the wedged-
+        # scrape-loop condition the gauge exposes is exactly the condition
+        # that would stop a scrape-time write from ever reporting it
+        router_ref = weakref.ref(self)
+
+        def _scrape_age_collector():
+            router = router_ref()
+            if router is None or router._closed.is_set():
+                raise LookupError("router gone — drop this collector")
+            router._publish_scrape_ages()
+
+        reg.register_collector(_scrape_age_collector)
         self.fleet_health = _fleet.FleetHealth(
             self.statuses, name=name, min_serving=min_serving)
         self._pool = ThreadPoolExecutor(
@@ -241,6 +277,11 @@ class Router:
         serving = 0
         for slot in slots:
             slot.scrape = self._safe_scrape(slot.client)
+            # the inter-scrape gap this sweep closed — read BEFORE the
+            # stamp update, so the history shows the loop's real cadence
+            # (a recovered wedge leaves its spike in the series)
+            gap = slot.scrape_age()
+            slot.last_scrape_mono = time.monotonic()
             state = self._state(slot)
             if state == _fleet.SERVING:
                 serving += 1
@@ -253,8 +294,22 @@ class Router:
                 inflight=float(slot.inflight),
                 breaker_open=1.0 if s.get("breaker_open") else 0.0,
                 slo_burn=float(s.get("slo_burn", 0.0) or 0.0),
+                requests_total=(None if s.get("requests_total") is None
+                                else float(s["requests_total"])),
             )
+            # the fleet history: this sweep's observation, replica-labeled
+            self.series.ingest_scrape(self.name, slot.name, s,
+                                      scrape_age_s=gap)
         self._gauges.publish_fleet(size=len(slots), serving=serving)
+
+    def _publish_scrape_ages(self) -> None:
+        """Live per-slot scrape age into ``fleet_scrape_age_s`` — invoked
+        by the registry collector at every export, so a wedged scrape loop
+        shows its growing age instead of a frozen near-zero write."""
+        with self._lock:
+            slots = list(self._slots.values())
+        for slot in slots:
+            self._gauges.publish(slot.name, scrape_age_s=slot.scrape_age())
 
     def _scrape_loop(self) -> None:
         while not self._closed.wait(self._scrape_interval_s):
@@ -266,6 +321,12 @@ class Router:
             return _fleet.DOWN
         if slot.draining or s.get("draining"):
             return _fleet.DRAINING
+        if (self._stale_after_s is not None
+                and slot.scrape_age() > self._stale_after_s):
+            # the view is too old to steer by: a stale-but-up replica's
+            # frozen gauges would otherwise keep winning least-loaded
+            # placement long after its real queue grew
+            return _fleet.DEGRADED
         if not s.get("ready"):
             return _fleet.JOINING
         if s.get("breaker_open"):
@@ -289,6 +350,7 @@ class Router:
                 "slo_burn": s.get("slo_burn", 0.0),
                 "breaker_open": bool(s.get("breaker_open")),
                 "params_version": s.get("params_version", 0),
+                "scrape_age_s": round(slot.scrape_age(), 3),
             }
         return out
 
@@ -619,17 +681,35 @@ class Router:
         """Watch one freshly-swapped replica; returns a regression reason or
         None (healthy bake). With ``min_requests`` > 0 the window extends
         (up to 4x ``bake_s``) until the replica actually served that much
-        post-swap traffic — a bake with no traffic proves nothing."""
+        post-swap traffic — a bake with no traffic proves nothing.
+
+        Burn is judged against the fleet series HISTORY, not just this
+        poll: every bake poll (and the background scrape loop) lands in
+        ``self.series``, and the regression check takes the windowed MAX
+        since the swap — a burn spike between two bake polls still rolls
+        the fleet back instead of slipping through the gap."""
         t0 = time.monotonic()
         base = None
+        burn_key = obs.series_key(
+            "fleet_replica_slo_burn",
+            {"fleet": self.name, "replica": slot.name})
         while True:
             s = self._safe_scrape(slot.client)
             slot.scrape = s
+            slot.last_scrape_mono = time.monotonic()
+            self.series.ingest_scrape(self.name, slot.name, s)
             if not s.get("up"):
                 return "replica went down post-swap"
             if s.get("breaker_open"):
                 return "breaker opened post-swap"
             burn = float(s.get("slo_burn", 0.0) or 0.0)
+            # window anchored EXACTLY at the swap (never floored wider): a
+            # pre-swap burn sample — say the spike this rollout is fixing —
+            # must not roll a healthy swap back
+            hist = self.series.window_agg(
+                burn_key, window_s=max(time.monotonic() - t0, 0.0),
+                agg="max")
+            burn = max(burn, hist if hist is not None else 0.0)
             if burn > burn_threshold:
                 return (f"SLO burn {burn:.2f} exceeded threshold "
                         f"{burn_threshold:g} post-swap")

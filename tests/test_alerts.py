@@ -1,0 +1,429 @@
+"""Declarative alerting: rule validation, fire → hold-down → resolve
+hysteresis, absence/rate kinds, healthz degradation, EventLog + exemplar
+linkage, and the end-to-end SLO-burn drill over a real engine (ISSUE 12)."""
+
+import json
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import perceiver_io_tpu.obs as obs
+from perceiver_io_tpu.inference import ServingEngine
+from perceiver_io_tpu.resilience import RejectedError
+
+
+def _store_with(key, samples):
+    """A store pre-loaded with (mono, value) samples for one gauge key."""
+    s = obs.SeriesStore()
+    for mono, v in samples:
+        s.record(key, v, "gauge", t=1000.0 + mono, mono=mono)
+    return s
+
+
+# -- rule validation ----------------------------------------------------------
+
+
+def test_rule_validation_rejects_malformed_rules():
+    ok = obs.AlertRule(name="r", metric="m", threshold=2.0)
+    assert ok.effective_resolve_threshold == 2.0
+    assert ok.effective_resolve_for_s == 0.0
+    for bad in (
+        dict(name="", metric="m"),
+        dict(name="r", metric=""),
+        dict(name="r", metric="m", kind="nope"),
+        dict(name="r", metric="m", op="=="),
+        dict(name="r", metric="m", agg="median"),
+        dict(name="r", metric="m", severity="fatal"),
+        dict(name="r", metric="m", window_s=0),
+        dict(name="r", metric="m", for_s=-1),
+        # hysteresis must widen AGAINST the firing direction
+        dict(name="r", metric="m", op=">", threshold=2.0,
+             resolve_threshold=3.0),
+        dict(name="r", metric="m", op="<", threshold=1.0,
+             resolve_threshold=0.5),
+    ):
+        with pytest.raises(ValueError):
+            obs.AlertRule(**bad)
+
+
+def test_load_rules_json_and_unknown_field_rejection(tmp_path):
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps({"rules": [
+        {"name": "burn", "metric": "slo_error_budget_burn_rate",
+         "threshold": 2.0, "for_s": 1.0, "resolve_threshold": 1.0,
+         "severity": "page"},
+        {"name": "quiet", "metric": "serving_requests_total",
+         "kind": "absence", "window_s": 60, "severity": "warn"},
+    ]}))
+    rules = obs.load_alert_rules(str(path))
+    assert [r.name for r in rules] == ["burn", "quiet"]
+    assert rules[0].effective_resolve_threshold == 1.0
+    # a misspelled field must fail loudly, not become a default silently
+    path.write_text(json.dumps([{"name": "x", "metric": "m", "fors": 3}]))
+    with pytest.raises(ValueError, match="unknown fields"):
+        obs.load_alert_rules(str(path))
+    path.write_text(json.dumps([{"name": "x", "metric": "m"},
+                                {"name": "x", "metric": "m2"}]))
+    with pytest.raises(ValueError, match="duplicate"):
+        obs.load_alert_rules(str(path))
+    # a top-level typo (or an empty file) must not silently disable all
+    # alerting
+    path.write_text(json.dumps({"alert_rules": [{"name": "x",
+                                                 "metric": "m"}]}))
+    with pytest.raises(ValueError, match="'rules' key"):
+        obs.load_alert_rules(str(path))
+    path.write_text(json.dumps([]))
+    with pytest.raises(ValueError, match="zero rules"):
+        obs.load_alert_rules(str(path))
+
+
+# -- the state machine --------------------------------------------------------
+
+
+def test_threshold_fire_hold_down_resolve_hysteresis():
+    """The full lifecycle, deterministically clocked: breach → hold-down
+    (no fire yet) → fire → dip below threshold but above the resolve
+    threshold (still firing — hysteresis) → below resolve threshold →
+    resolve hold-down → resolved."""
+    key = "burn"
+    store = obs.SeriesStore()
+    rule = obs.AlertRule(name="hot", metric=key, op=">", threshold=2.0,
+                         window_s=10.0, agg="last", for_s=2.0,
+                         resolve_threshold=1.0, resolve_for_s=2.0)
+    eng = obs.AlertEngine(store, [rule], registry=obs.MetricsRegistry(),
+                          name="t1")
+    try:
+        def tick(mono, value):
+            store.record(key, value, "gauge", mono=mono)
+            return eng.evaluate(now=mono)
+
+        assert tick(100.0, 3.0) == []            # breached, hold-down starts
+        assert tick(101.0, 3.0) == []            # 1s < for_s
+        trans = tick(102.5, 3.0)                 # held 2.5s >= 2.0 → FIRE
+        assert [t["action"] for t in trans] == ["firing"]
+        assert trans[0]["rule"] == "hot" and trans[0]["value"] == 3.0
+        assert eng.firing() == {"hot": [key]}
+        # hysteresis: 1.5 is below the firing threshold but above the
+        # resolve threshold — the alert must NOT resolve (no flap)
+        assert tick(103.0, 1.5) == []
+        assert tick(110.0, 1.5) == []            # however long it lingers
+        assert eng.firing() == {"hot": [key]}
+        # below the resolve threshold starts the resolve hold-down
+        assert tick(111.0, 0.5) == []
+        # a bounce back above resolve_threshold resets the hold-down
+        assert tick(112.0, 1.5) == []
+        assert tick(113.0, 0.5) == []
+        assert tick(114.0, 0.5) == []            # 1s < resolve_for_s
+        trans = tick(115.5, 0.5)                 # held 2.5s → RESOLVED
+        assert [t["action"] for t in trans] == ["resolved"]
+        assert eng.firing() == {}
+        # a breach that recovers before the hold-down never fires
+        assert tick(120.0, 9.0) == []
+        assert tick(121.0, 0.0) == []
+        assert tick(130.0, 0.0) == []
+        assert eng.stats()["fired"] == 1 and eng.stats()["resolved"] == 1
+    finally:
+        eng.close()
+
+
+def test_flapping_gauge_cannot_flap_the_alert():
+    """A gauge oscillating across the firing threshold (but never below
+    the resolve threshold) produces exactly ONE firing transition."""
+    key = "flappy"
+    store = obs.SeriesStore()
+    rule = obs.AlertRule(name="f", metric=key, threshold=2.0,
+                         window_s=10.0, for_s=0.0, resolve_threshold=0.5,
+                         resolve_for_s=1.0)
+    eng = obs.AlertEngine(store, [rule], registry=obs.MetricsRegistry(),
+                          name="t2")
+    try:
+        transitions = []
+        value = [3.0, 1.0]  # straddles threshold=2, never crosses 0.5
+        for i in range(20):
+            store.record(key, value[i % 2], "gauge", mono=100.0 + i)
+            transitions += eng.evaluate(now=100.0 + i)
+        assert [t["action"] for t in transitions] == ["firing"]
+        assert eng.firing() == {"f": [key]}
+        g = eng.registry.gauge("alert_state", labels={"rule": "f"})
+        assert g.value == 1.0
+    finally:
+        eng.close()
+
+
+def test_absence_rule_fires_when_the_series_goes_quiet():
+    key = "heartbeat_metric"
+    store = obs.SeriesStore()
+    rule = obs.AlertRule(name="gone", metric=key, kind="absence",
+                         window_s=5.0, for_s=0.0)
+    eng = obs.AlertEngine(store, [rule], registry=obs.MetricsRegistry(),
+                          name="t3")
+    try:
+        store.record(key, 1.0, "gauge", mono=100.0)
+        assert eng.evaluate(now=101.0) == []       # fresh
+        assert eng.evaluate(now=104.0) == []       # still inside the window
+        trans = eng.evaluate(now=106.0)            # 6s > 5s → absent
+        assert [t["action"] for t in trans] == ["firing"]
+        store.record(key, 2.0, "gauge", mono=107.0)  # samples resume
+        trans = eng.evaluate(now=107.5)
+        assert [t["action"] for t in trans] == ["resolved"]
+    finally:
+        eng.close()
+
+
+def test_absence_rule_fires_for_a_series_that_never_arrived():
+    """An explicit key nothing ever produced IS the alert — but only after
+    the engine has watched a full window (no page at boot)."""
+    store = obs.SeriesStore()
+    rule = obs.AlertRule(name="never", metric="never_produced",
+                         kind="absence", window_s=5.0)
+    eng = obs.AlertEngine(store, [rule], registry=obs.MetricsRegistry(),
+                          name="t4")
+    try:
+        t0 = eng._start_mono
+        assert eng.evaluate(now=t0 + 1.0) == []    # grace: window not over
+        trans = eng.evaluate(now=t0 + 6.0)
+        assert [t["action"] for t in trans] == ["firing"]
+        detail = eng.health_status()[2]
+        assert detail["never_matched"] == ["never"]
+    finally:
+        eng.close()
+
+
+def test_phantom_absence_instance_resolves_when_labeled_series_arrive():
+    """A bare-name absence rule fires on its phantom key while NOTHING
+    matches; once the real (labeled) series arrives, the phantom must
+    RESOLVE — not page forever on a key match() will never return again."""
+    store = obs.SeriesStore()
+    rule = obs.AlertRule(name="hb", metric="heartbeat_total",
+                         kind="absence", window_s=5.0, severity="page")
+    eng = obs.AlertEngine(store, [rule], registry=obs.MetricsRegistry(),
+                          name="t4b")
+    try:
+        t0 = eng._start_mono
+        trans = eng.evaluate(now=t0 + 6.0)
+        assert [t["action"] for t in trans] == ["firing"]
+        assert not eng.health_status()[1]
+        # the series starts arriving — labeled, as package instruments are
+        key = obs.series_key("heartbeat_total", {"engine": "e"})
+        store.record(key, 1.0, "counter", mono=t0 + 7.0)
+        trans = eng.evaluate(now=t0 + 7.5)
+        assert [(t["metric"], t["action"]) for t in trans] \
+            == [("heartbeat_total", "resolved")]
+        assert eng.firing() == {}
+        assert eng.health_status()[1]
+        # and the labeled instance now tracks absence on its own
+        trans = eng.evaluate(now=t0 + 20.0)
+        assert [(t["metric"], t["action"]) for t in trans] \
+            == [(key, "firing")]
+    finally:
+        eng.close()
+
+
+def test_rate_rule_over_a_counter():
+    key = "sheds_total"
+    store = obs.SeriesStore()
+    rule = obs.AlertRule(name="shedding", metric=key, kind="rate",
+                         op=">", threshold=0.5, window_s=10.0,
+                         resolve_threshold=0.0)
+    eng = obs.AlertEngine(store, [rule], registry=obs.MetricsRegistry(),
+                          name="t5")
+    try:
+        store.record(key, 0, "counter", mono=100.0)
+        store.record(key, 0, "counter", mono=101.0)
+        assert eng.evaluate(now=101.0) == []       # flat counter: rate 0
+        store.record(key, 8, "counter", mono=102.0)  # 8 sheds in 2s
+        trans = eng.evaluate(now=102.0)
+        assert [t["action"] for t in trans] == ["firing"]
+        assert trans[0]["value"] > 0.5
+        # the window slides past the burst: rate back to 0 → resolves
+        store.record(key, 8, "counter", mono=112.0)
+        store.record(key, 8, "counter", mono=113.0)
+        trans = eng.evaluate(now=113.0)
+        assert [t["action"] for t in trans] == ["resolved"]
+    finally:
+        eng.close()
+
+
+def test_bare_metric_name_alerts_per_label_set():
+    """One rule over a bare instrument name maintains independent state
+    per labeled series — replica r1 firing does not mask r0's later fire,
+    and each resolves on its own."""
+    store = obs.SeriesStore()
+    keys = {r: obs.series_key("fleet_replica_queue_depth",
+                              {"fleet": "f", "replica": r})
+            for r in ("r0", "r1")}
+    rule = obs.AlertRule(name="qd", metric="fleet_replica_queue_depth",
+                         threshold=10.0, window_s=10.0)
+    eng = obs.AlertEngine(store, [rule], registry=obs.MetricsRegistry(),
+                          name="t6")
+    try:
+        store.record(keys["r0"], 1.0, "gauge", mono=100.0)
+        store.record(keys["r1"], 99.0, "gauge", mono=100.0)
+        trans = eng.evaluate(now=100.0)
+        assert [(t["action"], t["metric"]) for t in trans] \
+            == [("firing", keys["r1"])]
+        store.record(keys["r0"], 88.0, "gauge", mono=101.0)
+        store.record(keys["r1"], 0.0, "gauge", mono=101.0)
+        trans = eng.evaluate(now=101.0)
+        actions = {(t["action"], t["metric"]) for t in trans}
+        assert actions == {("firing", keys["r0"]),
+                           ("resolved", keys["r1"])}
+        assert eng.firing() == {"qd": [keys["r0"]]}
+    finally:
+        eng.close()
+
+
+# -- healthz + events + exemplars ---------------------------------------------
+
+
+def test_firing_page_alert_degrades_healthz_warn_does_not():
+    store = obs.SeriesStore()
+    store.record("pager_metric", 9.0, "gauge", mono=100.0)
+    store.record("warner_metric", 9.0, "gauge", mono=100.0)
+    reg = obs.MetricsRegistry()
+    page = obs.AlertEngine(
+        store, [obs.AlertRule(name="p", metric="pager_metric",
+                              threshold=1.0, window_s=1e6,
+                              severity="page")],
+        registry=reg, name="pageeng")
+    warn = obs.AlertEngine(
+        store, [obs.AlertRule(name="w", metric="warner_metric",
+                              threshold=1.0, window_s=1e6,
+                              severity="warn")],
+        registry=reg, name="warneng")
+    try:
+        warn.evaluate(now=100.0)
+        ok, detail = obs.healthz()  # the same aggregation path as stalls
+        assert ok  # a warn-severity alert never 503s the process
+        assert detail["sources"]["alerts:warneng"]["firing"] == {
+            "w": ["warner_metric"]}
+        page.evaluate(now=100.0)
+        ok, detail = obs.healthz()
+        assert not ok
+        assert detail["sources"]["alerts:pageeng"]["paging"] == ["p"]
+    finally:
+        page.close()
+        warn.close()
+    ok, detail = obs.healthz()  # close() unregisters both sources
+    assert "alerts:pageeng" not in detail.get("sources", {})
+
+
+def test_transitions_land_in_the_event_log_with_exemplar_traces(tmp_path):
+    """alert_firing/alert_resolved ride the EventLog; a histogram-derived
+    alert carries the instrument's r15 exemplar trace ids — the page links
+    straight to the traces that breached it."""
+    reg = obs.MetricsRegistry()
+    h = reg.histogram("router_lat_seconds", labels={"router": "x"})
+    for i in range(8):
+        h.observe(0.1 * i, exemplar=f"trace{i}")
+    store = obs.SeriesStore()
+    sam = obs.Sampler(registry=reg, store=store, name="ev")
+    sam.sample_once()
+    p99_key = obs.series_key("router_lat_seconds", {"router": "x"},
+                             field="p99")
+    rule = obs.AlertRule(name="tail", metric=p99_key, threshold=0.5,
+                         window_s=1e6, resolve_threshold=0.1)
+    eng = obs.AlertEngine(store, [rule], registry=reg, name="t7")
+    path = tmp_path / "events.jsonl"
+    try:
+        obs.configure_event_log(str(path))
+        trans = eng.evaluate()
+        assert [t["action"] for t in trans] == ["firing"]
+        assert trans[0]["trace_exemplars"][0] == "trace7"  # slowest first
+        store.record(p99_key, 0.0, "gauge")  # the tail recovered
+        trans = eng.evaluate()
+        assert [t["action"] for t in trans] == ["resolved"]
+    finally:
+        obs.configure_event_log(None)  # flush + close
+        eng.close()
+        sam.close()
+    events = [json.loads(l) for l in path.read_text().splitlines()]
+    by_name = {e["event"]: e for e in events}
+    assert by_name["alert_firing"]["rule"] == "tail"
+    assert by_name["alert_firing"]["severity"] == "page"
+    assert by_name["alert_firing"]["trace_exemplars"][0] == "trace7"
+    assert by_name["alert_resolved"]["rule"] == "tail"
+    # counters rode the registry too
+    assert reg.counter("alerts_fired_total",
+                       labels={"rule": "tail"}).value == 1
+
+
+# -- the end-to-end drill -----------------------------------------------------
+
+
+def test_e2e_slo_burn_episode_fires_degrades_healthz_and_resolves(tmp_path):
+    """The ISSUE 12 acceptance drill, tier-1: open-loop load past the knee
+    of a real (trivially-jitted) engine injects an SLO-burn episode — the
+    burn-rate alert fires within one evaluation window, degrades /healthz
+    through the standard aggregation, lands in the EventLog, and resolves
+    with hysteresis once the episode ends."""
+    reg = obs.MetricsRegistry()
+    slo = obs.SLO(latency_target_s=5.0, availability_target=0.9,
+                  name="drill", burn_alert=None, min_samples=5)
+
+    def apply_fn(p, x):
+        return x * p
+
+    store = obs.SeriesStore()
+    sampler = obs.Sampler(registry=reg, store=store, name="drill")
+    burn_key = obs.series_key("slo_error_budget_burn_rate",
+                              {"engine": "drill", "slo": "drill"})
+    rule = obs.AlertRule(name="burn_rate", metric=burn_key, op=">",
+                         threshold=2.0, window_s=30.0, agg="last",
+                         for_s=0.0, resolve_threshold=0.5,
+                         severity="page",
+                         description="error budget burning >2x accrual")
+    alerts = obs.AlertEngine(store, [rule], registry=reg, name="drill")
+    events_path = tmp_path / "drill_events.jsonl"
+    with ServingEngine(apply_fn, jnp.float32(2.0), max_batch=4,
+                       name="drill", registry=reg, queue_limit=4,
+                       slo=slo, slo_window=64) as engine:
+        engine.predict(np.ones((1, 3), np.float32), timeout=60)  # warm
+        try:
+            obs.configure_event_log(str(events_path))
+            # -- the episode: open-loop burst far past the 4-part queue —
+            # arrivals the engine refuses are shed, and every shed burns
+            futs, sheds = [], 0
+            for i in range(80):
+                try:
+                    futs.append(engine.submit(
+                        np.ones((1, 3), np.float32)))
+                except RejectedError:
+                    sheds += 1
+            for f in futs:
+                f.result(timeout=60)
+            assert sheds > 20, "the burst never exceeded the queue bound"
+            assert engine.slo_tracker.burn_rate() > 2.0
+            # ONE sample + ONE evaluation window: the alert must fire
+            sampler.sample_once()
+            trans = alerts.evaluate()
+            assert [(t["rule"], t["action"]) for t in trans] \
+                == [("burn_rate", "firing")]
+            ok, detail = obs.healthz()
+            assert not ok  # a firing page alert degrades /healthz
+            assert detail["sources"]["alerts:drill"]["paging"] \
+                == ["burn_rate"]
+            # -- the episode ends: good traffic refills the SLO window
+            for _ in range(25):
+                waves = [engine.submit(np.ones((1, 3), np.float32))
+                         for _ in range(3)]
+                for f in waves:
+                    f.result(timeout=60)
+            assert engine.slo_tracker.burn_rate() < 0.5
+            sampler.sample_once()
+            trans = alerts.evaluate()
+            assert [(t["rule"], t["action"]) for t in trans] \
+                == [("burn_rate", "resolved")]
+            ok, _ = obs.healthz()
+            assert ok
+        finally:
+            obs.configure_event_log(None)
+            alerts.close()
+            sampler.close()
+    events = [json.loads(l) for l in events_path.read_text().splitlines()]
+    names = [e["event"] for e in events]
+    assert "alert_firing" in names and "alert_resolved" in names
+    firing = events[names.index("alert_firing")]
+    assert firing["rule"] == "burn_rate" and firing["value"] > 2.0
+    assert names.index("alert_firing") < names.index("alert_resolved")

@@ -1,0 +1,182 @@
+"""Regression sentinel: noise-floor-aware bench record comparison
+(tools/bench_compare.py, ISSUE 12). The floors come from PERF.md's recorded
+null-control numbers — device trace ±0.04%, CPU paired interleave ±1.5
+points, host-clock cross-session ±2x — never re-derived at compare time."""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from tools.bench_compare import (  # noqa: E402
+    classify,
+    compare,
+    flatten,
+    load_record,
+    summarize,
+)
+
+
+def _headline(value, device_ms=9.8, host_ms=9.9):
+    return {
+        "metric": "mlm_tokens_per_sec_per_chip", "value": value,
+        "unit": "tokens/s/chip", "method": "device_trace",
+        "device_ms_per_step": device_ms, "host_ms_per_step": host_ms,
+    }
+
+
+# -- classification + verdicts ------------------------------------------------
+
+
+def test_synthetic_regression_improvement_within_noise_triple():
+    """The acceptance triple: a −5% regression, a +5% improvement, and a
+    +0.01% wiggle on the device-trace headline classify correctly against
+    the ±0.04% floor."""
+    base = _headline(3_300_000.0)
+    cases = {
+        "regressed": _headline(3_300_000.0 * 0.95),
+        "improved": _headline(3_300_000.0 * 1.05),
+        "within_noise": _headline(3_300_000.0 * 1.0001),
+    }
+    for expected, cand in cases.items():
+        comp = compare(base, cand)
+        by_key = {c["key"]: c for c in comp}
+        assert by_key["value"]["verdict"] == expected, (expected, comp)
+        assert summarize(comp)["verdict"] == expected
+        assert "0.04%" in by_key["value"]["floor"]
+
+
+def test_host_clock_metrics_get_the_brutal_cross_session_floor():
+    """A 30% 'win' on a host-clock number is within the ±2x session swing
+    and must read within_noise; only a >2x change clears the floor.
+    Lower-is-better direction holds for latency keys."""
+    base = {"calibrated_rps": 1000.0, "p99_ms": 10.0}
+    small = compare(base, {"calibrated_rps": 1300.0, "p99_ms": 7.0})
+    assert all(c["verdict"] == "within_noise" for c in small)
+    big = compare(base, {"calibrated_rps": 2500.0, "p99_ms": 30.0})
+    by_key = {c["key"]: c for c in big}
+    assert by_key["calibrated_rps"]["verdict"] == "improved"
+    assert by_key["p99_ms"]["verdict"] == "regressed"  # latency UP is bad
+
+
+def test_paired_interleave_percent_floor_is_absolute_points():
+    """overhead_pct compares on the ±1.5 absolute-point null-control floor
+    (a relative floor on a ~2% number would be meaningless)."""
+    base = {"trace": {"overhead_pct": 1.8}}
+    assert compare(base, {"trace": {"overhead_pct": 2.9}})[0]["verdict"] \
+        == "within_noise"
+    worse = compare(base, {"trace": {"overhead_pct": 4.0}})[0]
+    assert worse["verdict"] == "regressed"
+    assert "1.5" in worse["floor"]
+    assert compare(base, {"trace": {"overhead_pct": 0.5}})[0]["verdict"] \
+        == "within_noise"  # a 1.3-point drop is still inside ±1.5
+    assert compare(base, {"trace": {"overhead_pct": 0.1}})[0]["verdict"] \
+        == "improved"      # a 1.7-point drop clears the floor
+
+
+def test_headline_value_floor_depends_on_the_record_method():
+    """'value' is device-trace-tight only when the record SAYS it was
+    measured from the device trace; a host-clock headline gets the host
+    floor."""
+    mode, floor, direction, _ = classify("value", _headline(1.0))
+    assert (mode, floor, direction) == ("frac", 0.0004, "higher")
+    host = dict(_headline(1.0), method="host_clock")
+    _, floor_host, _, _ = classify("value", host)
+    assert floor_host == 1.0
+    # unrecognized keys are not measurements → not classified
+    assert classify("seed", _headline(1.0)) is None
+    assert classify("sweep.0.submitted", {}) is None
+
+
+def test_flatten_dot_paths_and_record_loading(tmp_path):
+    rec = {"a": 1, "b": {"c": 2.5, "d": [3, {"e": 4}]},
+           "skip": True, "s": "x"}
+    assert flatten(rec) == {"a": 1.0, "b.c": 2.5, "b.d.0": 3.0,
+                            "b.d.1.e": 4.0}
+    # the driver's BENCH_rNN wrapper unwraps to its parsed record
+    p = tmp_path / "wrapped.json"
+    p.write_text(json.dumps({"n": 4, "tail": "...",
+                             "parsed": _headline(2.0)}))
+    assert load_record(str(p))["value"] == 2.0
+    # a JSONL log compares by its newest parseable record
+    p2 = tmp_path / "log.jsonl"
+    p2.write_text('not json\n{"value": 1.0}\n{"value": 2.0}\n')
+    assert load_record(str(p2))["value"] == 2.0
+
+
+# -- the CLI contract ---------------------------------------------------------
+
+
+def test_cli_one_json_line_and_fail_on_regress(tmp_path):
+    base, cand = tmp_path / "base.json", tmp_path / "cand.json"
+    base.write_text(json.dumps(_headline(3_300_000.0)))
+    cand.write_text(json.dumps(_headline(3_000_000.0)))
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "bench_compare.py"),
+             str(base), str(cand), *extra],
+            capture_output=True, text=True, cwd=ROOT, timeout=120)
+
+    proc = run()
+    assert proc.returncode == 0, proc.stderr[-500:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout  # exactly ONE JSON line
+    record = json.loads(lines[0])
+    assert record["tool"] == "bench_compare"
+    assert record["verdict"] == "regressed"
+    assert record["candidates"][0]["summary"]["regressed"] >= 1
+    # per-metric detail (incl. the floor provenance) rides stderr
+    assert "PERF.md" in proc.stderr
+    proc = run("--fail_on_regress")
+    assert proc.returncode == 1
+    assert json.loads(proc.stdout.strip())["ok"] is False
+
+
+def test_no_comparable_metrics_cannot_pass_the_regression_gate(tmp_path):
+    """A comparison that checked NOTHING (schema drift, a --dry record as
+    baseline) must say so — and fail under --fail_on_regress instead of
+    silently waving the candidate through."""
+    assert summarize([]) == {
+        "improved": 0, "regressed": 0, "within_noise": 0, "changed": 0,
+        "verdict": "no_comparable_metrics",
+    }
+    base, cand = tmp_path / "base.json", tmp_path / "cand.json"
+    base.write_text(json.dumps({"metric": "load_bench", "dry": True}))
+    cand.write_text(json.dumps(_headline(3_300_000.0)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "bench_compare.py"),
+         str(base), str(cand), "--fail_on_regress"],
+        capture_output=True, text=True, cwd=ROOT, timeout=120)
+    assert proc.returncode == 1, proc.stdout
+    record = json.loads(proc.stdout.strip())
+    assert record["verdict"] == "no_comparable_metrics"
+    assert record["compared"] == 0 and record["ok"] is False
+    assert "NO comparable metrics" in proc.stderr
+    # the gate is per CANDIDATE: one record that compared fine must not
+    # wave an unchecked sibling through
+    good_base = tmp_path / "gbase.json"
+    good_base.write_text(json.dumps(_headline(3_300_000.0)))
+    drifted = tmp_path / "drifted.json"
+    drifted.write_text(json.dumps({"renamed": 1.0}))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "bench_compare.py"),
+         str(good_base), str(good_base), str(drifted),
+         "--fail_on_regress"],
+        capture_output=True, text=True, cwd=ROOT, timeout=120)
+    assert proc.returncode == 1, proc.stdout
+    record = json.loads(proc.stdout.strip())
+    assert record["compared"] > 0 and record["ok"] is False
+    assert record["candidates"][1]["summary"]["verdict"] \
+        == "no_comparable_metrics"
+    # without the gate flag it reports honestly but exits 0
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "bench_compare.py"),
+         str(base), str(cand)],
+        capture_output=True, text=True, cwd=ROOT, timeout=120)
+    assert proc.returncode == 0
+    assert json.loads(proc.stdout.strip())["verdict"] \
+        == "no_comparable_metrics"

@@ -268,6 +268,13 @@ def test_serve_metrics_sidecar_end_to_end(tmp_path):
     ckpt = os.path.join(run_dir, "checkpoints")
     tok = glob.glob(str(tmp_path / "cache" / "*tokenizer*.json"))[0]
     events = str(tmp_path / "events.jsonl")
+    series = str(tmp_path / "series.jsonl")
+    rules = tmp_path / "rules.json"
+    rules.write_text(json.dumps([
+        # never fires (healthz must stay ok); its state gauge still exports
+        {"name": "queue_hot", "metric": "serving_queue_depth",
+         "threshold": 1e6, "window_s": 60, "severity": "page"},
+    ]))
 
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.Popen(
@@ -275,7 +282,9 @@ def test_serve_metrics_sidecar_end_to_end(tmp_path):
          "--checkpoint", ckpt, "--tokenizer", tok, "--stdin",
          "--max_batch", "4", "--bucket_widths", "16", "--no_warmup",
          "--metrics_port", "0", "--heartbeat_deadline_s", "60",
-         "--events_jsonl", events, "--k", "2"],
+         "--events_jsonl", events, "--k", "2",
+         "--series_interval_s", "0.1", "--series_jsonl", series,
+         "--alert_rules", str(rules)],
         cwd=root, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
         stderr=subprocess.PIPE, text=True,
     )
@@ -327,6 +336,22 @@ def test_serve_metrics_sidecar_end_to_end(tmp_path):
         assert code == 200
         assert statz["counters"]['serving_requests_total{engine="mlm"}'] >= 1
         assert statz["health"]["status"] == "ok"
+        # the never-firing page rule still exports its state gauge, and the
+        # alerting healthz source reports it without degrading the probe
+        assert statz["gauges"]['alert_state{rule="queue_hot"}'] == 0.0
+        assert statz["health"]["sources"]["alerts:serve"]["paging"] == []
+        # /seriesz serves the sampled history live: the engine's request
+        # counter has accumulated windowed samples by now
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            code, body = get("/seriesz")
+            entry = json.loads(body)["series"].get(
+                'serving_requests_total{engine="mlm"}')
+            if entry and entry["n"] >= 2 and entry["last"] >= 1:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(f"/seriesz never showed the history: {body}")
 
         # communicate() flushes and closes stdin → serve drains and exits
         out, err = proc.communicate(timeout=120)
@@ -339,6 +364,13 @@ def test_serve_metrics_sidecar_end_to_end(tmp_path):
         # the event log captured the compile events (all off-stdout)
         rows = [json.loads(l) for l in open(events)]
         assert any(r.get("event") == "serving_compile" for r in rows)
+        # the series JSONL drained on close: every persisted sweep parses
+        # and carries the sampled engine counter
+        srows = [json.loads(l) for l in open(series)]
+        assert len(srows) >= 2
+        assert all(r["event"] == "series_sample" for r in srows)
+        assert srows[-1]["series"][
+            'serving_requests_total{engine="mlm"}'] >= 1
     finally:
         if proc.poll() is None:
             proc.kill()

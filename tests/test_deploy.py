@@ -12,6 +12,7 @@ tier-1 tests that retain its logic coverage.
 
 import json
 import os
+import tempfile
 import threading
 import time
 
@@ -63,6 +64,24 @@ def _tamper(pub_path: str) -> None:
     named[first] = np.asarray(named[first]) + 1.0
     with open(npz, "wb") as f:
         np.savez(f, **named)
+
+
+def _publish_tampered(publish_dir: str, step: int, tree) -> str:
+    """Publish an already-digest-tampered publication ATOMICALLY: stage +
+    tamper out of sight, then rename the whole directory in. A live
+    deployer (polling every few ms) must only ever observe the final
+    tampered payload — tampering in place races the watcher into reading
+    a torn npz ('unreadable' instead of the digest_mismatch under test).
+    The staging dir lives INSIDE publish_dir (same filesystem by
+    construction, so the rename stays atomic under any --basetemp/TMPDIR
+    split) under the ``.tmp-`` prefix list_publications always skips."""
+    staging = tempfile.mkdtemp(prefix=".tmp-tamper-", dir=publish_dir)
+    pub = publish_params(staging, step, tree)
+    _tamper(pub)
+    dest = os.path.join(publish_dir, os.path.basename(pub))
+    os.rename(pub, dest)
+    os.rmdir(staging)
+    return dest
 
 
 @pytest.fixture
@@ -630,10 +649,10 @@ def test_fleet_deploy_chaos_e2e(no_faults):
         assert [r["action"] for r in deployer.history] == ["swapped"] * 3, \
             deployer.history
         # publication 4: NaN-corrupted by the PIT_FAULTS machinery (digest
-        # verifies!); publication 5: digest-tampered after landing
+        # verifies!); publication 5: digest-tampered (staged + renamed in,
+        # so the live watcher can only observe the tampered payload)
         publish_params(publish_dir, 40, _tree(2.004))
-        p5 = publish_params(publish_dir, 50, _tree(2.005))
-        _tamper(p5)
+        _publish_tampered(publish_dir, 50, _tree(2.005))
         deadline = time.monotonic() + 60
         while len(deployer.history) < 5 and time.monotonic() < deadline:
             time.sleep(0.05)
@@ -919,12 +938,13 @@ def test_train_serve_deploy_drill_real_process(tmp_path):
                 assert trainer.returncode == 0, out[-3000:]
                 assert "TRAINER_DONE" in out
                 # trainer published steps 3,6,9,12; #2 (step 6) is the NaN
-                # one. Add a digest-tampered publication from the test side.
-                p_t = publish_params(str(publish_dir), 100,
-                                     jax.tree.map(
-                                         lambda a: np.asarray(a) * 1.001,
-                                         params))
-                _tamper(p_t)
+                # one. Add a digest-tampered publication from the test side
+                # (staged + renamed in: the live watcher must only ever see
+                # the tampered payload, never a torn mid-tamper npz).
+                _publish_tampered(str(publish_dir), 100,
+                                  jax.tree.map(
+                                      lambda a: np.asarray(a) * 1.001,
+                                      params))
                 deadline = time.monotonic() + 300
                 while (len(deployer.history) < 5
                        and time.monotonic() < deadline):

@@ -408,6 +408,112 @@ def test_fleet_health_degrades_label_not_router(x):
     _close(router, *reps)
 
 
+# -- scrape staleness + the fleet time-series (ISSUE 12) ----------------------
+
+
+def test_router_scrape_staleness_degrades_placement(x):
+    """A stale-but-up replica's frozen gauges must stop steering
+    least-loaded dispatch: past ``stale_after_intervals`` the slot is
+    DEGRADED for placement (routed around while any fresh replica serves),
+    ``fleet_scrape_age_s{replica=}`` rides the registry, and the next
+    completed scrape reinstates it."""
+    reps = [_make_replica(f"st{i}") for i in range(2)]
+    reg = obs.MetricsRegistry()
+    # a long interval parks the background loop; refresh() drives scrapes
+    router = Router(reps, registry=reg, scrape_interval_s=60.0,
+                    stale_after_intervals=0.05)  # stale past the 0.5s floor
+    try:
+        router.refresh()
+        labels = {"fleet": router.name, "replica": "st0"}
+        age_key = obs.series_key("fleet_scrape_age_s", labels)
+        assert reg.snapshot()["gauges"][age_key] < 0.5
+        assert reg.gauge(
+            "fleet_replica_requests_total",
+            labels={"fleet": router.name, "replica": "st0"}).value >= 0
+        assert all(s["state"] == "serving"
+                   for s in router.statuses().values())
+        # st0's view goes stale (the observation aged, not the replica)
+        with router._lock:
+            router._slots["st0"].last_scrape_mono -= 10.0
+        st = router.statuses()
+        assert st["st0"]["state"] == "degraded"
+        assert st["st0"]["scrape_age_s"] > 0.5
+        assert st["st1"]["state"] == "serving"
+        # the exported gauge reports the LIVE age (computed at export by
+        # the registry collector): a wedged scrape loop — which is exactly
+        # when refresh() stops running — cannot freeze it near zero
+        assert reg.snapshot()["gauges"][age_key] > 0.5
+        # placement routes around the stale slot while a fresh one serves
+        for _ in range(4):
+            fut = router.submit(x)
+            fut.result(timeout=30)
+            assert fut.replica == "st1"
+        # a completed scrape is a fresh observation: reinstated
+        router.refresh()
+        assert router.statuses()["st0"]["state"] == "serving"
+    finally:
+        _close(router, *reps)
+
+
+def test_router_feeds_fleet_series_store(x):
+    """The scrape loop feeds per-replica series into one fleet store
+    (labels ``replica=``): a scraped LocalReplica leaves a queryable
+    up/queue-depth/requests history instead of a point read."""
+    reps = [_make_replica(f"ts{i}") for i in range(2)]
+    router = _router(reps)
+    try:
+        router.refresh()
+        assert np.allclose(router.predict(x, timeout=30), 2.0)
+        router.refresh()
+        router.refresh()
+        labels = {"fleet": router.name, "replica": "ts0"}
+        up = obs.series_key("fleet_replica_up", labels)
+        pts = router.series.points(up)
+        assert len(pts) >= 3 and all(v == 1.0 for _, v in pts)
+        # the replica's lifetime request counter ingests counter-kind:
+        # windowed delta answers "how much did this replica serve lately"
+        served = 0.0
+        for r in ("ts0", "ts1"):
+            key = obs.series_key("fleet_replica_requests_total",
+                                 {"fleet": router.name, "replica": r})
+            assert router.series.kind(key) == "counter"
+            served += router.series.delta(key, window_s=3600.0) or 0.0
+        assert served >= 1.0
+        # a killed replica's outage is visible IN the history (up drops
+        # to 0), not a gap in it
+        reps[0].kill()
+        router.refresh()
+        assert router.series.last(up) == 0.0
+    finally:
+        _close(router, *reps)
+
+
+def test_bake_judges_burn_history_not_point_reads(x):
+    """A burn spike the bake's own polls never catch (landed in the fleet
+    series between polls — e.g. by the background scrape loop) must still
+    roll the swap back: the bake judges the windowed MAX since the swap,
+    not whatever the latest poll happened to read."""
+    rep = _make_replica("bk0")
+    router = _router([rep])
+    try:
+        router.refresh()
+        assert router._bake(router._slots["bk0"], bake_s=0.1,
+                            burn_threshold=2.0, poll_s=0.02,
+                            min_requests=0) is None  # clean bake
+        # a spike stamped inside the upcoming bake window, invisible to
+        # every direct scrape (the replica's own gauge reads 0 throughout)
+        router.series.record(
+            obs.series_key("fleet_replica_slo_burn",
+                           {"fleet": router.name, "replica": "bk0"}),
+            9.0, "gauge", mono=time.monotonic() + 0.03)
+        reason = router._bake(router._slots["bk0"], bake_s=0.3,
+                              burn_threshold=2.0, poll_s=0.02,
+                              min_requests=0)
+        assert reason is not None and "SLO burn" in reason
+    finally:
+        _close(router, rep)
+
+
 # -- the RPC shim over real HTTP (in-process server) --------------------------
 
 

@@ -319,6 +319,63 @@ def test_span_rule_validates_literal_names_against_the_registry():
                     'record_span("not_a_span", None, 0, 0)')) == ()
 
 
+# -- PIT-METRIC ---------------------------------------------------------------
+
+
+def test_metric_rule_resolves_literals_against_registered_instruments():
+    """The PIT-SPAN pattern for the alerting layer: an AlertRule(metric=)
+    or series_key() literal naming an instrument nothing registers would
+    build a rule that silently never fires — it must fail lint instead.
+    The known set derives from the package's .counter/.gauge/.histogram
+    registration literals."""
+    from perceiver_io_tpu.analysis.rules_metrics import (
+        MetricNameRule,
+        known_metric_names,
+        strip_series_key,
+    )
+
+    known = known_metric_names()
+    # spot-check the scan found real registrations across layers
+    for name in ("serving_queue_depth", "slo_error_budget_burn_rate",
+                 "fleet_replica_slo_burn", "fleet_scrape_age_s",
+                 "eventlog_dropped_total", "alert_state",
+                 "router_latency_seconds"):
+        assert name in known, f"{name} missing from the known-metric scan"
+    assert strip_series_key(
+        'serving_phase_seconds{engine="e",phase="queue"}:p99') \
+        == "serving_phase_seconds"
+    assert strip_series_key("reqs_total:count") == "reqs_total"
+    assert strip_series_key("ns:custom") == "ns:custom"  # not a field
+
+    src = """
+    import perceiver_io_tpu.obs as obs
+    from perceiver_io_tpu.obs import AlertRule
+
+    def good(store):
+        obs.AlertRule(name="q", metric="serving_queue_depth", threshold=1)
+        AlertRule("burn", "slo_error_budget_burn_rate:p99")
+        store.last(obs.series_key("router_latency_seconds",
+                                  {"router": "r"}, field="p99"))
+
+    def bad():
+        obs.AlertRule(name="q", metric="serving_queue_depht", threshold=1)
+        obs.series_key("router_latency_secondz")
+
+    def dynamic(name):
+        obs.AlertRule(name="d", metric=name)  # runtime's problem
+    """
+    found = _check(MetricNameRule(), src)
+    assert len(found) == 2
+    assert all(f.scope == "bad" for f in found)
+    assert "serving_queue_depht" in found[0].message
+    assert "router_latency_secondz" in found[1].message
+
+    # the lint suite's own fixtures are excluded
+    assert MetricNameRule().check(
+        FileContext("x", "tests/test_lint.py",
+                    'series_key("not_a_metric")')) == ()
+
+
 # -- baseline -----------------------------------------------------------------
 
 

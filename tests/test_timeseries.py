@@ -1,0 +1,267 @@
+"""Metrics time-series: bounded ring-buffer store, windowed queries, the
+cadenced registry sampler with JSONL persistence, /seriesz, and the
+eventlog-loss instruments (ISSUE 12)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+import perceiver_io_tpu.obs as obs
+from perceiver_io_tpu.obs.timeseries import split_series_key
+
+
+# -- keys ---------------------------------------------------------------------
+
+
+def test_series_key_matches_registry_snapshot_keys():
+    """series_key() must be byte-identical to the registry's snapshot()
+    keys — hand-built queries and sampled series meet on the same strings."""
+    reg = obs.MetricsRegistry()
+    reg.gauge("queue_depth", labels={"engine": "e1", "zone": "a"})
+    snap_key = next(iter(reg.snapshot()["gauges"]))
+    assert obs.series_key(
+        "queue_depth", {"engine": "e1", "zone": "a"}) == snap_key
+    assert obs.series_key("queue_depth", {"zone": "a", "engine": "e1"}) \
+        == snap_key  # label order never matters
+    hist_key = obs.series_key("lat", {"e": "x"}, field="p99")
+    assert split_series_key(hist_key) == ("lat", '{e="x"}', "p99")
+    assert split_series_key("plain") == ("plain", "", "")
+    # a non-field colon suffix stays part of the name, not a field
+    assert split_series_key("ns:custom") == ("ns:custom", "", "")
+
+
+# -- bounded store ------------------------------------------------------------
+
+
+def test_store_rings_are_bounded_and_series_capped():
+    """Sustained sampling cannot grow memory: per-series rings hold
+    max_samples, the key table holds max_series, overflow is counted."""
+    s = obs.SeriesStore(max_samples=8, max_series=3)
+    for i in range(10_000):
+        s.record("a", i, "counter", t=float(i), mono=float(i))
+    assert len(s.points("a")) == 8
+    assert [v for _, v in s.points("a")] == list(range(9992, 10000))
+    assert s.record("b", 1) and s.record("c", 1)
+    assert not s.record("d", 1)  # the cap refuses, never grows
+    assert s.dropped_series == 1
+    assert s.keys() == ["a", "b", "c"]
+    assert s.n_series() == 3
+
+
+def test_windowed_queries_and_counter_reset_awareness():
+    s = obs.SeriesStore()
+    # a counter climbing 0..9 at 1 Hz, resetting to 0 at t=6 (a restarted
+    # process re-publishing from zero)
+    values = [0, 1, 2, 3, 4, 5, 0, 1, 2, 3]
+    for i, v in enumerate(values):
+        s.record("c", v, "counter", t=1000.0 + i, mono=100.0 + i)
+    now = 109.0
+    assert s.last("c") == 3
+    assert s.last("c", window_s=0.5, now=now) == 3
+    assert s.last("missing") is None
+    # reset-aware delta over the whole run: 5 increments before the reset,
+    # 3 after — never negative
+    assert s.delta("c", window_s=100, now=now) == 8
+    assert s.rate("c", window_s=100, now=now) == pytest.approx(8 / 9)
+    # a window past the reset sees only the new segment
+    assert s.delta("c", window_s=3.5, now=now) == 3
+    assert s.age_s("c", now=now) == pytest.approx(0.0)
+    assert s.age_s("missing") is None
+    # gauges: plain last-minus-first, window aggregations
+    for i, v in enumerate([5.0, 1.0, 3.0]):
+        s.record("g", v, "gauge", mono=200.0 + i)
+    assert s.delta("g", window_s=100, now=203.0) == -2.0
+    assert s.window_agg("g", 100, "max", now=203.0) == 5.0
+    assert s.window_agg("g", 100, "mean", now=203.0) == 3.0
+    assert s.window_agg("g", 100, "min", now=203.0) == 1.0
+    assert s.window_agg("g", 100, "last", now=203.0) == 3.0
+    assert s.window_agg("g", 0.5, "max", now=300.0) is None  # empty window
+    with pytest.raises(ValueError):
+        s.window_agg("g", 1.0, "median", now=203.0)
+    # two-sample floor for derivatives
+    s.record("one", 1, "counter", mono=1.0)
+    assert s.delta("one", 100, now=2.0) is None
+    assert s.rate("one", 100, now=2.0) is None
+
+
+def test_match_selects_label_sets_of_a_bare_name():
+    s = obs.SeriesStore()
+    for r in ("r0", "r1"):
+        s.record(obs.series_key("fleet_replica_queue_depth",
+                                {"fleet": "f", "replica": r}), 1.0)
+    s.record("other", 1.0)
+    s.record(obs.series_key("lat", {"e": "a"}, field="p99"), 1.0)
+    assert s.match("fleet_replica_queue_depth") == [
+        obs.series_key("fleet_replica_queue_depth",
+                       {"fleet": "f", "replica": "r0"}),
+        obs.series_key("fleet_replica_queue_depth",
+                       {"fleet": "f", "replica": "r1"}),
+    ]
+    # a field suffix narrows to that field's series; exact keys match only
+    # themselves; unknown names match nothing
+    assert s.match("lat:p99") == [obs.series_key("lat", {"e": "a"},
+                                                 field="p99")]
+    assert s.match("lat") == []
+    assert s.match(obs.series_key("other")) == ["other"]
+    assert s.match('nope{replica="r0"}') == []
+
+
+# -- sampler ------------------------------------------------------------------
+
+
+def test_sampler_snapshots_every_instrument_kind(tmp_path):
+    reg = obs.MetricsRegistry()
+    c = reg.counter("reqs_total", labels={"e": "s"})
+    g = reg.gauge("depth")
+    h = reg.histogram("lat_seconds", labels={"e": "s"})
+    c.inc(10)
+    g.set(3.0)
+    for v in range(100):
+        h.observe(v / 100.0)
+    jsonl = tmp_path / "series.jsonl"
+    sam = obs.Sampler(registry=reg, store=obs.SeriesStore(),
+                      jsonl_path=str(jsonl), name="t")
+    n = sam.sample_once()
+    c.inc(5)
+    sam.sample_once()
+    store = sam.store
+    ck = obs.series_key("reqs_total", {"e": "s"})
+    assert store.kind(ck) == "counter"
+    assert [v for _, v in store.points(ck)] == [10.0, 15.0]
+    assert store.delta(ck, window_s=3600) == 5.0
+    assert store.last(obs.series_key("depth")) == 3.0
+    # histograms land as :p50/:p95/:p99 gauges + a :count counter
+    p99 = obs.series_key("lat_seconds", {"e": "s"}, field="p99")
+    cnt = obs.series_key("lat_seconds", {"e": "s"}, field="count")
+    assert store.last(p99) == pytest.approx(0.99)
+    assert store.kind(cnt) == "counter"
+    assert store.last(cnt) == 100.0
+    # the sampler observes itself (sweeps + series count)
+    assert sam.sweeps == 2
+    assert store.last(obs.series_key("series_count", {"sampler": "t"})) >= n
+    sam.close()  # drains the JSONL
+    lines = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    assert len(lines) == 2
+    assert lines[0]["event"] == "series_sample"
+    assert lines[0]["series"][ck] == 10.0
+    assert lines[1]["series"][ck] == 15.0
+    # persisted records carry the event log's dual clock stamps
+    assert "t" in lines[0] and "mono" in lines[0]
+
+
+def test_sampler_cadence_thread_and_bounded_store():
+    reg = obs.MetricsRegistry()
+    reg.gauge("depth").set(1.0)
+    with obs.Sampler(registry=reg, store=obs.SeriesStore(max_samples=4),
+                     interval_s=0.02, name="cad") as sam:
+        sam.start()
+        deadline = threading.Event()
+        for _ in range(200):
+            if sam.sweeps >= 6:
+                break
+            deadline.wait(0.02)
+        assert sam.sweeps >= 6
+    # bounded despite more sweeps than the ring holds
+    assert len(sam.store.points(obs.series_key("depth"))) <= 4
+
+
+# -- /seriesz -----------------------------------------------------------------
+
+
+def test_seriesz_endpoint_serves_the_installed_store():
+    reg = obs.MetricsRegistry()
+    reg.gauge("depth").set(7.0)
+    store = obs.SeriesStore()
+    sam = obs.Sampler(registry=reg, store=store, name="sz")
+    sam.sample_once()
+    sam.sample_once()
+    with obs.ObsServer(registry=reg, series_store=store) as srv:
+        body = json.loads(urllib.request.urlopen(
+            srv.url + "/seriesz", timeout=10).read())
+        assert body["series"][obs.series_key("depth")]["last"] == 7.0
+        assert body["series"][obs.series_key("depth")]["n"] == 2
+        # ?window_s bounds the returned points (a far-future-only window
+        # is empty but the key survives with n=0)
+        narrow = json.loads(urllib.request.urlopen(
+            srv.url + "/seriesz?window_s=0.000001", timeout=10).read())
+        assert narrow["window_s"] == pytest.approx(1e-6)
+    sam.close()
+
+
+def test_seriesz_404_until_a_store_is_installed():
+    reg = obs.MetricsRegistry()
+    assert obs.get_series_store() is None
+    with obs.ObsServer(registry=reg) as srv:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(srv.url + "/seriesz", timeout=10)
+        assert e.value.code == 404
+        # installing the process default makes the same endpoint live
+        store = obs.SeriesStore()
+        store.record("x", 1.0)
+        try:
+            obs.install_series_store(store)
+            body = json.loads(urllib.request.urlopen(
+                srv.url + "/seriesz", timeout=10).read())
+            assert "x" in body["series"]
+        finally:
+            obs.install_series_store(None)
+
+
+# -- fleet ingestion ----------------------------------------------------------
+
+
+def test_ingest_scrape_builds_replica_labeled_series():
+    s = obs.SeriesStore()
+    scrape = {"up": True, "ready": True, "queue_depth": 5, "inflight": 2,
+              "breaker_open": False, "slo_burn": 1.5, "requests_total": 42}
+    s.ingest_scrape("fleet", "r0", scrape, scrape_age_s=0.1)
+    s.ingest_scrape("fleet", "r0",
+                    {**scrape, "queue_depth": 9, "requests_total": 50},
+                    scrape_age_s=0.2)
+    labels = {"fleet": "fleet", "replica": "r0"}
+    qd = obs.series_key("fleet_replica_queue_depth", labels)
+    assert [v for _, v in s.points(qd)] == [5.0, 9.0]
+    assert s.last(obs.series_key("fleet_replica_slo_burn", labels)) == 1.5
+    assert s.last(obs.series_key("fleet_replica_up", labels)) == 1.0
+    rt = obs.series_key("fleet_replica_requests_total", labels)
+    assert s.kind(rt) == "counter"
+    assert s.delta(rt, window_s=3600) == 8.0
+    assert s.last(obs.series_key("fleet_scrape_age_s", labels)) \
+        == pytest.approx(0.2)
+    # a dead replica's scrape ({"up": False}) still records up=0 — the
+    # outage is visible in the history, not a gap
+    s.ingest_scrape("fleet", "r0", {"up": False, "error": "gone"})
+    assert s.last(obs.series_key("fleet_replica_up", labels)) == 0.0
+
+
+# -- eventlog loss instruments (satellite) ------------------------------------
+
+
+def test_eventlog_drops_and_queue_depth_ride_the_registry(tmp_path):
+    """EventLog.dropped was counted only on the object — invisible to
+    /metrics and to alerting. Now eventlog_dropped_total / queue depth are
+    registry instruments refreshed at scrape time."""
+    path = tmp_path / "drops_unique.jsonl"
+    log = obs.EventLog(str(path), queue_depth=3)
+    # stop the writer so the bound is hit deterministically, then overfill
+    log._stop.set()
+    log._writer.join(timeout=10)
+    for i in range(10):
+        log.write({"event": "e", "i": i})
+    assert log.dropped == 7
+    reg = obs.get_registry()  # EventLog publishes to the process registry
+    labels = {"log": "drops_unique.jsonl"}
+    snap = reg.snapshot()  # runs the collector → syncs the instruments
+    key = obs.series_key("eventlog_dropped_total", labels)
+    qkey = obs.series_key("eventlog_queue_depth", labels)
+    assert snap["counters"][key] == 7.0
+    assert snap["gauges"][qkey] == 3.0
+    log.close()  # drains the 3 buffered records, zeroes the gauge
+    assert reg.gauge("eventlog_queue_depth", labels=labels).value == 0.0
+    lines = path.read_text().splitlines()
+    assert len(lines) == 3
+    # the collector for a closed log drops itself from later exports
+    reg.snapshot()
+    assert reg.counter("eventlog_dropped_total", labels=labels).value == 7.0

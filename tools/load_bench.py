@@ -102,6 +102,17 @@ DEPLOY_KEYS = ("publish_every_s", "publishes", "swaps", "rejects",
 # throughput cost of full tracing (PERF.md §Tracing bar: <= 2% on CPU)
 TRACE_KEYS = ("ab_waves", "untraced_rps", "traced_rps", "overhead_pct",
               "spans_recorded")
+# the alerts block of a --series_jsonl run (null otherwise): the
+# timeseries+alerting ride-along — registry sampled on a cadence during the
+# sweep, context-default alert rules evaluated over the windowed series
+ALERT_KEYS = ("rules", "fired", "resolved", "firing_at_end",
+              "series_samples", "series_jsonl")
+# the series_ab block of a --series_ab run (null otherwise): sampler
+# overhead by the same paired-interleave methodology as --trace_ab
+# (PERF.md §Timeseries bar: <= 2% on CPU at the default cadence); --ab_null
+# runs both arms unsampled (the floor measurement)
+SERIES_AB_KEYS = ("ab_waves", "unsampled_rps", "sampled_rps",
+                  "overhead_pct", "interval_s", "null")
 
 
 def _pct(values: List[float], q: float) -> Optional[float]:
@@ -167,6 +178,69 @@ def _calibrate(submit, reqs, waves: int, wave_size: int):
     return rates[len(rates) // 2], lat if lat is not None else 0.01
 
 
+def _ab_rates(submit, reqs, waves: int, wave_size: int,
+              drain_timeout_s: float, set_arm) -> Dict[bool, List[float]]:
+    """The shared paired-interleave wave engine (PERF.md discipline):
+    closed-loop waves alternate the armed/disarmed condition AND the order
+    per pair (U,T then T,U — a null control measured a ~0.5% second-of-
+    pair bias on this host), so the per-pair ratios cancel slow drift."""
+    rates: Dict[bool, List[float]] = {False: [], True: []}
+    for w in range(2 * waves):
+        armed = bool(w % 2) ^ bool((w // 2) % 2)
+        set_arm(armed)
+        t0 = time.monotonic()
+        futs = [submit(reqs[i % len(reqs)]) for i in range(wave_size)]
+        for f in futs:
+            f.result(timeout=drain_timeout_s)
+        rates[armed].append(wave_size / (time.monotonic() - t0))
+    set_arm(False)
+    return rates
+
+
+def _paired_overhead(rates: Dict[bool, List[float]]):
+    """(disarmed median rps, armed median rps, paired overhead fraction):
+    the overhead is the median of per-adjacent-pair ratios, so host drift
+    cancels instead of inflating the arm medians."""
+    med = lambda v: sorted(v)[len(v) // 2]
+    paired = med([1.0 - t / u
+                  for u, t in zip(rates[False], rates[True])])
+    return med(rates[False]), med(rates[True]), paired
+
+
+def _series_ab(submit, reqs, waves: int, wave_size: int,
+               drain_timeout_s: float, interval_s: float,
+               null: bool) -> Dict:
+    """Sampler-overhead A/B: armed waves run a live Sampler at
+    ``interval_s`` over the process registry (the full instrument sweep +
+    store append path), disarmed waves run none. ``null`` arms NOTHING in
+    either arm — the floor measurement the overhead claim is judged
+    against."""
+    import perceiver_io_tpu.obs as obs
+
+    state = {"sampler": None}
+
+    def set_arm(armed: bool) -> None:
+        if state["sampler"] is not None:
+            state["sampler"].close()
+            state["sampler"] = None
+        if armed and not null:
+            state["sampler"] = obs.Sampler(
+                store=obs.SeriesStore(), interval_s=interval_s,
+                name="series_ab").start()
+
+    rates = _ab_rates(submit, reqs, waves, wave_size, drain_timeout_s,
+                      set_arm)
+    unsampled, sampled, paired = _paired_overhead(rates)
+    return {
+        "ab_waves": waves,
+        "unsampled_rps": round(unsampled, 3),
+        "sampled_rps": round(sampled, 3),
+        "overhead_pct": round(100.0 * paired, 3),
+        "interval_s": interval_s,
+        "null": null,
+    }
+
+
 def _trace_ab(submit, reqs, waves: int, wave_size: int,
               drain_timeout_s: float) -> Dict:
     """Same-process INTERLEAVED traced-vs-untraced A/B (the PERF.md
@@ -182,23 +256,12 @@ def _trace_ab(submit, reqs, waves: int, wave_size: int,
     tmp = tempfile.NamedTemporaryFile(prefix="load_bench_trace_",
                                       suffix=".jsonl", delete=False)
     tmp.close()
-    rates: Dict[bool, List[float]] = {False: [], True: []}
     spans = 0
     try:
-        for w in range(2 * waves):
-            # interleaved AND order-alternating per pair (U,T then T,U):
-            # a null-control run (both arms identical) measured the
-            # second-of-pair wave systematically ~0.5% slower on this
-            # host, so a fixed order would bias the paired estimate by
-            # exactly that much
-            traced = bool(w % 2) ^ bool((w // 2) % 2)
-            obs.configure_event_log(tmp.name if traced else None)
-            t0 = time.monotonic()
-            futs = [submit(reqs[i % len(reqs)]) for i in range(wave_size)]
-            for f in futs:
-                f.result(timeout=drain_timeout_s)
-            rates[traced].append(wave_size / (time.monotonic() - t0))
-        obs.configure_event_log(None)
+        rates = _ab_rates(
+            submit, reqs, waves, wave_size, drain_timeout_s,
+            lambda traced: obs.configure_event_log(
+                tmp.name if traced else None))
         with open(tmp.name) as f:
             for line in f:
                 rec = json.loads(line)
@@ -213,12 +276,7 @@ def _trace_ab(submit, reqs, waves: int, wave_size: int,
         # process-wide log writing into the inode unlinked below
         obs.configure_event_log(None)
         os.unlink(tmp.name)
-    med = lambda v: sorted(v)[len(v) // 2]
-    untraced, traced_rps = med(rates[False]), med(rates[True])
-    # each traced wave paired with the untraced wave adjacent in time:
-    # the per-pair ratio cancels the slow drift a shared host smears
-    # across the run (arm medians would absorb it as ±severalx the signal)
-    paired = med([1.0 - t / u for u, t in zip(rates[False], rates[True])])
+    untraced, traced_rps, paired = _paired_overhead(rates)
     return {
         "ab_waves": waves,
         "untraced_rps": round(untraced, 3),
@@ -426,6 +484,31 @@ def main() -> None:
                           "(overhead_pct must stay <= 2 on CPU)")
     trc.add_argument("--trace_ab_waves", type=int, default=6,
                      help="waves per arm of the A/B")
+    ser = parser.add_argument_group(
+        "metrics time-series + alerting (perceiver_io_tpu.obs.timeseries)")
+    ser.add_argument("--series_jsonl", default=None, metavar="PATH",
+                     help="ride-along: sample every registry instrument "
+                          "into a bounded series store each "
+                          "--series_interval_s during the sweep, persist "
+                          "the samples here (rotating JSONL), and evaluate "
+                          "context-default alert rules (queue-depth "
+                          "threshold + shed-rate) over the windowed "
+                          "series; the record gains an 'alerts' block "
+                          "(fired/resolved counts)")
+    ser.add_argument("--series_interval_s", type=float, default=0.5,
+                     help="sampling + alert-evaluation cadence for the "
+                          "ride-along (sweeps are short; serving defaults "
+                          "to 1 s)")
+    ser.add_argument("--series_ab", action="store_true",
+                     help="measure sampler overhead: same-process "
+                          "INTERLEAVED sampled/unsampled closed-loop waves "
+                          "(the --trace_ab methodology); the record gains "
+                          "a 'series_ab' block (overhead_pct must stay "
+                          "<= 2 on CPU at the default cadence)")
+    ser.add_argument("--ab_null", action="store_true",
+                     help="null control for --series_ab: BOTH arms run "
+                          "unsampled — measures the host noise floor the "
+                          "overhead verdict is judged against")
     args = parser.parse_args()
 
     if args.dry:
@@ -435,9 +518,10 @@ def main() -> None:
             "duration_s": args.duration_s,
             "point_keys": list(POINT_KEYS), "phase_keys": list(PHASE_KEYS),
             "fleet_keys": list(FLEET_KEYS), "deploy_keys": list(DEPLOY_KEYS),
-            "trace_keys": list(TRACE_KEYS),
+            "trace_keys": list(TRACE_KEYS), "alert_keys": list(ALERT_KEYS),
+            "series_ab_keys": list(SERIES_AB_KEYS),
             "sweep": [], "capacity": None, "fleet": None, "deploy": None,
-            "trace": None,
+            "trace": None, "alerts": None, "series_ab": None,
         }
         emit_json_line(record)
         return
@@ -578,10 +662,59 @@ def main() -> None:
                                  args.calibration_wave_size,
                                  args.drain_timeout_s)
         _log(f"trace A/B: {json.dumps(trace_record)}")
+    series_ab_record = None
+    if args.series_ab:
+        series_ab_record = _series_ab(
+            submit, reqs, args.trace_ab_waves, args.calibration_wave_size,
+            args.drain_timeout_s, args.series_interval_s, args.ab_null)
+        _log(f"series A/B: {json.dumps(series_ab_record)}")
     if args.events_jsonl:
         # configured AFTER the A/B (which owns the global log while it
         # runs): the sweep itself records spans at every hop
         obs.configure_event_log(args.events_jsonl)
+
+    # -- timeseries + alerting ride-along (--series_jsonl) -------------------
+    sampler = alert_engine = None
+    if args.series_jsonl:
+        store = obs.SeriesStore()
+        sampler = obs.Sampler(
+            store=store, interval_s=args.series_interval_s,
+            jsonl_path=args.series_jsonl, name="load_bench").start()
+        qthresh = float(max(4, (queue_limit or 64) // 2))
+        window = max(4 * args.series_interval_s, 2.0)
+        common = dict(window_s=window, severity="warn",
+                      resolve_threshold=qthresh / 2)
+        if args.replicas > 0:
+            # fleet gauges are per-replica labeled: a bare-name rule fires
+            # per replica; sheds count at the router's admission edge
+            rules = [
+                obs.AlertRule(name="replica_queue_depth",
+                              metric="fleet_replica_queue_depth",
+                              threshold=qthresh, agg="max", **common),
+                obs.AlertRule(name="router_shed_rate",
+                              metric="router_shed_total", kind="rate",
+                              threshold=0.0, window_s=window,
+                              severity="warn"),
+            ]
+        else:
+            rules = [
+                obs.AlertRule(name="queue_depth",
+                              metric=obs.series_key(
+                                  "serving_queue_depth",
+                                  {"engine": "load_bench"}),
+                              threshold=qthresh, agg="max", **common),
+                obs.AlertRule(name="shed_rate",
+                              metric="serving_shed_total", kind="rate",
+                              threshold=0.0, window_s=window,
+                              severity="warn"),
+            ]
+        alert_engine = obs.AlertEngine(
+            store, rules, interval_s=args.series_interval_s,
+            name="load_bench").start()
+        _log(f"series ride-along: sampling every "
+             f"{args.series_interval_s:g}s -> {args.series_jsonl}; "
+             f"{len(rules)} alert rule(s): "
+             f"{', '.join(r.name for r in rules)}")
 
     # -- continuous-deployment ride-along (--publish_every_s) ----------------
     deploy_stack = None
@@ -754,6 +887,25 @@ def main() -> None:
         }
         _log(f"fleet: {json.dumps(fleet_record)}")
 
+    alerts_record = None
+    if sampler is not None:
+        # one final sample + evaluation tick so an episode that ended with
+        # the sweep still resolves into the counters before teardown
+        sampler.sample_once()
+        alert_engine.evaluate()
+        st = alert_engine.stats()
+        alerts_record = {
+            "rules": st["rules"],
+            "fired": st["fired"],
+            "resolved": st["resolved"],
+            "firing_at_end": sum(len(v) for v in st["firing"].values()),
+            "series_samples": sampler.sweeps,
+            "series_jsonl": args.series_jsonl,
+        }
+        alert_engine.close()
+        sampler.close()  # drains the series JSONL to disk
+        _log(f"alerts: {json.dumps(alerts_record)}")
+
     if engine is not None:
         ratio = registry.gauge(
             "serving_phase_sum_ratio", labels={"engine": "load_bench"}).value
@@ -775,6 +927,8 @@ def main() -> None:
         "fleet": fleet_record,
         "deploy": deploy_record,
         "trace": trace_record,
+        "alerts": alerts_record,
+        "series_ab": series_ab_record,
     }
     if args.events_jsonl:
         obs.configure_event_log(None)  # flush + release the sweep's log
