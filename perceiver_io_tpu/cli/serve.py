@@ -61,6 +61,19 @@ latents, and a dead pin surfaces as a re-encode. ``--rolling_swap_step``
 rolls the fleet to another checkpoint step one replica at a time with
 auto-rollback on post-swap SLO burn/breaker regression.
 
+``--autoscale`` (fleet mode) closes the serving control loop
+(``perceiver_io_tpu.serving.autoscale``, PERF.md §Autoscale): an
+``Autoscaler`` grows/shrinks the supervised fleet between
+``--min_replicas`` and ``--max_replicas`` from the windowed SLO-burn and
+queue series the router's scrape loop maintains, seeded by the measured
+``--autoscale_rps_per_replica`` capacity fit — hold-down + hysteresis so a
+bursty minute never flaps the fleet, scale-down only via graceful
+drain-then-retire (``lost_accepted`` stays 0), capped exponential backoff
+on failed spawns. ``--priority_classes``/``--client_quota_rps`` add
+admission control at the router's front door: weighted-fair dispatch
+across service classes and per-client token buckets, so one bursting
+client degrades its own SLO class while other classes' p99 stays flat.
+
 ``--watch_checkpoints DIR`` closes the train→serve loop
 (``perceiver_io_tpu.deploy``, PERF.md §Deployment): the process polls DIR
 (a trainer's ``publish_dir``) for atomically-published checkpoints, runs
@@ -240,6 +253,60 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--rolling_burn_threshold", type=float, default=2.0,
                    help="post-swap SLO burn rate above which the rollout "
                         "rolls back")
+    a = parser.add_argument_group(
+        "elastic autoscaling + admission control (fleet mode; "
+        "perceiver_io_tpu.serving.autoscale / .admission)")
+    a.add_argument("--autoscale", action="store_true",
+                   help="with --replicas: run the serving control loop — "
+                        "an Autoscaler spawns/retires supervised replica "
+                        "processes from the windowed fleet SLO-burn and "
+                        "queue series (hold-down + hysteresis, scale-down "
+                        "only via graceful drain-then-retire, capped "
+                        "exponential backoff on failed spawns). Requires "
+                        "--autoscale_rps_per_replica — seed it from a "
+                        "measured tools/load_bench.py capacity fit, never "
+                        "a guess")
+    a.add_argument("--autoscale_rps_per_replica", type=float, default=None,
+                   metavar="RPS",
+                   help="measured requests/s one replica sustains at the "
+                        "SLO (fit_capacity's slo_sustainable_rps over the "
+                        "sweep's replica count)")
+    a.add_argument("--min_replicas", type=int, default=1,
+                   help="autoscale floor")
+    a.add_argument("--max_replicas", type=int, default=None,
+                   help="autoscale ceiling (default: 2x --replicas)")
+    a.add_argument("--autoscale_interval_s", type=float, default=1.0,
+                   help="control-loop tick cadence")
+    a.add_argument("--priority_classes", default=None, metavar="SPEC",
+                   help="admission control: comma-separated "
+                        "'name:weight' service classes (e.g. "
+                        "'gold:8,silver:4,bronze:1' — first is the "
+                        "default class). Admitted requests dispatch in "
+                        "weighted-fair order; each class owns a weight-"
+                        "proportional share of --admission_queue_limit, "
+                        "so one bursting class sheds in ITS share while "
+                        "other classes' tail stays flat")
+    a.add_argument("--client_quota_rps", type=float, default=None,
+                   help="per-client token-bucket rate (each distinct "
+                        "client id draws from its own bucket; over-quota "
+                        "requests shed with a reasoned RejectedError that "
+                        "burns the CLIENT'S class SLO only)")
+    a.add_argument("--client_quota_burst", type=float, default=None,
+                   help="token-bucket burst ceiling (default: 2x the "
+                        "rate)")
+    a.add_argument("--admission_queue_limit", type=int, default=256,
+                   help="total WFQ queue slots split weight-"
+                        "proportionally across the priority classes")
+    a.add_argument("--request_client", default=None, metavar="ID",
+                   help="client id THIS process's requests present at the "
+                        "admission gate (they draw that client's token "
+                        "bucket; omitted = quota-exempt operator traffic). "
+                        "Several serve processes with different ids "
+                        "compose into a multi-tenant front")
+    a.add_argument("--request_priority", default=None, metavar="CLASS",
+                   help="priority class this process's requests ride in "
+                        "(default: the admission controller's default "
+                        "class)")
     d = parser.add_argument_group(
         "continuous deployment (perceiver_io_tpu.deploy; PERF.md "
         "§Deployment)")
@@ -378,6 +445,19 @@ def main(argv: Optional[Sequence[str]] = None):
     args = build_parser().parse_args(argv)
     if not args.texts and not args.stdin:  # catches omitted AND empty --texts
         raise SystemExit("nothing to serve: pass --texts ... or --stdin")
+    if args.autoscale:
+        if args.replicas <= 0:
+            raise SystemExit("--autoscale needs --replicas N (the control "
+                             "loop lives at the router tier)")
+        if not args.autoscale_rps_per_replica:
+            raise SystemExit(
+                "--autoscale needs --autoscale_rps_per_replica — seed it "
+                "from a measured tools/load_bench.py capacity fit "
+                "(slo_sustainable_rps / replicas), never a guess")
+    if (args.priority_classes or args.client_quota_rps) \
+            and args.replicas <= 0:
+        raise SystemExit("--priority_classes/--client_quota_rps need "
+                         "--replicas N (admission lives at the router)")
 
     # drain handlers go in FIRST: a SIGTERM during the checkpoint load /
     # warmup must already mean "graceful exit 0", not the default kill
@@ -784,6 +864,37 @@ def _serve_fleet(args, drain_state):
                        "--events_max_mb", str(args.events_max_mb)])
 
         sup_kw["argv_builder"] = _replica_argv
+    admission = None
+    if args.priority_classes or args.client_quota_rps:
+        from perceiver_io_tpu.serving import (
+            AdmissionController,
+            parse_priority_classes,
+        )
+
+        quota = None
+        if args.client_quota_rps:
+            # TokenBucket requires burst >= 1: the 2x-rate default would
+            # crash a sub-0.5 req/s quota at startup
+            quota = (args.client_quota_rps,
+                     args.client_quota_burst
+                     or max(1.0, 2 * args.client_quota_rps))
+        classes = (parse_priority_classes(args.priority_classes)
+                   if args.priority_classes else None)
+        slo = None
+        if args.slo_p99_ms is not None:
+            import perceiver_io_tpu.obs as obs
+
+            slo = obs.SLO(latency_target_s=args.slo_p99_ms / 1e3,
+                          availability_target=args.slo_availability,
+                          name="serve", burn_alert=None)
+        admission = AdmissionController(
+            classes=classes, quota=quota, slo=slo,
+            queue_limit=args.admission_queue_limit, name="serve")
+        print("serve: admission control — classes "
+              f"{sorted(admission.classes)} (default "
+              f"{admission.default_class!r})"
+              + (f", per-client quota {quota[0]:g} req/s burst {quota[1]:g}"
+                 if quota else ""), file=sys.stderr, flush=True)
     with ReplicaSupervisor(count=args.replicas, extra_args=extra,
                            cpu=args.cpu, **sup_kw) as sup:
         clients = sup.start()
@@ -792,8 +903,34 @@ def _serve_fleet(args, drain_state):
         sup.wait_ready(timeout_s=600.0)
         with Router(clients, name="serve",
                     queue_limit=args.queue_limit,
-                    trace_sample=args.trace_sample) as router:
+                    trace_sample=args.trace_sample,
+                    admission=admission) as router:
             router.refresh()
+            autoscaler = None
+            if args.autoscale:
+                from perceiver_io_tpu.serving import (
+                    Autoscaler,
+                    AutoscalePolicy,
+                    SupervisorPool,
+                )
+
+                policy = AutoscalePolicy(
+                    rps_per_replica=args.autoscale_rps_per_replica,
+                    min_replicas=args.min_replicas,
+                    max_replicas=args.max_replicas or 2 * args.replicas,
+                    drain_timeout_s=args.drain_timeout_s,
+                )
+                autoscaler = Autoscaler(
+                    router,
+                    SupervisorPool(sup,
+                                   drain_timeout_s=args.drain_timeout_s),
+                    policy,
+                    interval_s=args.autoscale_interval_s).start()
+                print(f"serve: autoscaling fleet [{policy.min_replicas}, "
+                      f"{policy.max_replicas}] at "
+                      f"{policy.rps_per_replica:g} req/s/replica "
+                      f"(tick {args.autoscale_interval_s:g}s)",
+                      file=sys.stderr, flush=True)
             deployer = None
             if args.watch_checkpoints:
                 from perceiver_io_tpu.deploy import RouterSwapTarget
@@ -812,6 +949,10 @@ def _serve_fleet(args, drain_state):
                         burn_threshold=args.rolling_burn_threshold),
                 )
             pending = []  # (text, future-or-None, n_masks)
+            # the admission identity this process's requests present at
+            # the gate (quota bucket + service class)
+            adm_kw = {"client": args.request_client,
+                      "priority": args.request_priority}
 
             def submit(text):
                 ids, pad, mask_pos, positions = prepare(text)
@@ -828,10 +969,10 @@ def _serve_fleet(args, drain_state):
                     # the latents.
                     session = f"t{len(pending)}"
                     enc = router.submit(ids, pad, kind="encode",
-                                        session=session)
+                                        session=session, **adm_kw)
                     fut = (session, ids, pad, positions, enc)
                 else:
-                    fut = router.submit(ids, pad, positions)
+                    fut = router.submit(ids, pad, positions, **adm_kw)
                 pending.append((text, fut, len(mask_pos)))
 
             def resolve(fut, n_masks):
@@ -841,14 +982,15 @@ def _serve_fleet(args, drain_state):
                 enc.result(timeout=600)  # pin established
                 try:
                     logits = router.decode(positions, session=session,
-                                           timeout=600)
+                                           timeout=600, **adm_kw)
                 except AffinityLost:
                     # the pinned replica (and its latents) died:
                     # re-encode on a live replica — which re-pins —
                     # and decode there (spill-on-death)
-                    router.encode(ids, pad, session=session, timeout=600)
+                    router.encode(ids, pad, session=session, timeout=600,
+                                  **adm_kw)
                     logits = router.decode(positions, session=session,
-                                           timeout=600)
+                                           timeout=600, **adm_kw)
                 return topk(logits, n_masks)
 
             try:
@@ -886,10 +1028,18 @@ def _serve_fleet(args, drain_state):
                 if args.stats:
                     print(f"serve: fleet stats {json.dumps(router.stats())}",
                           file=sys.stderr)
+                    if autoscaler is not None:
+                        print("serve: autoscale stats "
+                              f"{json.dumps(autoscaler.stats())}",
+                              file=sys.stderr)
             finally:
-                # the drain contract extends to the deployment loop: an
-                # in-progress ROLLING swap completes or rolls the fleet back
-                # before teardown — never a half-swapped fleet
+                # the control loop stops FIRST (no scale action may race
+                # the teardown), then the drain contract extends to the
+                # deployment loop: an in-progress ROLLING swap completes
+                # or rolls the fleet back before teardown — never a
+                # half-swapped fleet
+                if autoscaler is not None:
+                    autoscaler.close()
                 _stop_deployer(deployer, args.drain_timeout_s)
             # graceful fleet teardown: replicas finish accepted work before
             # the supervisor's quit/terminate sequence

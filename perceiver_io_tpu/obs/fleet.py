@@ -137,6 +137,11 @@ class ReplicaGauges:
         self._reg = registry if registry is not None else get_registry()
         self._fleet = fleet
         self._per: Dict[str, Dict[str, Any]] = {}
+        # retired replicas are TOMBSTONED: a scrape sweep that snapshotted
+        # the fleet before a removal must not resurrect the retired
+        # replica's gauges by publishing after remove() (they would export
+        # their last values forever); re-admission lifts the tombstone
+        self._retired: set = set()
         self._m_size = self._reg.gauge(
             "fleet_size", "replicas the router knows about",
             {"fleet": fleet})
@@ -181,10 +186,29 @@ class ReplicaGauges:
         return g
 
     def publish(self, replica: str, **values: float) -> None:
+        if replica in self._retired:
+            return  # a racing post-removal sweep must not resurrect it
         g = self._gauges(replica)
         for key, val in values.items():
             if key in g and val is not None:
                 g[key].set(float(val))
+
+    def readmit(self, replica: str) -> None:
+        """Lift a retirement tombstone (the replica re-joined the fleet)."""
+        self._retired.discard(replica)
+
+    def remove(self, replica: str) -> None:
+        """Retire a replica's per-replica gauges from the registry (the
+        scale-down path: a drained-and-retired replica must leave
+        ``/metrics``, not export its last queue depth forever). The name is
+        tombstoned so a scrape sweep racing the removal cannot re-register
+        them."""
+        self._retired.add(replica)
+        g = self._per.pop(replica, None)
+        if g is None:
+            return
+        for inst in g.values():
+            self._reg.remove(inst.name, inst.label_dict)
 
     def publish_fleet(self, size: int, serving: int) -> None:
         self._m_size.set(size)

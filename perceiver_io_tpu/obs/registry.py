@@ -270,6 +270,21 @@ class MetricsRegistry:
                   window: int = 4096) -> Histogram:
         return self._get(Histogram, name, help, labels, window=window)
 
+    def remove(self, name: str,
+               labels: Optional[Dict[str, str]] = None) -> bool:
+        """Drop ONE ``(name, labels)`` instrument from the exposition;
+        returns whether it existed. For bounded-lifecycle label sets only —
+        a retired replica's per-replica gauges must leave ``/metrics``
+        instead of exporting its last values forever. The name's KIND stays
+        reserved (a later re-registration of the same name as a different
+        type still raises), and any live reference a producer still holds
+        keeps working — it just no longer exports."""
+        name = sanitize_metric_name(name)
+        key = (name, tuple(sorted((str(k), str(v))
+                                  for k, v in (labels or {}).items())))
+        with self._lock:
+            return self._instruments.pop(key, None) is not None
+
     def _sorted_instruments(self) -> List[_Instrument]:
         with self._lock:
             return [self._instruments[k] for k in sorted(self._instruments)]

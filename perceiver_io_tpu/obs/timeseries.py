@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from perceiver_io_tpu.obs.registry import (
     MetricsRegistry,
+    _escape_label,
     _label_suffix,
     get_registry,
     sanitize_metric_name,
@@ -176,6 +177,21 @@ class SeriesStore:
         if scrape_age_s is not None:
             self.record(series_key("fleet_scrape_age_s", labels),
                         float(scrape_age_s), "gauge")
+
+    def forget(self, labels: Dict[str, str]) -> int:
+        """Drop every series whose key carries ALL the given label pairs;
+        returns how many were dropped. The scale-down path: a drained-and-
+        retired replica's history must leave the fleet store — the
+        autoscaler and the rollout bake query by bare instrument name, and
+        a ghost replica's frozen series would keep matching forever."""
+        frags = ['%s="%s"' % (str(k), _escape_label(str(v)))
+                 for k, v in labels.items()]
+        with self._lock:
+            doomed = [key for key in self._series
+                      if all(f in key for f in frags)]
+            for key in doomed:
+                del self._series[key]
+        return len(doomed)
 
     # -- reading -------------------------------------------------------------
 

@@ -72,6 +72,13 @@ SITES = (
     "deploy.publish",
     "deploy.gate",
     "deploy.swap",
+    # the serving control loop (perceiver_io_tpu.serving): the autoscaler's
+    # actuation edge (raise = a spawn/retire failing — the backoff drill:
+    # PIT_FAULTS="autoscale.scale:transient@1" fails the first spawn) and
+    # the router's admission gate (raise/hang inside admit, before any
+    # queue slot or token is consumed)
+    "autoscale.scale",
+    "router.admit",
 )
 _SUFFIXED = ("engine.dispatch", "engine.complete")
 

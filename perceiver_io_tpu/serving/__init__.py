@@ -17,10 +17,30 @@ graceful drain — into redundancy:
   transparent failover (zero lost accepted requests when a replica dies),
   latent-cache affinity with spill-on-death, graceful drain, and rolling
   rollout with fleet-wide auto-rollback.
+- :mod:`admission` — the router's front-door policy: priority classes,
+  per-client token-bucket quotas, and weighted-fair queueing, so one
+  bursting client degrades its own SLO class instead of the fleet's.
+- :mod:`autoscale` — the actuation half of the control loop: an
+  ``Autoscaler`` drives replica spawn / drain-then-retire from the
+  windowed SLO-burn and queue series in the router's fleet store, seeded
+  by the measured per-replica capacity fit, with hold-down + hysteresis
+  so a bursty minute never flaps the fleet.
 
 Importing this package never initializes a jax backend.
 """
 
+from perceiver_io_tpu.serving.admission import (
+    AdmissionController,
+    PriorityClass,
+    TokenBucket,
+    parse_priority_classes,
+)
+from perceiver_io_tpu.serving.autoscale import (
+    Autoscaler,
+    AutoscalePolicy,
+    CallbackPool,
+    SupervisorPool,
+)
 from perceiver_io_tpu.serving.replica import (
     HttpReplicaClient,
     LocalReplica,
@@ -35,8 +55,13 @@ from perceiver_io_tpu.serving.supervisor import (
 )
 
 __all__ = [
+    "AdmissionController",
+    "Autoscaler",
+    "AutoscalePolicy",
+    "CallbackPool",
     "HttpReplicaClient",
     "LocalReplica",
+    "PriorityClass",
     "RemoteEngineError",
     "ReplicaApp",
     "ReplicaServer",
@@ -44,5 +69,8 @@ __all__ = [
     "Router",
     "RouterClosed",
     "RouterFuture",
+    "SupervisorPool",
+    "TokenBucket",
     "default_replica_argv",
+    "parse_priority_classes",
 ]

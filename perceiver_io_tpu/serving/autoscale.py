@@ -1,0 +1,507 @@
+"""Elastic autoscaling: the actuation half of the serving control loop.
+
+Everything before this watched: r11 fit the capacity model
+(``obs.slo.fit_capacity``), r15 attributed the tail, r16 stored the windowed
+burn/queue signals in the router's fleet series store. This module ACTS on
+them — an :class:`Autoscaler` drives replica spawn/retire from the live
+series so the fleet tracks offered load instead of being sized for the peak:
+
+- **signals** come from the router's :class:`~perceiver_io_tpu.obs.
+  timeseries.SeriesStore` (the scrape loop's per-replica history): demand as
+  the windowed counter rate of ``fleet_replica_requests_total`` summed over
+  replicas, pressure as the windowed max of ``fleet_replica_slo_burn`` and
+  the per-replica mean of ``fleet_replica_queue_depth`` — a HISTORY, never a
+  point read (the r16 bake lesson: a spike between polls still counts).
+- **the policy** (:class:`AutoscalePolicy`) is seeded by the capacity fit:
+  ``rps_per_replica`` is exactly what :func:`fit_capacity` measured one
+  replica sustaining at the SLO (``AutoscalePolicy.from_capacity``). Demand
+  over ``rps_per_replica × target_utilization`` sets the desired count;
+  burn/queue pressure forces an up-step even when the demand estimate lags.
+- **hold-down + hysteresis** in the r16 ``AlertRule`` style: an up (down)
+  condition must hold continuously for ``hold_up_s`` (``hold_down_s``)
+  before acting, scale-down engages only below ``scale_down_utilization`` —
+  strictly under the scale-UP target, so the two thresholds open a dead band
+  a bursty minute oscillates inside without flapping the fleet — and each
+  action starts a cooldown. Scale-up holds short and cools briefly (capacity
+  missing is an SLO burn); scale-down holds long and cools long (capacity
+  idling is only money).
+- **scale-down is drain-then-retire only**: the victim leaves the router's
+  placement (``drain_replica(detach=True)`` — finishes every accepted
+  request, then its gauges and series leave the fleet store), and only then
+  does the pool reap the process. ``lost_accepted`` stays 0 across every
+  scale event, which is the acceptance bar.
+- **failed spawns back off, capped-exponentially** (``resilience.
+  RetryPolicy``): a spawn that raises (the ``autoscale.scale`` fault site,
+  or a real fork failure) defers the next attempt instead of hammering — and
+  the fleet NEVER flaps in response, because backoff gates only the
+  actuation, not the desired-count estimate.
+
+Actuation targets a tiny pool surface (``spawn() -> client`` /
+``retire(name)``): :class:`SupervisorPool` adapts the r12
+:class:`~perceiver_io_tpu.serving.supervisor.ReplicaSupervisor` (real
+processes; a spawned replica JOINs through the router's readiness gate and
+takes traffic only once warm), :class:`CallbackPool` adapts in-process
+fleets (tests, ``tools/load_bench.py --autoscale``).
+
+Every decision lands in the event log (``autoscale_decision``), trace-linked
+through the router's latency-histogram exemplars — "why did the fleet grow
+at 14:07" resolves to the assembled traces that were burning the SLO.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import perceiver_io_tpu.obs as obs
+from perceiver_io_tpu.resilience import RetryPolicy, faults
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalePolicy",
+    "CallbackPool",
+    "SupervisorPool",
+]
+
+FAULT_SITE = "autoscale.scale"
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """The declarative control policy.
+
+    ``rps_per_replica`` is the measured requests/s ONE replica sustains at
+    the SLO — seed it from the capacity fit (:meth:`from_capacity`), never
+    a guess. Desired count = demand / (``rps_per_replica`` ×
+    ``target_utilization``), clamped to [``min_replicas``,
+    ``max_replicas``]. ``up_burn`` / ``queue_high`` are the pressure
+    overrides (scale up even when the demand estimate lags reality);
+    ``scale_down_utilization`` < ``target_utilization`` and ``down_burn``
+    < ``up_burn`` are the hysteresis gaps, and the hold/cooldown pairs are
+    the flap dampers (AlertRule ``for_s`` semantics: the condition must
+    hold CONTINUOUSLY, a one-tick spike re-arms the timer).
+    """
+
+    rps_per_replica: float
+    min_replicas: int = 1
+    max_replicas: int = 8
+    target_utilization: float = 0.7
+    scale_down_utilization: float = 0.45
+    up_burn: float = 1.0
+    down_burn: float = 0.5
+    queue_high: float = 8.0
+    window_s: float = 5.0
+    hold_up_s: float = 1.0
+    hold_down_s: float = 5.0
+    cooldown_up_s: float = 2.0
+    cooldown_down_s: float = 10.0
+    max_step: int = 2
+    drain_timeout_s: float = 30.0
+
+    def __post_init__(self):
+        if self.rps_per_replica <= 0:
+            raise ValueError(
+                f"rps_per_replica must be positive, got "
+                f"{self.rps_per_replica} — seed it from fit_capacity()")
+        if self.min_replicas < 1 or self.max_replicas < self.min_replicas:
+            raise ValueError(
+                f"need 1 <= min_replicas <= max_replicas, got "
+                f"[{self.min_replicas}, {self.max_replicas}]")
+        if not 0.0 < self.target_utilization <= 1.0:
+            raise ValueError(
+                f"target_utilization must lie in (0, 1], got "
+                f"{self.target_utilization}")
+        if not 0.0 < self.scale_down_utilization < self.target_utilization:
+            # the hysteresis dead band: scale-down must engage strictly
+            # below the scale-up target or the fleet flaps on the boundary
+            raise ValueError(
+                f"scale_down_utilization ({self.scale_down_utilization}) "
+                f"must sit strictly below target_utilization "
+                f"({self.target_utilization}) — the gap is the anti-flap "
+                f"dead band")
+        if self.down_burn > self.up_burn:
+            raise ValueError(
+                f"down_burn ({self.down_burn}) must not exceed up_burn "
+                f"({self.up_burn}) — hysteresis opens against the firing "
+                f"direction")
+        if (self.hold_up_s < 0 or self.hold_down_s < 0
+                or self.cooldown_up_s < 0 or self.cooldown_down_s < 0):
+            raise ValueError("hold/cooldown durations must be >= 0")
+        if self.window_s <= 0:
+            raise ValueError(f"window_s must be positive, got {self.window_s}")
+        if self.max_step < 1:
+            raise ValueError(f"max_step must be >= 1, got {self.max_step}")
+
+    @staticmethod
+    def from_capacity(fit: Dict[str, Any], replicas_measured: int = 1,
+                      **overrides) -> "AutoscalePolicy":
+        """Seed the policy from a :func:`~perceiver_io_tpu.obs.slo.
+        fit_capacity` record: per-replica sustainable rate = the SLO-
+        sustainable fit (falling back knee → capacity) over the replica
+        count the sweep measured."""
+        rps = (fit.get("slo_sustainable_rps") or fit.get("knee_rps")
+               or fit.get("capacity_rps") or 0.0)
+        return AutoscalePolicy(
+            rps_per_replica=float(rps) / max(replicas_measured, 1),
+            **overrides)
+
+
+class SupervisorPool:
+    """Actuation over a :class:`~perceiver_io_tpu.serving.supervisor.
+    ReplicaSupervisor`: spawn returns the new client IMMEDIATELY (the
+    router's JOINING gate keeps traffic off it until the warm pool is
+    live), retire reaps an already-router-drained replica."""
+
+    def __init__(self, supervisor, drain_timeout_s: float = 30.0):
+        self.supervisor = supervisor
+        self.drain_timeout_s = drain_timeout_s
+
+    def spawn(self):
+        return self.supervisor.add_replica()
+
+    def retire(self, name: str) -> None:
+        self.supervisor.retire(name, drain_timeout_s=self.drain_timeout_s)
+
+
+class CallbackPool:
+    """Actuation over caller-supplied functions (in-process fleets:
+    ``spawn_fn() -> client``, ``retire_fn(name)``)."""
+
+    def __init__(self, spawn_fn: Callable[[], Any],
+                 retire_fn: Optional[Callable[[str], None]] = None):
+        self.spawn_fn = spawn_fn
+        self.retire_fn = retire_fn
+
+    def spawn(self):
+        return self.spawn_fn()
+
+    def retire(self, name: str) -> None:
+        if self.retire_fn is not None:
+            self.retire_fn(name)
+
+
+class Autoscaler:
+    """Drives a router's fleet between ``min_replicas`` and
+    ``max_replicas`` from the fleet series store. ``tick()`` is the
+    deterministic unit (injectable ``now`` for tests); ``start()`` runs it
+    on a daemon thread every ``interval_s``."""
+
+    # pitlint PIT-LOCK: decision/accounting state is written by the tick
+    # (control thread) and read by stats() callers
+    _guarded_by = {
+        "_counts": "_lock",
+        "_replica_seconds": "_lock",
+    }
+
+    def __init__(
+        self,
+        router,
+        pool,
+        policy: AutoscalePolicy,
+        interval_s: float = 0.5,
+        spawn_backoff: Optional[RetryPolicy] = None,
+        registry: Optional[obs.MetricsRegistry] = None,
+        name: Optional[str] = None,
+    ):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be positive, got {interval_s}")
+        self.router = router
+        self.pool = pool
+        self.policy = policy
+        self.interval_s = interval_s
+        self.name = name if name is not None else router.name
+        self._backoff = spawn_backoff or RetryPolicy(
+            max_retries=8, base_s=0.5, max_s=30.0)
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._replica_seconds = 0.0
+        # hold-down state (AlertRule for_s semantics)
+        self._up_since: Optional[float] = None
+        self._down_since: Optional[float] = None
+        self._cooldown_until = 0.0
+        self._spawn_failures = 0
+        self._spawn_retry_at = 0.0
+        self._last_tick: Optional[float] = None
+        reg = registry if registry is not None else obs.get_registry()
+        labels = {"router": self.name}
+        self._m_target = reg.gauge(
+            "fleet_target_replicas",
+            "the autoscaler's desired replica count (clamped)", labels)
+        self._m_decisions: Dict[str, Any] = {}
+        self._reg = reg
+        self._m_spawn_failures = reg.counter(
+            "autoscale_spawn_failures_total",
+            "replica spawns that raised (each defers the next attempt "
+            "with capped exponential backoff)", labels)
+        self._m_backoff = reg.gauge(
+            "autoscale_spawn_backoff_s",
+            "seconds until the next spawn attempt is allowed (0 = none "
+            "pending)", labels)
+        self._m_replica_seconds = reg.counter(
+            "autoscale_replica_seconds_total",
+            "integral of live replicas over time — the resource the "
+            "autoscaler exists to save vs a peak-sized static fleet",
+            labels)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- signals -------------------------------------------------------------
+
+    def signals(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The windowed control inputs, read from the router's fleet series
+        store (never a point scrape): summed demand rate, max burn, mean
+        queue depth per replica."""
+        store = self.router.series
+        w = self.policy.window_s
+        demand = 0.0
+        saw_rate = False
+        for key in store.match("fleet_replica_requests_total"):
+            r = store.rate(key, w, now=now)
+            if r is not None:
+                demand += max(r, 0.0)
+                saw_rate = True
+        burn = 0.0
+        for key in store.match("fleet_replica_slo_burn"):
+            b = store.window_agg(key, w, "max", now=now)
+            if b is not None:
+                burn = max(burn, b)
+        queue_sum = 0.0
+        n_queues = 0
+        for key in store.match("fleet_replica_queue_depth"):
+            q = store.window_agg(key, w, "mean", now=now)
+            if q is not None:
+                queue_sum += q
+                n_queues += 1
+        replicas = len(self.router.replicas())
+        return {
+            "demand_rps": demand if saw_rate else None,
+            "burn": burn,
+            "queue_per_replica": queue_sum / max(n_queues, 1),
+            "replicas": replicas,
+        }
+
+    # -- the control tick ----------------------------------------------------
+
+    def tick(self, now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """One control evaluation; returns the decision record when the
+        tick ACTED (scale_up / scale_down / spawn_failed), None otherwise.
+        ``now`` (monotonic) is injectable for tests."""
+        p = self.policy
+        now = time.monotonic() if now is None else now
+        sig = self.signals(now=now)
+        n = sig["replicas"]
+        if self._last_tick is not None and now > self._last_tick:
+            dt = now - self._last_tick
+            with self._lock:
+                self._replica_seconds += n * dt
+            self._m_replica_seconds.inc(n * dt)
+        self._last_tick = now
+        demand = sig["demand_rps"] or 0.0
+        desired = (math.ceil(demand / (p.rps_per_replica
+                                       * p.target_utilization))
+                   if demand > 0 else p.min_replicas)
+        desired = max(p.min_replicas, min(p.max_replicas, desired))
+        self._m_target.set(desired)
+        self._m_backoff.set(max(0.0, self._spawn_retry_at - now))
+
+        pressure = (sig["burn"] > p.up_burn
+                    or sig["queue_per_replica"] > p.queue_high)
+        up_cond = n < p.max_replicas and (desired > n or pressure)
+        # hysteresis: with one fewer replica, utilization must still sit
+        # below the scale-DOWN bound (strictly under the scale-up target)
+        # and nothing may be burning
+        down_cond = (
+            not up_cond
+            and n > p.min_replicas
+            and sig["burn"] < p.down_burn
+            and demand / (max(n - 1, 1) * p.rps_per_replica)
+            < p.scale_down_utilization
+        )
+
+        decision = None
+        if up_cond:
+            self._down_since = None
+            if self._up_since is None:
+                self._up_since = now
+            if (now - self._up_since >= p.hold_up_s
+                    and now >= self._cooldown_until
+                    and now >= self._spawn_retry_at):
+                decision = self._scale_up(n, desired, sig, now)
+                self._up_since = None  # re-arm: the next step holds again
+        elif down_cond:
+            self._up_since = None
+            if self._down_since is None:
+                self._down_since = now
+            if (now - self._down_since >= p.hold_down_s
+                    and now >= self._cooldown_until):
+                decision = self._scale_down(n, desired, sig, now)
+                self._down_since = None
+        else:
+            self._up_since = None
+            self._down_since = None
+        return decision
+
+    def _count(self, action: str) -> None:
+        with self._lock:
+            self._counts[action] = self._counts.get(action, 0) + 1
+        counter = self._m_decisions.get(action)
+        if counter is None:
+            counter = self._m_decisions[action] = self._reg.counter(
+                "autoscale_decisions_total",
+                "autoscaler actions taken, by kind",
+                {"router": self.name, "action": action})
+        counter.inc()
+
+    def _event(self, action: str, sig: Dict[str, Any],
+               **fields: Any) -> Dict[str, Any]:
+        rec = {
+            "action": action,
+            "replicas": sig["replicas"],
+            "demand_rps": (None if sig["demand_rps"] is None
+                           else round(sig["demand_rps"], 3)),
+            "burn": round(sig["burn"], 4),
+            "queue_per_replica": round(sig["queue_per_replica"], 3),
+            **fields,
+        }
+        exemplars = self.router.latency_exemplars()
+        if exemplars:
+            # the trace link: WHY the fleet moved resolves to the assembled
+            # traces that were burning the tail when the decision fired
+            rec["trace_exemplars"] = exemplars
+        obs.event("autoscale_decision", autoscaler=self.name, **rec)
+        return rec
+
+    def _scale_up(self, n: int, desired: int, sig: Dict[str, Any],
+                  now: float) -> Dict[str, Any]:
+        p = self.policy
+        target = min(max(desired, n + 1), p.max_replicas, n + p.max_step)
+        spawned: List[str] = []
+        for _ in range(target - n):
+            try:
+                faults.inject(FAULT_SITE)
+                client = self.pool.spawn()
+            except Exception as e:
+                self._spawn_failures += 1
+                self._m_spawn_failures.inc()
+                pause = self._backoff.backoff_s(self._spawn_failures)
+                self._spawn_retry_at = now + pause
+                self._m_backoff.set(pause)
+                self._count("spawn_failed")
+                rec = self._event(
+                    "spawn_failed", sig, target=target,
+                    error=f"{type(e).__name__}: {e}",
+                    consecutive_failures=self._spawn_failures,
+                    backoff_s=round(pause, 3), spawned=spawned)
+                if spawned:
+                    # a partial step still counts as a scale-up (and cools
+                    # down): the fleet moved
+                    self._finish_up(sig, target, spawned, now)
+                return rec
+            self.router.add_replica(client)
+            spawned.append(client.name)
+        self._spawn_failures = 0
+        self._spawn_retry_at = 0.0
+        self._m_backoff.set(0.0)
+        return self._finish_up(sig, target, spawned, now)
+
+    def _finish_up(self, sig: Dict[str, Any], target: int,
+                   spawned: List[str], now: float) -> Dict[str, Any]:
+        self._cooldown_until = now + self.policy.cooldown_up_s
+        self._count("scale_up")
+        return self._event("scale_up", sig, target=target, spawned=spawned)
+
+    def _scale_down(self, n: int, desired: int, sig: Dict[str, Any],
+                    now: float) -> Optional[Dict[str, Any]]:
+        p = self.policy
+        victim = self._pick_victim()
+        if victim is None:
+            return None
+        try:
+            faults.inject(FAULT_SITE)
+            # drain-then-retire, NEVER kill: the victim finishes every
+            # accepted request inside the router (detach removes its gauges
+            # and series from the fleet store), then the pool reaps it
+            drained = self.router.drain_replica(
+                victim, timeout_s=p.drain_timeout_s, detach=True)
+            self.pool.retire(victim)
+        except Exception as e:
+            self._count("retire_failed")
+            self._cooldown_until = now + p.cooldown_down_s
+            return self._event("retire_failed", sig, victim=victim,
+                               error=f"{type(e).__name__}: {e}")
+        self._cooldown_until = now + p.cooldown_down_s
+        self._count("scale_down")
+        return self._event("scale_down", sig, victim=victim,
+                           drained=drained, target=max(desired, n - 1))
+
+    def _pick_victim(self) -> Optional[str]:
+        """Scale-down victim preference: a JOINING replica first (it takes
+        no traffic yet, and the down decision just concluded its capacity
+        is not needed — retiring it can never reduce serving capacity),
+        then the least-loaded SERVING replica — but NEVER the last serving
+        one while non-serving members remain (that trade would be an
+        outage: live capacity swapped for a replica still warming)."""
+        statuses = self.router.statuses()
+        joining = [name for name, s in statuses.items()
+                   if s["state"] == "joining"]
+        if joining:
+            return min(joining)
+        serving = [(s["router_inflight"] + (s["queue_depth"] or 0), name)
+                   for name, s in statuses.items()
+                   if s["state"] == "serving"]
+        if serving:
+            if len(serving) == 1 and len(statuses) > 1:
+                return None  # the only live capacity stays
+            return min(serving)[1]
+        others = [name for name, s in statuses.items()
+                  if s["state"] not in ("draining", "down")]
+        return min(others) if others else None
+
+    # -- lifecycle / introspection -------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            counts = dict(self._counts)
+            replica_seconds = self._replica_seconds
+        return {
+            "replicas": len(self.router.replicas()),
+            "target": int(self._m_target.value),
+            "decisions": counts,
+            "scale_ups": counts.get("scale_up", 0),
+            "scale_downs": counts.get("scale_down", 0),
+            "spawn_failures": int(self._m_spawn_failures.value),
+            "replica_seconds": round(replica_seconds, 3),
+        }
+
+    def start(self) -> "Autoscaler":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name=f"{self.name}-autoscale",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.tick()
+            except Exception as e:
+                # the control loop must outlive a bad tick (a scrape race,
+                # a closing router) — but never silently
+                obs.event("autoscale_tick_error", autoscaler=self.name,
+                          error=f"{type(e).__name__}: {e}")
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def __enter__(self) -> "Autoscaler":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -153,10 +153,16 @@ class Router:
         trace_sample: float = 1.0,
         stale_after_intervals: Optional[float] = 8.0,
         series_store: Optional[obs.SeriesStore] = None,
+        admission=None,
     ):
         self.name = name
         self.policy = policy if policy is not None else FailoverPolicy()
         self.queue_limit = queue_limit
+        # admission control (serving.admission.AdmissionController): when
+        # set, every submit passes the class/quota gate and admitted work
+        # dispatches in weighted-fair order instead of FIFO — one bursting
+        # client sheds in ITS class while other classes' tail stays flat
+        self.admission = admission
         self.burn_degrade = burn_degrade
         self.request_timeout_s = request_timeout_s
         # scrape-staleness bound: a slot whose view is older than this many
@@ -238,6 +244,7 @@ class Router:
         its label, not 503 the router (obs.fleet.adopt_source)."""
         slot = _Slot(client)
         slot.scrape = self._safe_scrape(client)
+        self._gauges.readmit(client.name)  # a re-joining name publishes again
         with self._lock:
             self._slots[client.name] = slot
         for src in health_sources:
@@ -253,6 +260,12 @@ class Router:
                 del self._pins[s]
         self.fleet_health.release_sources(name)
         if slot is not None:
+            # the replica's telemetry leaves with it: its per-replica gauges
+            # drop from /metrics and its history from the fleet series store
+            # — a retired replica must not keep steering autoscale signals
+            # or export its last queue depth forever
+            self._gauges.remove(name)
+            self.series.forget({"fleet": self.name, "replica": name})
             obs.event("router_replica_removed", router=self.name,
                       replica=name)
 
@@ -501,14 +514,21 @@ class Router:
 
     def submit(self, *arrays, kind: str = "infer",
                session: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> RouterFuture:
+               deadline_s: Optional[float] = None,
+               client: Optional[str] = None,
+               priority: Optional[str] = None) -> RouterFuture:
         """Route one request; returns a :class:`RouterFuture`.
 
         ``kind`` names the replica RPC verb (``infer``/``encode``/
         ``decode``). ``session`` engages affinity: an ``encode`` pins the
         session to the replica that served it, a ``decode`` must follow the
         pin. ``deadline_s`` bounds the whole routed lifetime (placement +
-        failover + service)."""
+        failover + service). With an admission controller installed,
+        ``client`` draws the request against that client's token-bucket
+        quota and ``priority`` names its service class (default class
+        otherwise); over-quota/over-share requests shed HERE with a
+        reasoned :class:`RejectedError` and admitted work dispatches in
+        weighted-fair class order."""
         if self._closed.is_set():
             raise RouterClosed(f"submit() on closed router {self.name!r}")
         with self._lock:
@@ -525,6 +545,19 @@ class Router:
                 f"router {self.name!r}: {pending} requests pending "
                 f"(limit {self.queue_limit}) — request shed"
             )
+        ticket = None
+        if self.admission is not None:
+            try:
+                ticket = self.admission.admit(client=client,
+                                              priority=priority)
+            except BaseException:
+                # the class/quota gate refused (or the router.admit fault
+                # site fired): the request was never pending and the shed
+                # counts at the router edge too
+                with self._lock:
+                    self._pending -= 1
+                self._m_shed.inc()
+                raise
         self._m_requests.inc()
         tr = obs.maybe_trace(self.trace_sample)
         fut = RouterFuture(trace=tr)
@@ -536,49 +569,84 @@ class Router:
         def run_and_time():
             self._run(fut, kind, arrays, session, pin, deadline)
             ok = fut._error is None
+            latency = (fut.t_done if fut.t_done is not None
+                       else time.monotonic()) - t0
             if ok:
                 self._m_latency.observe(
-                    time.monotonic() - t0,
+                    latency,
                     exemplar=tr.trace_id if tr is not None else None)
+            if ticket is not None:
+                # close the admission books: the result classifies against
+                # the request's CLASS SLO (the per-class burn gauges)
+                self.admission.on_result(ticket, latency, ok)
             if tr is not None:
                 # the root span: the whole routed lifetime, recorded by the
                 # router process (its duration IS the e2e latency the
                 # histogram + exemplar observe)
-                dur = (fut.t_done if fut.t_done is not None
-                       else time.monotonic()) - t0
                 obs.record_span(
-                    "router_request", tr, t0, dur, router=self.name,
+                    "router_request", tr, t0, latency, router=self.name,
                     kind=kind, attempts=fut.attempts, replica=fut.replica,
                     ok=ok, **({} if ok
                               else {"error": type(fut._error).__name__}))
-                self.traces.add(tr.trace_id, dur, ok=ok, kind=kind,
+                self.traces.add(tr.trace_id, latency, ok=ok, kind=kind,
                                 attempts=fut.attempts, replica=fut.replica)
 
-        self._pool.submit(run_and_time)
+        if ticket is None:
+            self._pool.submit(run_and_time)
+        else:
+            # weighted-fair dispatch: the thunk enters its class queue and
+            # the pool receives an anonymous worker token — each token runs
+            # whatever the WFQ says is globally next, so under contention
+            # every backlogged class receives weight-proportional service
+            self.admission.enqueue(ticket, fut, run_and_time)
+            self._pool.submit(self._admission_worker)
         return fut
+
+    def _admission_worker(self) -> None:
+        item = (self.admission.pop()
+                if self.admission is not None else None)
+        if item is None:
+            return  # the queue was drained (shutdown) under this token
+        _, (_, fn) = item
+        fn()
+
+    def latency_exemplars(self, n: int = 4) -> List[str]:
+        """Trace ids from the router latency histogram's exemplar ring
+        (slowest-first) — the trace link autoscale decisions and alerts
+        attach to."""
+        return [e["trace"] for e in self._m_latency.exemplars()[:n]]
 
     def predict(self, *arrays, kind: str = "infer",
                 session: Optional[str] = None,
-                timeout: Optional[float] = None):
-        return self.submit(*arrays, kind=kind, session=session).result(
+                timeout: Optional[float] = None,
+                client: Optional[str] = None,
+                priority: Optional[str] = None):
+        return self.submit(*arrays, kind=kind, session=session,
+                           client=client, priority=priority).result(
             timeout=timeout)
 
     # -- latent-cache affinity helpers ---------------------------------------
 
     def encode(self, *arrays, session: str,
-               timeout: Optional[float] = None):
+               timeout: Optional[float] = None,
+               client: Optional[str] = None,
+               priority: Optional[str] = None):
         """Encode-once: runs the encoder on the least-loaded replica and pins
         ``session`` there (the latents stay resident on that replica)."""
         return self.predict(*arrays, kind="encode", session=session,
-                            timeout=timeout)
+                            timeout=timeout, client=client,
+                            priority=priority)
 
     def decode(self, *arrays, session: str,
-               timeout: Optional[float] = None):
+               timeout: Optional[float] = None,
+               client: Optional[str] = None,
+               priority: Optional[str] = None):
         """Decode-many against a pinned session; raises
         :class:`AffinityLost` when the pinned replica (and the latents)
         died — the caller re-``encode()``s, which re-pins."""
         return self.predict(*arrays, kind="decode", session=session,
-                            timeout=timeout)
+                            timeout=timeout, client=client,
+                            priority=priority)
 
     def pinned(self, session: str) -> Optional[str]:
         with self._lock:
@@ -760,7 +828,7 @@ class Router:
     def stats(self) -> Dict[str, Any]:
         with self._lock:
             pending = self._pending
-        return {
+        out = {
             "pending": pending,
             "requests": self._m_requests.value,
             "completed": self._m_completed.value,
@@ -770,11 +838,26 @@ class Router:
             "affinity_spills": self._m_spills.value,
             "replicas": self.statuses(),
         }
+        if self.admission is not None:
+            out["admission"] = self.admission.stats()
+        return out
 
     def close(self) -> None:
         self._closed.set()
         self._scraper.join(timeout=5)
+        if self.admission is not None:
+            # fail everything still waiting in the class queues explicitly:
+            # the pool shutdown below cancels their worker tokens, so an
+            # un-drained WFQ entry would leave its future hanging forever
+            for ticket, (fut, _) in self.admission.drain_queue():
+                fut._fail(RouterClosed(
+                    f"router {self.name!r} closed before dispatch"))
+                self._m_failed.inc()
+                with self._lock:
+                    self._pending -= 1
         self._pool.shutdown(wait=True, cancel_futures=True)
+        if self.admission is not None:
+            self.admission.close()
         self.fleet_health.close()
 
     def __enter__(self) -> "Router":

@@ -1,4 +1,5 @@
-"""Replica process supervision: spawn, babysit, restart-with-backoff.
+"""Replica process supervision: spawn, babysit, restart-with-backoff,
+runtime grow/shrink.
 
 The supervisor owns the PROCESS half of the fleet story (the router owns the
 TRAFFIC half): it spawns N replica processes (``serving.replica`` CLI),
@@ -6,7 +7,11 @@ watches them, and restarts any that die — with capped exponential backoff
 (:class:`~perceiver_io_tpu.resilience.RetryPolicy`), on the same port (so
 the router's client handle stays valid across a restart), never more than
 ``max_restarts`` times per replica (a crash-looping replica is detached, not
-hammered).
+hammered). The fleet is ELASTIC at runtime: ``add_replica()`` grows it (the
+autoscaler's scale-up edge — the newcomer JOINs through the router's
+readiness gate) and ``retire()`` shrinks it gracefully (drain RPC → SIGTERM
+→ SIGKILL only as a last resort; the port releases with the process and the
+babysitter can never restart a retirement).
 
 A restarted replica REJOINS only after its warm pool is live: the router's
 scrape loop sees it as JOINING (``ready=False``) until every engine's
@@ -75,6 +80,15 @@ class ReplicaSupervisor:
     explicit ``argv_builder`` device selection).
     """
 
+    # pitlint PIT-LOCK: fleet membership is mutated by add_replica/retire
+    # (the autoscaler's actuation thread) while the babysitter thread
+    # iterates it — touched only under _lock
+    _guarded_by = {
+        "_replicas": "_lock",
+        "_clients": "_lock",
+        "_m_restarts": "_lock",
+    }
+
     def __init__(
         self,
         count: int = 3,
@@ -101,6 +115,9 @@ class ReplicaSupervisor:
         self.max_restarts = max_restarts
         self._poll_s = poll_s
         self._log_dir = log_dir
+        self._base_name = base_name
+        self._next_index = count
+        self._lock = threading.Lock()
         self._replicas: Dict[str, _Replica] = {
             f"{base_name}{i}": _Replica(f"{base_name}{i}", _free_port())
             for i in range(count)
@@ -110,16 +127,19 @@ class ReplicaSupervisor:
                 name, f"http://127.0.0.1:{rep.port}")
             for name, rep in self._replicas.items()
         }
-        reg = registry if registry is not None else obs.get_registry()
+        self._registry = (registry if registry is not None
+                          else obs.get_registry())
         self._m_restarts = {
-            name: reg.counter(
-                "fleet_replica_restarts_total",
-                "unexpected replica exits the supervisor restarted",
-                {"replica": name})
-            for name in self._replicas
+            name: self._restart_counter(name) for name in self._replicas
         }
         self._stopping = threading.Event()
         self._monitor: Optional[threading.Thread] = None
+
+    def _restart_counter(self, name: str):
+        return self._registry.counter(
+            "fleet_replica_restarts_total",
+            "unexpected replica exits the supervisor restarted",
+            {"replica": name})
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -160,29 +180,100 @@ class ReplicaSupervisor:
         """Spawn the fleet and start the babysitter; returns the clients
         (hand them to a :class:`Router`). Does NOT wait for readiness —
         ``wait_ready()`` does, or let the router's JOINING state gate."""
-        for rep in self._replicas.values():
-            self._spawn(rep)
+        with self._lock:
+            reps = list(self._replicas.values())
+            clients = list(self._clients.values())
+        for rep in reps:
+            if rep.proc is None:  # add_replica may already have spawned it
+                self._spawn(rep)
         self._monitor = threading.Thread(
             target=self._watch, name="replica-supervisor", daemon=True)
         self._monitor.start()
-        return list(self._clients.values())
+        return clients
+
+    def add_replica(self, name: Optional[str] = None) -> HttpReplicaClient:
+        """Grow the fleet by one replica at runtime (the autoscaler's
+        scale-up edge): allocate a fresh port, spawn the child, and return
+        its client — hand it to ``Router.add_replica``. Does NOT wait for
+        readiness: the router scrapes the newcomer as JOINING until its
+        warm pool is live, so traffic never sees a cold replica."""
+        with self._lock:
+            if name is None:
+                name = f"{self._base_name}{self._next_index}"
+                self._next_index += 1
+            if name in self._replicas:
+                raise ValueError(f"replica {name!r} already exists")
+            rep = _Replica(name, _free_port())
+            client = HttpReplicaClient(name, f"http://127.0.0.1:{rep.port}")
+            self._replicas[name] = rep
+            self._clients[name] = client
+            self._m_restarts[name] = self._restart_counter(name)
+        self._spawn(rep)
+        return client
+
+    def retire(self, name: str, drain_timeout_s: float = 30.0,
+               term_timeout_s: float = 10.0) -> bool:
+        """Shrink the fleet by one replica: graceful drain (the replica
+        finishes every accepted request) → SIGTERM (its signal handler
+        exits 0) → SIGKILL only past ``term_timeout_s``. The replica leaves
+        the supervised set FIRST, so the babysitter can never restart a
+        retirement, and its port is released with the process. Returns
+        whether the replica reported fully drained.
+
+        Callers draining through a router (``Router.drain_replica(...,
+        detach=True)``) should retire AFTER the router detach — the router
+        stops placing work, this call reaps the process."""
+        with self._lock:
+            rep = self._replicas.pop(name, None)
+            client = self._clients.pop(name, None)
+            self._m_restarts.pop(name, None)
+        if rep is None:
+            raise KeyError(f"unknown replica {name!r}")
+        rep.failed = True  # a babysitter holding a stale snapshot skips it
+        drained = False
+        if rep.proc is not None and rep.proc.poll() is None:
+            try:
+                drained = bool(client.drain(drain_timeout_s))
+            except Exception:
+                pass  # an unresponsive replica still gets the SIGTERM drain
+            rep.proc.terminate()
+            try:
+                rep.proc.wait(timeout=term_timeout_s)
+            except subprocess.TimeoutExpired:
+                rep.proc.kill()
+                rep.proc.wait(timeout=5)
+        if rep.log is not None:
+            rep.log.close()
+            rep.log = None
+        # the retired replica's restart counter leaves /metrics with it
+        # (autoscale churn mints monotonically-new names — without this the
+        # exposition grows one dead counter per retirement, forever)
+        self._registry.remove("fleet_replica_restarts_total",
+                              {"replica": name})
+        obs.event("replica_retired", replica=name, port=rep.port,
+                  drained=drained)
+        return drained
 
     def clients(self) -> List[HttpReplicaClient]:
-        return list(self._clients.values())
+        with self._lock:
+            return list(self._clients.values())
 
     def client(self, name: str) -> HttpReplicaClient:
-        return self._clients[name]
+        with self._lock:
+            return self._clients[name]
 
     def wait_ready(self, timeout_s: float = 180.0,
                    names: Optional[Sequence[str]] = None) -> None:
         """Block until every (named) replica scrapes ready — the AOT warm
         pool is live and traffic can flow without a compile wall."""
         deadline = time.monotonic() + timeout_s
-        waiting = list(names if names is not None else self._clients)
+        with self._lock:
+            clients = dict(self._clients)
+        waiting = list(names if names is not None else clients)
         while waiting:
             waiting = [
                 n for n in waiting
-                if not self._clients[n].scrape(timeout_s=2.0).get("ready")
+                if not clients[n].scrape(timeout_s=2.0).get("ready")
             ]
             if not waiting:
                 return
@@ -196,7 +287,10 @@ class ReplicaSupervisor:
 
     def _watch(self) -> None:
         while not self._stopping.wait(self._poll_s):
-            for rep in self._replicas.values():
+            with self._lock:
+                reps = list(self._replicas.values())
+                counters = dict(self._m_restarts)
+            for rep in reps:
                 if rep.proc is None or rep.failed:
                     continue
                 rc = rep.proc.poll()
@@ -205,7 +299,9 @@ class ReplicaSupervisor:
                 now = time.monotonic()
                 if rep.restart_at is None:
                     rep.restarts += 1
-                    self._m_restarts[rep.name].inc()
+                    counter = counters.get(rep.name)
+                    if counter is not None:
+                        counter.inc()
                     if rep.restarts > self.max_restarts:
                         rep.failed = True
                         obs.event("replica_crash_looped", replica=rep.name,
@@ -228,14 +324,16 @@ class ReplicaSupervisor:
     def note_stable(self, name: str) -> None:
         """Reset a replica's restart budget after proven stability (callers
         decide what 'stable' means — e.g. N minutes serving)."""
-        self._replicas[name].restarts = 0
+        with self._lock:
+            self._replicas[name].restarts = 0
 
     # -- chaos / teardown ----------------------------------------------------
 
     def kill(self, name: str, sig: int = signal.SIGKILL) -> int:
         """Send ``sig`` to a replica (the chaos drill's ``kill -9``); returns
         the pid. The babysitter restarts it with backoff."""
-        rep = self._replicas[name]
+        with self._lock:
+            rep = self._replicas[name]
         if rep.proc is None or rep.proc.poll() is not None:
             raise RuntimeError(f"replica {name!r} is not running")
         pid = rep.proc.pid
@@ -244,23 +342,28 @@ class ReplicaSupervisor:
         return pid
 
     def pid(self, name: str) -> Optional[int]:
-        rep = self._replicas[name]
+        with self._lock:
+            rep = self._replicas[name]
         return rep.proc.pid if rep.proc is not None else None
 
     def restarts(self, name: str) -> int:
-        return self._replicas[name].restarts
+        with self._lock:
+            return self._replicas[name].restarts
 
     def stop(self, timeout_s: float = 20.0) -> None:
         """Graceful fleet shutdown: quit RPC → SIGTERM (drain) → SIGKILL."""
         self._stopping.set()
         if self._monitor is not None:
             self._monitor.join(timeout=5)
-        for name, rep in self._replicas.items():
+        with self._lock:
+            replicas = dict(self._replicas)
+            clients = dict(self._clients)
+        for name, rep in replicas.items():
             if rep.proc is None or rep.proc.poll() is not None:
                 continue
-            self._clients[name].quit()
+            clients[name].quit()
         deadline = time.monotonic() + timeout_s
-        for rep in self._replicas.values():
+        for rep in replicas.values():
             if rep.proc is None:
                 continue
             left = max(0.1, deadline - time.monotonic())
@@ -273,12 +376,13 @@ class ReplicaSupervisor:
                 except subprocess.TimeoutExpired:
                     rep.proc.kill()
                     rep.proc.wait(timeout=5)
-        for rep in self._replicas.values():
+        for rep in replicas.values():
             if rep.log is not None:
                 rep.log.close()
 
     def log_path(self, name: str) -> Optional[str]:
-        rep = self._replicas[name]
+        with self._lock:
+            rep = self._replicas[name]
         return rep.log.name if rep.log is not None else None
 
     def __enter__(self) -> "ReplicaSupervisor":

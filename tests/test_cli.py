@@ -524,6 +524,19 @@ def test_load_bench_dry_emits_schema_json_line():
     for key in ("publishes", "swaps", "rejects", "rollbacks",
                 "p99_steady_ms", "p99_swap_ms", "per_swap_p99_ms"):
         assert key in record["deploy_keys"], record
+    # the elastic-autoscaling (--schedule/--autoscale) and admission
+    # (--noisy_neighbor) blocks declare their keys the same way
+    assert record["autoscale"] is None and record["admission"] is None
+    assert record["schedule"] is None
+    for key in ("schedule", "peak_replicas", "scale_ups", "scale_downs",
+                "spawn_failures", "replica_seconds",
+                "static_replica_seconds", "replica_seconds_saved_pct",
+                "p99_within_slo", "lost_accepted"):
+        assert key in record["autoscale_keys"], record
+    for key in ("classes", "abuser_quota_rps", "victim_p99_delta_pct",
+                "abuser_shed_drill", "victim_p99_unprotected_ms",
+                "sheds_by_reason", "null"):
+        assert key in record["admission_keys"], record
 
 
 def test_load_bench_cpu_sweep_shows_saturation_signature(tmp_path):

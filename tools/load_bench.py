@@ -113,6 +113,33 @@ ALERT_KEYS = ("rules", "fired", "resolved", "firing_at_end",
 # runs both arms unsampled (the floor measurement)
 SERIES_AB_KEYS = ("ab_waves", "unsampled_rps", "sampled_rps",
                   "overhead_pct", "interval_s", "null")
+# the autoscale block of a --schedule run (null otherwise): per-segment
+# offered rates ride the sweep; this block carries the control-loop verdict
+# — replica-seconds actually spent vs a static fleet sized for the observed
+# peak, p99 vs the SLO across segments, and lost_accepted (must be 0
+# across every scale event)
+AUTOSCALE_KEYS = ("enabled", "schedule", "period_s", "low", "high",
+                  "rps_per_replica", "min_replicas", "max_replicas",
+                  "initial_replicas", "peak_replicas", "scale_ups",
+                  "scale_downs", "spawn_failures", "decisions",
+                  "replica_seconds", "static_replica_seconds",
+                  "replica_seconds_saved_pct", "p99_ms_max", "slo_p99_ms",
+                  "p99_within_slo", "lost_accepted")
+# the admission block of a --noisy_neighbor run (null otherwise): two
+# classes (gold victim / bronze abuser), the abuser under a token-bucket
+# quota — phase A both polite, phase B the abuser floods at flood_factor ×
+# quota. The isolation verdict: the victim's p99 moves within the recorded
+# ±1.5 pt paired-interleave floor while the abuser's own class absorbs the
+# shedding
+ADMISSION_KEYS = ("classes", "abuser_quota_rps", "flood_factor", "pairs",
+                  "null", "victim_rps", "abuser_rps_baseline",
+                  "abuser_rps_drill", "victim_p99_baseline_ms",
+                  "victim_p99_drill_ms", "victim_p99_delta_pct",
+                  "victim_completed", "victim_shed",
+                  "abuser_shed_baseline", "abuser_shed_drill",
+                  "abuser_admitted_drill",
+                  "victim_p99_unprotected_ms", "victim_shed_unprotected",
+                  "sheds_by_reason")
 
 
 def _pct(values: List[float], q: float) -> Optional[float]:
@@ -301,6 +328,24 @@ def _arrival_gaps(arrival: str, rate: float, duration: float, burst: int,
     return times
 
 
+def _schedule_factors(schedule: str, low: float, high: float) -> List[float]:
+    """Per-segment offered-rate factors (of the calibrated initial-fleet
+    capacity) for the --schedule arrival profiles: ``step`` holds low, steps
+    to the peak, steps back; ``burst`` alternates; ``diurnal`` traces one
+    raised-cosine day. Each factor runs for --schedule_period_s."""
+    if schedule == "step":
+        return [low, low, high, high, low, low]
+    if schedule == "burst":
+        return [low, high, low, high, low, high]
+    # diurnal: one smooth low → high → low cycle over 8 segments
+    import math
+
+    k = 8
+    return [low + (high - low) * 0.5 * (1.0 - math.cos(2.0 * math.pi
+                                                       * i / k))
+            for i in range(k)]
+
+
 def _run_point(submit, breaker_state, reqs, rate: float, duration: float,
                arrival: str, burst: int, rng, drain_timeout_s: float,
                on_frac=None, sink=None) -> Dict:
@@ -373,6 +418,139 @@ def _run_point(submit, breaker_state, reqs, rate: float, duration: float,
         "breaker": breaker_state(),
     }
     return point
+
+
+def _noisy_neighbor(router, reqs, rng, duration: float, victim_rps: float,
+                    quota_rps: float, flood_factor: float,
+                    drain_timeout_s: float, pairs: int = 3,
+                    null: bool = False) -> Dict:
+    """The noisy-neighbor drill: a gold-class victim at a steady polite
+    rate, a bronze-class abuser that alternates polite (under its token-
+    bucket quota) and flooding (``flood_factor`` × quota) sub-phases. The
+    PERF.md paired-interleave discipline applies — ``pairs`` (baseline,
+    drill) sub-phase pairs run order-ALTERNATED in one process, and the
+    victim's verdict is the paired median p99 delta, so slow host drift
+    cancels instead of masquerading as interference. ``null`` runs the
+    abuser polite in BOTH arms: the drill's own noise floor. The verdict
+    the record carries: the victim's p99 stays flat (within that floor)
+    while the abuser's own class absorbs the shedding."""
+    from perceiver_io_tpu.resilience import RejectedError
+
+    def phase(abuser_rps: float, abuser_tag: str = "abuser",
+              abuser_cls: Optional[str] = "bronze") -> Dict:
+        arrivals = sorted(
+            [(t, "victim", "gold")
+             for t in _arrival_gaps("poisson", victim_rps, duration, 8, rng)]
+            + [(t, abuser_tag, abuser_cls)
+               for t in _arrival_gaps("poisson", abuser_rps, duration, 8,
+                                      rng)])
+        t0 = time.monotonic()
+        futs = {"victim": [], abuser_tag: []}
+        shed = {"victim": 0, abuser_tag: 0}
+        for at, client, cls in arrivals:
+            delay = t0 + at - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                futs[client].append(
+                    (router.submit(*reqs[len(futs[client]) % len(reqs)],
+                                   client=(None if client == "anon"
+                                           else client),
+                                   priority=cls),
+                     time.monotonic()))
+            except RejectedError:
+                shed[client] += 1
+        lats = {"victim": [], abuser_tag: []}
+        for client, fs in futs.items():
+            for fut, ts in fs:
+                try:
+                    fut.result(timeout=drain_timeout_s)
+                except RejectedError:
+                    shed[client] += 1
+                    continue
+                except Exception:
+                    shed[client] += 1
+                    continue
+                lats[client].extend(_fut_latencies(fut, ts)[0])
+        return {
+            "victim_p99_s": _pct(lats["victim"], 0.99),
+            "victim_completed": len(lats["victim"]),
+            "victim_shed": shed["victim"],
+            "abuser_completed": len(lats[abuser_tag]),
+            "abuser_shed": shed[abuser_tag],
+        }
+
+    base_rps = quota_rps * 0.8
+    flood_rps = base_rps if null else quota_rps * flood_factor
+    _log(f"noisy-neighbor: victim {victim_rps:.1f} req/s (gold), abuser "
+         f"polite {base_rps:.1f} / "
+         + ("NULL (polite both arms)" if null
+            else f"flood {flood_rps:.1f}")
+         + f" req/s (bronze, quota {quota_rps:.1f}), {pairs} "
+         f"order-alternated pairs x {duration:g}s")
+    base_phases, drill_phases, deltas = [], [], []
+    for pair in range(pairs):
+        drill_first = bool(pair % 2)  # order-alternate within each pair
+        order = ([flood_rps, base_rps] if drill_first
+                 else [base_rps, flood_rps])
+        a = phase(order[0])
+        b = phase(order[1])
+        drill, base = (a, b) if drill_first else (b, a)
+        base_phases.append(base)
+        drill_phases.append(drill)
+        if base["victim_p99_s"] and drill["victim_p99_s"]:
+            deltas.append(drill["victim_p99_s"] / base["victim_p99_s"]
+                          - 1.0)
+    med = lambda v: sorted(v)[len(v) // 2] if v else None
+    ms = lambda v: None if v is None else round(v * 1e3, 3)
+    p99_b = med([p["victim_p99_s"] for p in base_phases
+                 if p["victim_p99_s"] is not None])
+    p99_d = med([p["victim_p99_s"] for p in drill_phases
+                 if p["victim_p99_s"] is not None])
+    paired = med(deltas)
+    unprotected = None
+    if not null:
+        # the contrast arm: the SAME flood with no client id — it bypasses
+        # the quota and lands in the DEFAULT (victim's) class, which is
+        # exactly what a fleet without admission control experiences
+        _log("noisy-neighbor contrast: the same flood UNPROTECTED "
+             "(no quota, victim's class)")
+        unprotected = phase(flood_rps, abuser_tag="anon", abuser_cls=None)
+    adm_stats = router.admission.stats()
+    return {
+        "classes": {n: c["weight"]
+                    for n, c in adm_stats["classes"].items()},
+        "abuser_quota_rps": round(quota_rps, 3),
+        "flood_factor": flood_factor,
+        "pairs": pairs,
+        "null": null,
+        "victim_rps": round(victim_rps, 3),
+        "abuser_rps_baseline": round(base_rps, 3),
+        "abuser_rps_drill": round(flood_rps, 3),
+        "victim_p99_baseline_ms": ms(p99_b),
+        "victim_p99_drill_ms": ms(p99_d),
+        # the headline: paired MEDIAN victim p99 delta across the
+        # order-alternated pairs (drift cancels; judge vs the --nn_null
+        # floor)
+        "victim_p99_delta_pct": (None if paired is None
+                                 else round(100.0 * paired, 2)),
+        "victim_completed": sum(p["victim_completed"]
+                                for p in base_phases + drill_phases),
+        "victim_shed": sum(p["victim_shed"]
+                           for p in base_phases + drill_phases),
+        "abuser_shed_baseline": sum(p["abuser_shed"]
+                                    for p in base_phases),
+        "abuser_shed_drill": sum(p["abuser_shed"] for p in drill_phases),
+        "abuser_admitted_drill": sum(p["abuser_completed"]
+                                     for p in drill_phases),
+        "victim_p99_unprotected_ms": (
+            None if unprotected is None
+            else ms(unprotected["victim_p99_s"])),
+        "victim_shed_unprotected": (
+            None if unprotected is None
+            else unprotected["victim_shed"]),
+        "sheds_by_reason": adm_stats["shed"],
+    }
 
 
 def _point_for_record(p: Dict) -> Dict:
@@ -509,19 +687,99 @@ def main() -> None:
                      help="null control for --series_ab: BOTH arms run "
                           "unsampled — measures the host noise floor the "
                           "overhead verdict is judged against")
+    aut = parser.add_argument_group(
+        "elastic autoscaling + admission "
+        "(perceiver_io_tpu.serving.autoscale / .admission)")
+    aut.add_argument("--schedule", choices=["step", "burst", "diurnal"],
+                     default=None,
+                     help="replace the rate sweep with a time-varying "
+                          "offered-rate profile (per-segment rates as "
+                          "fractions of the calibrated initial-fleet "
+                          "capacity): step = low→peak→low, burst = "
+                          "alternating, diurnal = one raised-cosine cycle. "
+                          "The per-segment points ride the sweep array; "
+                          "pair with --autoscale for the control-loop "
+                          "verdict")
+    aut.add_argument("--schedule_period_s", type=float, default=3.0,
+                     help="seconds per schedule segment")
+    aut.add_argument("--schedule_low", type=float, default=0.2,
+                     help="low-rate factor of the calibrated capacity")
+    aut.add_argument("--schedule_high", type=float, default=0.5,
+                     help="peak-rate factor. The default sits ABOVE the "
+                          "autoscaler's target utilization but BELOW the "
+                          "initial fleet's knee: the control loop grows "
+                          "the fleet on utilization pressure BEFORE "
+                          "saturation, so p99 never leaves the service "
+                          "floor (raise toward/past 1 for the "
+                          "saturation-transient variant instead — p99 "
+                          "then rides the reaction window)")
+    aut.add_argument("--autoscale_target_util", type=float, default=0.4,
+                     help="the policy's target utilization (scale up once "
+                          "windowed demand / fleet capacity exceeds it; "
+                          "scale down below 0.6x this). Deliberately low "
+                          "default: pre-knee headroom sized so p99 stays "
+                          "on the service floor THROUGH a scale-up "
+                          "reaction window on this class of host — real "
+                          "fleets with faster joins push it up")
+    aut.add_argument("--autoscale", action="store_true",
+                     help="run the Autoscaler over the fleet during the "
+                          "sweep/schedule (requires --replicas >= 1): "
+                          "spawn/drain-then-retire replicas from the "
+                          "windowed fleet series, seeded by the calibrated "
+                          "per-replica capacity; the record gains an "
+                          "'autoscale' block (replica-seconds vs a static "
+                          "peak fleet, lost_accepted must stay 0)")
+    aut.add_argument("--min_replicas", type=int, default=1,
+                     help="autoscale floor")
+    aut.add_argument("--max_replicas", type=int, default=None,
+                     help="autoscale ceiling (default: 2x --replicas)")
+    aut.add_argument("--autoscale_interval_s", type=float, default=0.25,
+                     help="control-loop tick cadence")
+    aut.add_argument("--noisy_neighbor", action="store_true",
+                     help="admission-control drill (requires --replicas "
+                          ">= 1): gold victim + bronze abuser behind "
+                          "per-client token-bucket quotas and WFQ; phase A "
+                          "both polite, phase B the abuser floods. The "
+                          "record gains an 'admission' block — the "
+                          "victim's p99 must stay flat while the abuser's "
+                          "class absorbs the shedding")
+    aut.add_argument("--nn_quota_rps", type=float, default=None,
+                     help="abuser token-bucket rate, also the victim's "
+                          "offered rate (default: 10%% of the calibrated "
+                          "capacity — low enough that the flood's "
+                          "SUBMISSION overhead cannot itself saturate a "
+                          "small host and masquerade as interference)")
+    aut.add_argument("--nn_flood_factor", type=float, default=4.0,
+                     help="drill-arm abuser rate as a multiple of its "
+                          "quota")
+    aut.add_argument("--nn_pairs", type=int, default=3,
+                     help="order-alternated (polite, flood) sub-phase "
+                          "pairs — the victim verdict is the paired "
+                          "median p99 delta")
+    aut.add_argument("--nn_null", action="store_true",
+                     help="null control: the abuser stays polite in BOTH "
+                          "arms — measures the drill's own noise floor "
+                          "the isolation verdict is judged against")
     args = parser.parse_args()
+
+    if (args.autoscale or args.noisy_neighbor) and args.replicas < 1:
+        parser.error("--autoscale/--noisy_neighbor need --replicas >= 1 "
+                     "(the control loop lives at the router tier)")
 
     if args.dry:
         record = {
             "metric": "load_bench", "dry": True, "backend": None,
             "preset": args.preset, "arrival": args.arrival,
-            "duration_s": args.duration_s,
+            "duration_s": args.duration_s, "schedule": args.schedule,
             "point_keys": list(POINT_KEYS), "phase_keys": list(PHASE_KEYS),
             "fleet_keys": list(FLEET_KEYS), "deploy_keys": list(DEPLOY_KEYS),
             "trace_keys": list(TRACE_KEYS), "alert_keys": list(ALERT_KEYS),
             "series_ab_keys": list(SERIES_AB_KEYS),
+            "autoscale_keys": list(AUTOSCALE_KEYS),
+            "admission_keys": list(ADMISSION_KEYS),
             "sweep": [], "capacity": None, "fleet": None, "deploy": None,
             "trace": None, "alerts": None, "series_ab": None,
+            "autoscale": None, "admission": None,
         }
         emit_json_line(record)
         return
@@ -574,11 +832,27 @@ def main() -> None:
 
     queue_limit = args.queue_limit if args.queue_limit > 0 else None
     engine = router = sup = params = None
+    admission = None
+    spawn_replica = None  # in-process autoscale spawn hook
     local_replicas = []
     killed = {"name": None}
     if args.replicas > 0:
         from perceiver_io_tpu.serving import Router
 
+        if args.noisy_neighbor:
+            # gold carries the victim, bronze the abuser; the abuser's
+            # token bucket is sized AFTER calibration (client_quotas is
+            # consulted lazily on the client's first admit)
+            from perceiver_io_tpu.serving import (
+                AdmissionController,
+                PriorityClass,
+            )
+
+            admission = AdmissionController(
+                classes=[PriorityClass("gold", weight=4.0),
+                         PriorityClass("bronze", weight=1.0)],
+                default_class="gold", queue_limit=512,
+                name="load_bench", registry=registry)
         if args.replica_mode == "process":
             from perceiver_io_tpu.serving import ReplicaSupervisor
 
@@ -600,22 +874,47 @@ def main() -> None:
             from perceiver_io_tpu.serving import LocalReplica, ReplicaApp
 
             gathered_apply, params = build_model_apply()
-            for i in range(args.replicas):
+            made = [0]
+            compile_cache = None
+            if args.autoscale:
+                # autoscale spawns share one AOT executable cache: the
+                # first replica's compile persists, every later spawn
+                # DESERIALIZES — the reaction window is process bring-up,
+                # not a compile wall (the r10 cold-start property, and
+                # what serve.py --replicas --compile_cache does for real
+                # process fleets)
+                import tempfile
+
+                compile_cache = tempfile.mkdtemp(prefix="lb_autoscale_aot_")
+
+            def spawn_replica(background: bool = False):
+                i = made[0]
+                made[0] += 1
                 eng = ServingEngine(
                     gathered_apply, params, max_batch=args.max_batch,
                     name=f"lb_r{i}", registry=registry,
                     queue_limit=queue_limit,
                     request_deadline_s=args.deadline_s,
+                    compile_cache=compile_cache,
                 )
-                eng.warmup(*reqs[0])
+                # autoscale spawns warm in the BACKGROUND: the newcomer
+                # scrapes as JOINING until its program is live, exactly
+                # like a supervised process replica
+                eng.warmup(*reqs[0], background=background)
                 app = ReplicaApp({"infer": eng}, params, name=f"r{i}",
                                  registry=registry)
-                local_replicas.append(LocalReplica(app))
-            clients = local_replicas
+                rep = LocalReplica(app)
+                local_replicas.append(rep)
+                return rep
+
+            for i in range(args.replicas):
+                spawn_replica()
+            clients = list(local_replicas)
             _log(f"warmed {args.replicas} in-process replicas")
         router = Router(clients, name="load_bench", registry=registry,
                         scrape_interval_s=0.1,
-                        request_timeout_s=args.drain_timeout_s)
+                        request_timeout_s=args.drain_timeout_s,
+                        admission=admission)
         router.refresh()
         submit = lambda req: router.submit(*req)
 
@@ -788,11 +1087,70 @@ def main() -> None:
         name="load_bench",
     )
 
-    if args.rates:
+    if args.schedule:
+        factors = _schedule_factors(args.schedule, args.schedule_low,
+                                    args.schedule_high)
+        rates = [f * cal_rps for f in factors]
+        durations = [args.schedule_period_s] * len(rates)
+        _log(f"schedule {args.schedule}: "
+             + ", ".join(f"{r:.0f}" for r in rates)
+             + f" req/s x {args.schedule_period_s:g}s segments")
+    elif args.rates:
         rates = [float(r) for r in args.rates.split(",")]
+        durations = [args.duration_s] * len(rates)
     else:
         rates = [float(f) * cal_rps
                  for f in args.rate_factors.split(",")]
+        durations = [args.duration_s] * len(rates)
+
+    # -- the elastic control loop (--autoscale) ------------------------------
+    auto = None
+    if args.autoscale:
+        from perceiver_io_tpu.serving import (
+            Autoscaler,
+            AutoscalePolicy,
+            CallbackPool,
+            SupervisorPool,
+        )
+
+        rps_per_replica = cal_rps / args.replicas
+        max_reps = args.max_replicas or 2 * args.replicas
+        tick = args.autoscale_interval_s
+        policy = AutoscalePolicy(
+            rps_per_replica=rps_per_replica,
+            min_replicas=args.min_replicas, max_replicas=max_reps,
+            target_utilization=args.autoscale_target_util,
+            scale_down_utilization=0.6 * args.autoscale_target_util,
+            window_s=max(4 * tick, 1.5),
+            hold_up_s=2 * tick, hold_down_s=6 * tick,
+            cooldown_up_s=2 * tick, cooldown_down_s=8 * tick,
+            max_step=1, drain_timeout_s=args.drain_timeout_s)
+        if sup is not None:
+            pool = SupervisorPool(sup,
+                                  drain_timeout_s=args.drain_timeout_s)
+        else:
+            def _retire_local(name):
+                for rep in local_replicas:
+                    if rep.name == name:
+                        rep.app.close()
+
+            pool = CallbackPool(lambda: spawn_replica(background=True),
+                                _retire_local)
+        auto = Autoscaler(router, pool, policy, interval_s=tick,
+                          registry=registry).start()
+        peak = [len(router.replicas())]
+        stop_peak = threading.Event()
+
+        def _watch_peak():
+            while not stop_peak.wait(0.05):
+                peak[0] = max(peak[0], len(router.replicas()))
+
+        peak_thread = threading.Thread(target=_watch_peak, daemon=True)
+        peak_thread.start()
+        t_auto0 = time.monotonic()
+        _log(f"autoscale: {rps_per_replica:.1f} req/s/replica fit, fleet "
+             f"[{args.min_replicas}, {max_reps}], tick {tick:g}s")
+
     rng = np.random.default_rng(args.seed)
     points = []
     for idx, rate in enumerate(rates):
@@ -801,7 +1159,7 @@ def main() -> None:
                 and idx == args.kill_point):
             on_frac = (args.kill_replica_at, kill_hook)
         point = _run_point(submit, breaker_state, reqs, rate,
-                           args.duration_s, args.arrival, args.burst, rng,
+                           durations[idx], args.arrival, args.burst, rng,
                            args.drain_timeout_s, on_frac=on_frac,
                            sink=completion_sink)
         points.append(point)
@@ -834,6 +1192,70 @@ def main() -> None:
     else:
         capacity = None
         _log("capacity model: no point completed any request — nothing to fit")
+
+    autoscale_record = None
+    if auto is not None:
+        total_s = time.monotonic() - t_auto0
+        auto.close()
+        stop_peak.set()
+        peak_thread.join(timeout=2)
+        st = auto.stats()
+        # the verdict: replica-seconds actually spent vs a static fleet
+        # sized for the observed peak over the same wall window
+        static_rs = peak[0] * total_s
+        saved = (100.0 * (1.0 - st["replica_seconds"] / static_rs)
+                 if static_rs > 0 else None)
+        p99s = [p["p99_s"] for p in points if p["p99_s"] is not None]
+        p99_max = max(p99s) if p99s else None
+        # lost = accepted work that FAILED (non-shed exceptions at the
+        # point level: RejectedError/DeadlineExceeded deliveries are
+        # taxonomy-honest SHEDS, not losses — the router's coarse failed
+        # counter includes placement-exhaustion rejections under overload)
+        lost = sum(int(p["failed"]) for p in points)
+        autoscale_record = {
+            "enabled": True,
+            "schedule": args.schedule,
+            "period_s": args.schedule_period_s if args.schedule else None,
+            "low": args.schedule_low if args.schedule else None,
+            "high": args.schedule_high if args.schedule else None,
+            "rps_per_replica": round(cal_rps / args.replicas, 3),
+            "min_replicas": args.min_replicas,
+            "max_replicas": args.max_replicas or 2 * args.replicas,
+            "initial_replicas": args.replicas,
+            "peak_replicas": peak[0],
+            "scale_ups": st["scale_ups"],
+            "scale_downs": st["scale_downs"],
+            "spawn_failures": st["spawn_failures"],
+            "decisions": st["decisions"],
+            "replica_seconds": st["replica_seconds"],
+            "static_replica_seconds": round(static_rs, 3),
+            "replica_seconds_saved_pct": (None if saved is None
+                                          else round(saved, 2)),
+            "p99_ms_max": (None if p99_max is None
+                           else round(p99_max * 1e3, 3)),
+            "slo_p99_ms": round(slo.latency_target_s * 1e3, 3),
+            "p99_within_slo": (None if p99_max is None
+                               else p99_max <= slo.latency_target_s),
+            # accepted-but-never-delivered across every scale event —
+            # drain-then-retire keeps this 0
+            "lost_accepted": lost,
+        }
+        _log(f"autoscale: {json.dumps(autoscale_record)}")
+
+    admission_record = None
+    if args.noisy_neighbor:
+        quota = args.nn_quota_rps or 0.1 * cal_rps
+        # the abuser's bucket is sized from the CALIBRATED capacity (the
+        # controller consults client_quotas lazily, on the client's first
+        # admit — no abuser traffic has flowed yet)
+        admission.client_quotas["abuser"] = (quota, max(8.0, quota / 4.0))
+        admission_record = _noisy_neighbor(
+            router, reqs, rng, args.duration_s,
+            victim_rps=quota, quota_rps=quota,
+            flood_factor=args.nn_flood_factor,
+            drain_timeout_s=args.drain_timeout_s,
+            pairs=args.nn_pairs, null=args.nn_null)
+        _log(f"admission: {json.dumps(admission_record)}")
 
     deploy_record = None
     if deploy_stack is not None:
@@ -916,7 +1338,8 @@ def main() -> None:
         "metric": "load_bench", "dry": False, "backend": backend,
         "preset": "tiny" if tiny else "flagship",
         "arrival": args.arrival, "burst": args.burst,
-        "duration_s": args.duration_s, "max_batch": args.max_batch,
+        "duration_s": args.duration_s, "schedule": args.schedule,
+        "max_batch": args.max_batch,
         "queue_limit": args.queue_limit, "seed": args.seed,
         "seq_len": max_seq_len,
         "calibrated_rps": round(cal_rps, 3),
@@ -929,6 +1352,8 @@ def main() -> None:
         "trace": trace_record,
         "alerts": alerts_record,
         "series_ab": series_ab_record,
+        "autoscale": autoscale_record,
+        "admission": admission_record,
     }
     if args.events_jsonl:
         obs.configure_event_log(None)  # flush + release the sweep's log
