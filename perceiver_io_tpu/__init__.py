@@ -46,6 +46,7 @@ from perceiver_io_tpu.models.multimodal import (
     build_multimodal_autoencoder,
 )
 from perceiver_io_tpu.models.perceiver import (
+    PerceiverARLM,
     PerceiverEncoder,
     PerceiverDecoder,
     PerceiverIO,
@@ -81,6 +82,7 @@ __all__ = [
     "PerceiverEncoder",
     "PerceiverDecoder",
     "PerceiverIO",
+    "PerceiverARLM",
     "PerceiverMLM",
     "TextMasking",
     "MLMPredictor",

@@ -33,6 +33,11 @@ def _preset_builders() -> Dict[str, Tuple[Callable, int]]:
         "tiny_mlm": (presets.tiny_mlm, 64),
         "flagship_mlm": (presets.flagship_mlm, 512),
         "flagship_tpu_mlm": (presets.flagship_tpu_mlm, 512),
+        # the generative (Perceiver-AR) task presets: same leaf names by
+        # construction, audited so a causal-path refactor cannot silently
+        # strand a sharding rule either
+        "tiny_ar": (presets.tiny_ar, 64),
+        "flagship_ar": (presets.flagship_ar, 512),
     }
 
 

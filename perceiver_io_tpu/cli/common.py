@@ -404,6 +404,35 @@ def build_mlm(args, vocab_size: int, max_seq_len: int) -> pit.PerceiverMLM:
     )
 
 
+def build_ar(args, vocab_size: int, max_seq_len: int):
+    """Perceiver-AR causal LM (the generative task preset surface —
+    mirrors :func:`build_mlm`'s width knobs over ``models.presets``)."""
+    dtype = DTYPES[args.dtype]
+    return pit.PerceiverARLM(
+        input_adapter=pit.TextInputAdapter(
+            vocab_size=vocab_size,
+            max_seq_len=max_seq_len,
+            num_channels=args.num_latent_channels,
+            dtype=dtype,
+        ),
+        output_adapter=pit.TextOutputAdapter(
+            vocab_size=vocab_size,
+            max_seq_len=max_seq_len,
+            num_output_channels=args.num_latent_channels,
+            dtype=dtype,
+            pad_classes_to=getattr(args, "pad_vocab_multiple", None),
+        ),
+        num_latents=args.num_latents,
+        num_layers=args.num_encoder_layers,
+        num_self_attention_layers_per_block=args.num_self_attention_layers_per_block,
+        num_cross_attention_heads=args.num_cross_attention_heads,
+        num_self_attention_heads=args.num_self_attention_heads,
+        dropout=args.dropout,
+        dtype=dtype,
+        attn_impl=args.attn_impl,
+    )
+
+
 def build_text_classifier(args, vocab_size: int, max_seq_len: int,
                           num_classes: int = 2) -> pit.PerceiverIO:
     """Sequence classifier (reference ``lightning.py:186-200``)."""

@@ -168,6 +168,29 @@ def _install_drain_handlers():
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     g = parser.add_argument_group("serving")
+    g.add_argument("--task", choices=("mlm", "generate"), default="mlm",
+                   help="workload class: 'mlm' fills [MASK] positions; "
+                        "'generate' streams Perceiver-AR continuations of "
+                        "each input line (checkpoint from cli/train_ar.py; "
+                        "single-process — fleet generation serves through "
+                        "`python -m perceiver_io_tpu.serving.replica "
+                        "--task generate` behind a Router)")
+    gen = parser.add_argument_group("generation (--task generate)")
+    gen.add_argument("--max_new_tokens", type=int, default=32,
+                     help="continuation length per prompt")
+    gen.add_argument("--temperature", type=float, default=0.0,
+                     help="0 = greedy; otherwise categorical at this "
+                          "temperature")
+    gen.add_argument("--top_k", type=int, default=0,
+                     help="truncate sampling to the k most likely tokens "
+                          "(0 = full softmax)")
+    gen.add_argument("--gen_seed", type=int, default=0,
+                     help="sampling seed (position-folded: deterministic "
+                          "per absolute position, reproducible across "
+                          "re-encodes)")
+    gen.add_argument("--generate_chunk", type=int, default=8,
+                     help="decode steps per chunked dispatch (= streaming "
+                          "granularity)")
     g.add_argument("--checkpoint", required=True,
                    help="checkpoint directory of a train_mlm run "
                         "(the version_N/checkpoints dir; hparams embedded)")
@@ -520,6 +543,14 @@ def main(argv: Optional[Sequence[str]] = None):
                   flush=True)
 
     try:
+        if args.task == "generate":
+            if args.replicas > 0:
+                raise SystemExit(
+                    "--task generate serves single-process here; a "
+                    "generation FLEET runs `python -m "
+                    "perceiver_io_tpu.serving.replica --task generate` "
+                    "replicas behind a serving.Router")
+            return _serve_generate(args, load_tokenizer, drain_state)
         if args.replicas > 0:
             return _serve_fleet(args, drain_state)
         return _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint,
@@ -757,6 +788,85 @@ def _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint,
                       "on demand", file=sys.stderr)
         if args.stats:
             print(f"serve: stats {json.dumps(server.stats())}", file=sys.stderr)
+    return results
+
+
+def _serve_generate(args, load_tokenizer, drain_state=None):
+    """``--task generate``: stream Perceiver-AR continuations of each input
+    line. One JSON result line per prompt on stdout ({"text",
+    "continuation_ids", "continuation"}); chunk-by-chunk progress rides
+    stderr. A drain signal stops admission; the tokens already streamed for
+    an interrupted prompt still emit (accepted work is never dropped)."""
+    from perceiver_io_tpu.inference.generate import (
+        ARGenerator,
+        SamplingConfig,
+        load_ar_checkpoint,
+    )
+
+    tokenizer = load_tokenizer(args.tokenizer)
+    model, params, max_seq_len = load_ar_checkpoint(
+        args.checkpoint, tokenizer, step=args.step,
+        dtype="bfloat16" if args.dtype == "bfloat16" else None,
+    )
+    gen = ARGenerator(
+        model, params, max_seq_len=max_seq_len, chunk=args.generate_chunk,
+        compute_dtype="bfloat16" if args.dtype == "bfloat16" else None,
+    )
+    sampling = SamplingConfig(temperature=args.temperature,
+                              top_k=args.top_k, seed=args.gen_seed)
+    if not args.no_warmup:
+        # warm the CONFIGURED sampling shape: greedy and top-k are distinct
+        # compiled decode programs, and an unwarmed shape is a mid-stream
+        # compile stall on the first prompt
+        n = gen.warmup(sampling=sampling)
+        print(f"serve: warmed {n} generation programs", file=sys.stderr)
+    results = []
+
+    def emit(text: str, tokens) -> None:
+        line = {
+            "text": text,
+            "continuation_ids": list(tokens),
+            "continuation": " ".join(
+                tokenizer.id_to_token(int(t)) for t in tokens),
+        }
+        results.append(line)
+        print(json.dumps(line))
+
+    def run_one(text: str) -> None:
+        prefix = tokenizer.encode_ids(text)
+        if not prefix:
+            emit(text, [])
+            return
+        streamed = []
+
+        def on_chunk(tokens, info):
+            streamed.extend(tokens)
+            print(f"serve: +{len(tokens)} tokens @pos {info['pos']} "
+                  f"({info['chunk_ms']:.1f} ms)", file=sys.stderr,
+                  flush=True)
+
+        try:
+            tokens, _ = gen.generate(prefix, args.max_new_tokens, sampling,
+                                     on_chunk=on_chunk)
+        except _DrainRequested:
+            emit(text, streamed)  # what was accepted still emits
+            raise
+        emit(text, tokens)
+
+    try:
+        if args.texts:
+            for text in args.texts:
+                run_one(text)
+        else:
+            for line in sys.stdin:
+                line = line.strip()
+                if line:
+                    run_one(line)
+    except _DrainRequested:
+        print("serve: drain requested — admission stopped", file=sys.stderr,
+              flush=True)
+    if args.stats:
+        print(json.dumps({"prompts": len(results)}), file=sys.stderr)
     return results
 
 

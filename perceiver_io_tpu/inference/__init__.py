@@ -16,6 +16,12 @@ from perceiver_io_tpu.inference.engine import (
     ServingEngine,
     WarmupHandle,
 )
+from perceiver_io_tpu.inference.generate import (
+    ARGenerator,
+    GenerateSessionStore,
+    GenSession,
+    SamplingConfig,
+)
 from perceiver_io_tpu.resilience import (
     BreakerOpen,
     DeadlineExceeded,
@@ -23,7 +29,11 @@ from perceiver_io_tpu.resilience import (
 )
 
 __all__ = [
+    "ARGenerator",
+    "GenSession",
+    "GenerateSessionStore",
     "Predictor",
+    "SamplingConfig",
     "bucket_size",
     "export_fn",
     "export_forward",

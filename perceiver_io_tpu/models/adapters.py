@@ -155,7 +155,12 @@ class TextInputAdapter(InputAdapter):
         return self.num_channels
 
     @nn.compact
-    def __call__(self, x: Array) -> Array:
+    def __call__(self, x: Array, positions: Optional[Array] = None) -> Array:
+        """``positions``: optional (B, L) int — the absolute position of each
+        token, for callers whose rows do NOT start at position 0 (the AR
+        decode step embeds ONE token at its true sequence position). Default
+        (None) keeps the contiguous ``[0, L)`` slice — bit-identical to the
+        historical behavior, and the gather-free fast path."""
         b, l = x.shape
         if l > self.max_seq_len:
             raise ValueError(f"sequence length {l} exceeds max_seq_len {self.max_seq_len}")
@@ -173,6 +178,8 @@ class TextInputAdapter(InputAdapter):
             uniform_init(-0.5, 0.5),
             (self.max_seq_len, self.num_channels),
         )
+        if positions is not None:
+            return emb + jnp.take(pos_enc, positions, axis=0).astype(self.dtype)
         return emb + pos_enc[:l].astype(self.dtype)
 
 

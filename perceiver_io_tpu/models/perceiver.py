@@ -267,6 +267,359 @@ class PerceiverIO(nn.Module):
         )
 
 
+class PerceiverARLayer(nn.Module):
+    """One causal encoder layer for the Perceiver-AR decode path: causal
+    cross-attention (latent window ← full input prefix) + causal latent
+    self-attention block.
+
+    Same submodule names as :class:`PerceiverLayer`
+    (``cross_attention_layer`` / ``self_attention_block``) so the param tree
+    keeps the torch-mirrored leaf names every sharding regex and interop
+    mapping matches on. Three call modes share the one weight set:
+
+    - **dense** (training / prefill / the parity oracle): ``causal_offset``
+      masks the cross-attention (query i at absolute position offset+i sees
+      keys ``<= offset+i``), the self-attention block is square-causal.
+      ``return_cache=True`` additionally harvests the tensors an incremental
+      decode caches — the cross (k, v) of the input stream and each
+      self-attention sub-layer's (k, v) — from the SAME computation.
+    - **kv_only**: project one new token's cross (k, v) for the cache ring.
+    - **incremental** (``latent_cache``): ``x_latent`` is the (B, 1, C) new
+      latent row; cross-attention runs against the caller-updated input ring
+      (``kv`` + ``pad_mask`` ring validity), the self-attention block writes
+      and attends its per-sub-layer rings at ``latent_index``.
+    """
+
+    num_latent_channels: int
+    num_input_channels: int
+    num_cross_attention_heads: int
+    num_self_attention_heads: int
+    num_self_attention_layers_per_block: int
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x_latent, x_input, pad_mask=None, deterministic=True,
+                 kv=None, causal_offset=None, kv_only=False,
+                 return_cache=False, latent_cache=None, latent_index=None,
+                 latent_pad=None):
+        xlayer = CrossAttentionLayer(
+            num_q_channels=self.num_latent_channels,
+            num_kv_channels=self.num_input_channels,
+            num_heads=self.num_cross_attention_heads,
+            dropout=self.dropout,
+            dtype=self.dtype,
+            attn_impl=self.attn_impl,
+            name="cross_attention_layer",
+        )
+        if kv_only:
+            return xlayer(x_latent, x_input, kv_only=True)
+        x_latent, kv_out = xlayer(
+            x_latent, x_input, pad_mask=pad_mask, deterministic=deterministic,
+            kv=kv, return_kv=True, causal_offset=causal_offset,
+        )
+        block = SelfAttentionBlock(
+            num_layers=self.num_self_attention_layers_per_block,
+            num_channels=self.num_latent_channels,
+            num_heads=self.num_self_attention_heads,
+            dropout=self.dropout,
+            dtype=self.dtype,
+            attn_impl=self.attn_impl,
+            name="self_attention_block",
+        )
+        if latent_cache is not None:
+            x_latent, rings = block(
+                x_latent, deterministic=deterministic, cache=latent_cache,
+                cache_index=latent_index, cache_pad=latent_pad,
+            )
+            return x_latent, rings
+        if return_cache:
+            x_latent, self_kvs = block(
+                x_latent, deterministic=deterministic, causal_offset=0,
+                return_kv=True,
+            )
+            return x_latent, kv_out, self_kvs
+        x_latent = block(x_latent, deterministic=deterministic,
+                         causal_offset=0)
+        return x_latent, kv_out
+
+
+class PerceiverARLM(nn.Module):
+    """Perceiver-AR causal language model (Hawthorne et al., 2022) on the
+    Perceiver IO component set: an arbitrary-length token prefix is
+    cross-attended into a small causal latent window covering the LAST N
+    positions, a causal latent self-attention stack refines it, and a causal
+    query decode predicts each window position's successor token.
+
+    Layout (torch-mirrored leaf names, PARAM_RULES-compatible):
+
+    - ``input_adapter``: token embedding + learned positions — the SAME
+      adapter the MLM stack uses, so the long-prefix encode rides the r5
+      long-context machinery unchanged (streaming fused cross-attention,
+      ``attn_impl='auto'`` KV-block tiers).
+    - ``latent``: ONE learned (1, C) latent row added to every window
+      query. Per-position identity comes from the (position-stable) input
+      embedding — a per-slot learned array would re-assign rows as the
+      window advances and break incremental-vs-dense parity.
+    - ``layer_1`` / ``layer_n``: the encoder recurrence of
+      :class:`PerceiverEncoder` (layer 1 unique, layers 2..num_layers ONE
+      shared weight set, cross K/V reused across applications), causal.
+    - ``output`` + ``cross_attention_layer`` + ``output_adapter``: the
+      decode — learned per-position output queries cross-attend the latent
+      window DIAGONALLY-causally (query i sees latents ``<= i``; without
+      this, a future latent would leak its token into an earlier
+      prediction), then the vocab projection.
+
+    Window rule: a length-L input with ``latent_offset`` o (default
+    ``L - min(num_latents, L)``) computes ``n = L - o`` latents for absolute
+    positions ``[o, L)``; logits row i predicts token ``o + i + 1``.
+
+    Incremental decode (:meth:`prefill` / :meth:`step`): prefill runs the
+    dense forward once over the (padded) prefix and harvests every tensor
+    the dense path attends over into fixed-capacity cache rings — input
+    cross (k, v) per cross weight set, latent (k, v) per (application,
+    sub-layer), final-latent (k, v) for the decode — so step t's single-row
+    recompute is attending over EXACTLY the dense forward's tensors. That is
+    the correctness spine: token-t logits from the cached step match a dense
+    full-prefix forward at 2e-5 on the f32 path (pinned tier-1).
+    """
+
+    input_adapter: nn.Module
+    output_adapter: nn.Module
+    num_latents: int
+    num_layers: int
+    num_cross_attention_heads: int = 4
+    num_self_attention_heads: int = 4
+    num_self_attention_layers_per_block: int = 2
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    attn_impl: str = "auto"
+
+    def setup(self):
+        c = self.input_adapter.num_input_channels
+        self.latent = self.param("latent", latent_init(), (1, c))
+        common = dict(
+            num_latent_channels=c,
+            num_input_channels=c,
+            num_cross_attention_heads=self.num_cross_attention_heads,
+            num_self_attention_heads=self.num_self_attention_heads,
+            num_self_attention_layers_per_block=(
+                self.num_self_attention_layers_per_block),
+            dropout=self.dropout,
+            dtype=self.dtype,
+            attn_impl=self.attn_impl,
+        )
+        self.layer_1 = PerceiverARLayer(**common)
+        if self.num_layers > 1:
+            self.layer_n = PerceiverARLayer(**common)
+        self.output = self.param(
+            "output", latent_init(), tuple(self.output_adapter.output_shape)
+        )
+        self.cross_attention_layer = CrossAttentionLayer(
+            num_q_channels=self.output_adapter.output_shape[-1],
+            num_kv_channels=c,
+            num_heads=self.num_cross_attention_heads,
+            dropout=self.dropout,
+            dtype=self.dtype,
+            attn_impl=self.attn_impl,
+        )
+
+    def _offset(self, l: int, latent_offset: Optional[int]) -> int:
+        o = l - min(self.num_latents, l) if latent_offset is None else latent_offset
+        if not 0 <= o < l:
+            raise ValueError(f"latent_offset {o} outside [0, {l})")
+        if l - o > self.num_latents:
+            raise ValueError(
+                f"latent window {l - o} exceeds num_latents {self.num_latents}"
+            )
+        return o
+
+    def _encode_window(self, h, pad_mask, o: int, deterministic: bool,
+                       return_cache: bool):
+        """Shared dense trunk: embedded input → causal latent window."""
+        q = h[:, o:] + self.latent.astype(self.dtype)
+        caches = []
+        if return_cache:
+            x, kv1, skvs = self.layer_1(
+                q, h, pad_mask=pad_mask, deterministic=deterministic,
+                causal_offset=o, return_cache=True)
+            caches.append(skvs)
+        else:
+            x, kv1 = self.layer_1(q, h, pad_mask=pad_mask,
+                                  deterministic=deterministic,
+                                  causal_offset=o)
+        kvn = None
+        for _ in range(self.num_layers - 1):
+            if return_cache:
+                x, kvn, skvs = self.layer_n(
+                    x, h, pad_mask=pad_mask, deterministic=deterministic,
+                    kv=kvn, causal_offset=o, return_cache=True)
+                caches.append(skvs)
+            else:
+                x, kvn = self.layer_n(x, h, pad_mask=pad_mask,
+                                      deterministic=deterministic, kv=kvn,
+                                      causal_offset=o)
+        return x, kv1, kvn, caches
+
+    def _decode_window(self, x, o: int, n: int, deterministic: bool,
+                       return_kv: bool):
+        queries = jnp.broadcast_to(
+            self.output[o: o + n].astype(self.dtype),
+            (x.shape[0], n, self.output.shape[-1]),
+        )
+        out = self.cross_attention_layer(
+            queries, x, deterministic=deterministic, causal_offset=0,
+            return_kv=return_kv,
+        )
+        if return_kv:
+            out, final_kv = out
+            return self.output_adapter(out), final_kv
+        return self.output_adapter(out)
+
+    def __call__(self, token_ids: Array, pad_mask: Optional[Array] = None,
+                 deterministic: bool = True,
+                 latent_offset: Optional[int] = None) -> Array:
+        """Dense causal forward — training and the incremental-parity
+        oracle: (B, L) token ids → (B, L - offset, vocab) logits, row i
+        predicting token ``offset + i + 1``."""
+        h = self.input_adapter(token_ids)
+        l = h.shape[1]
+        o = self._offset(l, latent_offset)
+        x, _, _, _ = self._encode_window(h, pad_mask, o, deterministic, False)
+        return self._decode_window(x, o, l - o, deterministic, False)
+
+    def prefill(self, token_ids: Array, pad_mask: Optional[Array] = None,
+                length: Optional[Array] = None,
+                latent_offset: Optional[int] = None,
+                deterministic: bool = True):
+        """Dense forward over the (possibly right-padded) prefix + cache
+        harvest: returns ``(logits, cache)``. ``length`` (scalar int32
+        array) is the REAL token count — slots at positions ``>= length``
+        hold pad garbage, are masked by the cache validity rules, and are
+        overwritten as generation proceeds. The cache pytree:
+
+        ``len``    scalar int32 — real tokens resident,
+        ``cross``  per cross weight set, (k, v) rings (B, W, E) over the
+                   input stream (+ the prefix pad mask folded into ``pad``),
+        ``pad``    (B, W) bool — True where the ring slot is invalid
+                   (beyond ``len``, or a prefix pad token),
+        ``latent`` per encoder application, per self-attention sub-layer,
+                   (k, v) rings (B, N, E),
+        ``final``  (k, v) ring (B, N, E) of decoded latent states.
+        """
+        h = self.input_adapter(token_ids)
+        b, l = token_ids.shape
+        o = self._offset(l, latent_offset)
+        n = l - o
+        if length is None:
+            length = jnp.asarray(l, jnp.int32)
+        x, kv1, kvn, latent_caches = self._encode_window(
+            h, pad_mask, o, deterministic, True)
+        logits, final_kv = self._decode_window(x, o, n, deterministic, True)
+        invalid = jnp.arange(l, dtype=jnp.int32)[None, :] >= length
+        if pad_mask is not None:
+            invalid = invalid | pad_mask
+        cross = {"layer_1": kv1}
+        if self.num_layers > 1:
+            cross["layer_n"] = kvn
+        cache = {
+            "len": jnp.asarray(length, jnp.int32),
+            "cross": cross,
+            "pad": jnp.broadcast_to(invalid, (b, l)),
+            "latent": latent_caches,
+            "final": final_kv,
+        }
+        return logits, cache
+
+    def step(self, cache, token: Array, deterministic: bool = True):
+        """One incremental decode step: append ``token`` (B, 1) at position
+        ``cache['len']``, recompute ONLY the new latent row against the
+        cache rings, and return ``(next_logits (B, vocab), new_cache)`` —
+        the logits for position ``len + 1``. Shape-stable in everything but
+        the (donatable) cache, so the whole generation loop is one compiled
+        program chained by ``lax.fori_loop`` (the tunnel-safe timing
+        discipline of PERF.md)."""
+        lax = jax.lax
+        k1 = cache["cross"]["layer_1"][0]
+        b, w, _ = k1.shape
+        n_cap = cache["final"][0].shape[1]
+        o = w - n_cap
+        p = cache["len"]                      # the new token's position
+        s = p - o                             # its latent window slot
+        zero = jnp.zeros((), jnp.int32)
+
+        pos = jnp.broadcast_to(jnp.reshape(p, (1, 1)), (b, 1))
+        h = self.input_adapter(token, positions=pos)
+
+        # append this token's cross k/v per weight set (same projections the
+        # dense forward applies — PerceiverARLayer kv_only)
+        cross = {}
+        layers = {"layer_1": self.layer_1}
+        if self.num_layers > 1:
+            layers["layer_n"] = self.layer_n
+        for name, layer in layers.items():
+            k_new, v_new = layer(h, h, kv_only=True)
+            k_ring, v_ring = cache["cross"][name]
+            cross[name] = (
+                lax.dynamic_update_slice(
+                    k_ring, k_new.astype(k_ring.dtype), (zero, p, zero)),
+                lax.dynamic_update_slice(
+                    v_ring, v_new.astype(v_ring.dtype), (zero, p, zero)),
+            )
+        # ring validity: the new slot becomes live, stale pad slots beyond
+        # stay masked (True = masked out)
+        live = jnp.arange(w, dtype=jnp.int32)[None, :] == p
+        kv_pad = jnp.broadcast_to(
+            (cache["pad"] | (jnp.arange(w, dtype=jnp.int32)[None, :] > p))
+            & ~live,
+            (b, w))
+        lat_pad = jnp.broadcast_to(
+            jnp.arange(n_cap, dtype=jnp.int32)[None, :] > s, (b, n_cap))
+
+        x = h + self.latent.astype(self.dtype)
+        new_latent = []
+        apps = [("layer_1", 0)] + [
+            ("layer_n", a) for a in range(1, self.num_layers)
+        ]
+        for name, a in apps:
+            x, rings = layers[name](
+                x, h, pad_mask=kv_pad, deterministic=deterministic,
+                kv=cross[name], latent_cache=cache["latent"][a],
+                latent_index=s, latent_pad=lat_pad,
+            )
+            new_latent.append(rings)
+
+        # decode: append the new final-latent k/v, query = output[p]
+        fk, fv = self.cross_attention_layer(x, x, kv_only=True)
+        final = (
+            lax.dynamic_update_slice(
+                cache["final"][0], fk.astype(cache["final"][0].dtype),
+                (zero, s, zero)),
+            lax.dynamic_update_slice(
+                cache["final"][1], fv.astype(cache["final"][1].dtype),
+                (zero, s, zero)),
+        )
+        query = jnp.broadcast_to(
+            jnp.take(self.output, jnp.reshape(p, (1,)), axis=0
+                     ).astype(self.dtype)[None],
+            (b, 1, self.output.shape[-1]),
+        )
+        dec = self.cross_attention_layer(
+            query, x, pad_mask=lat_pad, kv=final,
+            deterministic=deterministic,
+        )
+        logits = self.output_adapter(dec)[:, 0, :]
+        new_cache = {
+            "len": p + 1,
+            "cross": cross,
+            "pad": cache["pad"] & ~live,
+            "latent": new_latent,
+            "final": final,
+        }
+        return logits, new_cache
+
+
 class PerceiverMLM(nn.Module):
     """masking → encoder → decoder, logits truncated to input length
     (reference ``model.py:296-318``).

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 
 from perceiver_io_tpu.models.adapters import TextInputAdapter, TextOutputAdapter
 from perceiver_io_tpu.models.perceiver import (
+    PerceiverARLM,
     PerceiverDecoder,
     PerceiverEncoder,
     PerceiverMLM,
@@ -84,6 +85,84 @@ def tiny_mlm(
         max_seq_len=max_seq_len,
         num_latents=num_latents,
         num_channels=num_channels,
+        num_layers=num_layers,
+        num_self_attention_layers_per_block=num_self_attention_layers_per_block,
+        dtype=dtype,
+        attn_impl=attn_impl,
+    )
+
+
+def flagship_ar(
+    vocab_size: int = 10003,
+    max_seq_len: int = 512,
+    num_latents: int = 256,
+    num_channels: int = 512,
+    num_layers: int = 3,
+    num_self_attention_layers_per_block: int = 6,
+    dtype: jnp.dtype = jnp.bfloat16,
+    attn_impl: str = "auto",
+) -> PerceiverARLM:
+    """The generative (Perceiver-AR causal decode) task at the flagship
+    TPU-native widths: same encoder recipe shape as ``flagship_tpu_mlm``
+    (3 layers × (cross + 6-layer self block), C=512 / head depth 128), with
+    the causal latent window covering the last ``num_latents`` positions and
+    a causal query decode predicting each successor token.
+
+    ``attn_impl`` stays 'auto', which currently resolves every CAUSAL call
+    to XLA — the decode-shape kernel sweep that would set Pallas thresholds
+    is queued on the tunnel (PERF.md §Generation); dispatch thresholds only
+    move with measurements."""
+    return _build_ar(
+        vocab_size=vocab_size, max_seq_len=max_seq_len,
+        num_latents=num_latents, num_channels=num_channels,
+        num_layers=num_layers,
+        num_self_attention_layers_per_block=num_self_attention_layers_per_block,
+        dtype=dtype, attn_impl=attn_impl,
+    )
+
+
+def tiny_ar(
+    vocab_size: int = 503,
+    max_seq_len: int = 64,
+    num_latents: int = 16,
+    num_channels: int = 32,
+    num_layers: int = 2,
+    num_self_attention_layers_per_block: int = 1,
+    dtype: jnp.dtype = jnp.float32,
+    attn_impl: str = "auto",
+) -> PerceiverARLM:
+    """CPU-scale twin of :func:`flagship_ar` — the generation engine /
+    serving / chaos tests and the offline modes of the benches all build
+    exactly this model (one definition, like :func:`tiny_mlm`)."""
+    return _build_ar(
+        vocab_size=vocab_size, max_seq_len=max_seq_len,
+        num_latents=num_latents, num_channels=num_channels,
+        num_layers=num_layers,
+        num_self_attention_layers_per_block=num_self_attention_layers_per_block,
+        dtype=dtype, attn_impl=attn_impl,
+    )
+
+
+def _build_ar(
+    vocab_size: int,
+    max_seq_len: int,
+    num_latents: int,
+    num_channels: int,
+    num_layers: int,
+    num_self_attention_layers_per_block: int,
+    dtype: jnp.dtype,
+    attn_impl: str,
+) -> PerceiverARLM:
+    return PerceiverARLM(
+        input_adapter=TextInputAdapter(
+            vocab_size=vocab_size, max_seq_len=max_seq_len,
+            num_channels=num_channels, dtype=dtype,
+        ),
+        output_adapter=TextOutputAdapter(
+            vocab_size=vocab_size, max_seq_len=max_seq_len,
+            num_output_channels=num_channels, dtype=dtype,
+        ),
+        num_latents=num_latents,
         num_layers=num_layers,
         num_self_attention_layers_per_block=num_self_attention_layers_per_block,
         dtype=dtype,

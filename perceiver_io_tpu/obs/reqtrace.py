@@ -74,6 +74,8 @@ SPAN_NAMES = frozenset({
     "router_reroute",         # failover hop: the backoff gap between attempts
     "router_affinity_spill",  # a session pin died (caller re-encodes)
     "replica_serve",          # replica-side: RPC arrival → response built
+    "replica_generate",       # replica-side: one streamed generate RPC
+    "generate_step",          # one chunked decode dispatch within a stream
     "deploy_swap",            # install start → bake end (fleet context)
 })
 

@@ -228,6 +228,8 @@ class MultiHeadAttention(nn.Module):
         deterministic: bool = True,
         kv: Optional[Tuple[Array, Array]] = None,
         return_kv: bool = False,
+        causal_offset: Optional[int] = None,
+        kv_only: bool = False,
     ) -> Any:
         """``kv``: optional precomputed (k, v) projections — (B, S, E) in
         compute dtype, as returned by a previous call with ``return_kv=True``.
@@ -238,6 +240,20 @@ class MultiHeadAttention(nn.Module):
         forward dedup XLA's CSE sometimes finds anyway; the real win is the
         BACKWARD, where autodiff otherwise emits a full dW/dx projection pass
         per application (measured on the 131k-token MLM config, PERF.md r5).
+
+        ``causal_offset``: static int — query row i may attend key positions
+        ``<= i + causal_offset`` (``ops.masking.causal_mask``), composed with
+        ``pad_mask``/``attn_mask`` by OR. The explicit kernel path applies it
+        in-kernel (``fused_attention(causal_offset=)``); 'auto' dispatches
+        causal shapes to XLA for now — the decode-shape sweep that would set
+        kernel thresholds is queued on the tunnel (PERF.md §Generation), and
+        an unmeasured dispatch flip is exactly what the threshold invariants
+        forbid.
+
+        ``kv_only``: project and return ONLY this call's (k, v) of ``x_kv``
+        — no attention, no output projection. The incremental-decode path
+        uses it to append one new row to a KV cache ring with the SAME
+        weights the dense path projects with (cache parity by construction).
         """
         e = self.num_q_channels
         h = self.num_heads
@@ -251,6 +267,16 @@ class MultiHeadAttention(nn.Module):
                 "'auto', 'xla', 'pallas', 'pallas_sp', 'packed'"
             )
         d = e // h
+
+        if kv_only:
+            # k/v projections only — q_proj/out_proj are neither declared
+            # nor touched (their in_features belong to the query stream,
+            # which this call does not have)
+            wk, bk = _LinearParams(x_kv.shape[-1], e, name="k_proj")()
+            wv, bv = _LinearParams(x_kv.shape[-1], e, name="v_proj")()
+            xkv, wk, bk, wv, bv = nn.dtypes.promote_dtype(
+                x_kv, wk, bk, wv, bv, dtype=self.dtype)
+            return xkv @ wk + bk, xkv @ wv + bv
 
         wq, bq = _LinearParams(x_q.shape[-1], e, name="q_proj")()
         wk, bk = _LinearParams(x_kv.shape[-1], e, name="k_proj")()
@@ -306,7 +332,8 @@ class MultiHeadAttention(nn.Module):
         # 'pallas_sp' degrades to 'pallas' wherever sp doesn't apply, so one
         # model-level flag flips only the encoder cross-attention.
         sp = None
-        if self.seq_shard_kv and impl in ("auto", "pallas", "pallas_sp"):
+        if (self.seq_shard_kv and causal_offset is None
+                and impl in ("auto", "pallas", "pallas_sp")):
             from perceiver_io_tpu.parallel.mesh import active_sequence_parallel
 
             ctx = active_sequence_parallel()
@@ -328,7 +355,18 @@ class MultiHeadAttention(nn.Module):
             # self-attention go to the fused kernel, everything else to XLA
             # (see auto_attention_impl). Mesh-aware: under an active
             # seq-parallel regime the same shapes route to the sp kernel.
-            impl = auto_attention_impl(b, t, s, h, d)
+            # Causal (AR decode) shapes resolve CONSERVATIVELY to XLA until
+            # the decode-shape sweep lands (tools/attn_shapes_bench.py
+            # --decode; queued in PERF.md §Generation — dispatch thresholds
+            # only move with measurements). Explicit 'pallas' takes the
+            # kernel's in-kernel causal flag.
+            impl = ("xla" if causal_offset is not None
+                    else auto_attention_impl(b, t, s, h, d))
+        if impl == "packed" and causal_offset is not None:
+            raise ValueError(
+                "attn_impl='packed' does not implement causal_offset — use "
+                "'auto'/'xla' (masked einsum) or 'pallas' (in-kernel flag)"
+            )
         fusable = attn_mask is None and not dropout_active
         if impl == "pallas" and fusable and sp is not None:
             from perceiver_io_tpu.ops.pallas_attention import (
@@ -363,8 +401,15 @@ class MultiHeadAttention(nn.Module):
             out = fused_attention(
                 q.reshape(b, t, h, d), k.reshape(b, s, h, d),
                 v.reshape(b, s, h, d), pad_mask=pad_mask,
+                causal_offset=causal_offset,
             ).reshape(b, t, e)
         else:
+            if causal_offset is not None:
+                from perceiver_io_tpu.ops.masking import causal_mask
+
+                cmask = causal_mask(t, s, causal_offset)
+                attn_mask = (cmask if attn_mask is None
+                             else attn_mask | cmask)
             out = _dot_product_attention(
                 q.reshape(b, t, h, d), k.reshape(b, s, h, d),
                 v.reshape(b, s, h, d), pad_mask, attn_mask,
@@ -400,15 +445,16 @@ class CrossAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x_q, x_kv, pad_mask=None, attn_mask=None, deterministic=True,
-                 kv=None, return_kv=False):
+                 kv=None, return_kv=False, causal_offset=None, kv_only=False):
         """``kv``/``return_kv``: precomputed K/V reuse across shared-weight
         applications (see ``MultiHeadAttention``). With ``kv`` given, the
         kv_norm + k/v projections are skipped entirely — the cached tensors
-        already include them."""
-        x_q = layer_norm(self.dtype, "q_norm")(x_q)
-        if kv is None:
-            x_kv = layer_norm(self.dtype, "kv_norm")(x_kv)
-        return MultiHeadAttention(
+        already include them. ``kv_only``: kv_norm + k/v projections of
+        ``x_kv`` ONLY (no query side at all) — what a decode step appends to
+        its cache ring, bit-identical to what a dense forward would have
+        projected for the same rows. ``causal_offset``: see
+        :class:`MultiHeadAttention`."""
+        mha = MultiHeadAttention(
             num_q_channels=self.num_q_channels,
             num_kv_channels=self.num_kv_channels,
             num_heads=self.num_heads,
@@ -417,8 +463,16 @@ class CrossAttention(nn.Module):
             attn_impl=self.attn_impl,
             seq_shard_kv=self.seq_shard_kv,
             name="attention",
-        )(x_q, x_kv, pad_mask=pad_mask, attn_mask=attn_mask,
-          deterministic=deterministic, kv=kv, return_kv=return_kv)
+        )
+        if kv_only:
+            x_kv = layer_norm(self.dtype, "kv_norm")(x_kv)
+            return mha(x_kv, x_kv, kv_only=True)
+        x_q = layer_norm(self.dtype, "q_norm")(x_q)
+        if kv is None:
+            x_kv = layer_norm(self.dtype, "kv_norm")(x_kv)
+        return mha(x_q, x_kv, pad_mask=pad_mask, attn_mask=attn_mask,
+                   deterministic=deterministic, kv=kv, return_kv=return_kv,
+                   causal_offset=causal_offset)
 
 
 class SelfAttention(nn.Module):
@@ -431,9 +485,15 @@ class SelfAttention(nn.Module):
     attn_impl: str = "auto"
 
     @nn.compact
-    def __call__(self, x, pad_mask=None, attn_mask=None, deterministic=True):
+    def __call__(self, x, pad_mask=None, attn_mask=None, deterministic=True,
+                 causal_offset=None, kv=None, kv_only=False):
+        """``causal_offset``/``kv``/``kv_only``: the causal + KV-cache
+        surface (see :class:`MultiHeadAttention`) — ``kv_only`` returns this
+        stream's post-norm (k, v) rows for a decode cache ring; ``kv`` runs
+        the query side of ``x`` against a caller-held ring instead of
+        re-projecting the stream."""
         x = layer_norm(self.dtype, "norm")(x)
-        return MultiHeadAttention(
+        mha = MultiHeadAttention(
             num_q_channels=self.num_channels,
             num_kv_channels=self.num_channels,
             num_heads=self.num_heads,
@@ -441,7 +501,12 @@ class SelfAttention(nn.Module):
             dtype=self.dtype,
             attn_impl=self.attn_impl,
             name="attention",
-        )(x, x, pad_mask=pad_mask, attn_mask=attn_mask, deterministic=deterministic)
+        )
+        if kv_only:
+            return mha(x, x, kv_only=True)
+        return mha(x, x, pad_mask=pad_mask, attn_mask=attn_mask,
+                   deterministic=deterministic, causal_offset=causal_offset,
+                   kv=kv)
 
 
 class MLP(nn.Module):
@@ -492,11 +557,12 @@ class CrossAttentionLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x_q, x_kv, pad_mask=None, deterministic=True,
-                 kv=None, return_kv=False):
+                 kv=None, return_kv=False, causal_offset=None,
+                 kv_only=False):
         # Residual adds the FIRST positional arg (reference model.py:47-56):
         # for cross-attention that is the query/latent stream.
         drop = nn.Dropout(rate=self.dropout)
-        attn_out = CrossAttention(
+        xattn = CrossAttention(
             num_q_channels=self.num_q_channels,
             num_kv_channels=self.num_kv_channels,
             num_heads=self.num_heads,
@@ -505,8 +571,14 @@ class CrossAttentionLayer(nn.Module):
             attn_impl=self.attn_impl,
             seq_shard_kv=self.seq_shard_kv,
             name="cross_attention",
-        )(x_q, x_kv, pad_mask=pad_mask, deterministic=deterministic,
-          kv=kv, return_kv=return_kv)
+        )
+        if kv_only:
+            # the decode-step cache append: kv_norm + k/v projections of
+            # x_kv only, no query/residual/MLP work (see CrossAttention)
+            return xattn(x_q, x_kv, kv_only=True)
+        attn_out = xattn(x_q, x_kv, pad_mask=pad_mask,
+                         deterministic=deterministic, kv=kv,
+                         return_kv=return_kv, causal_offset=causal_offset)
         if return_kv:
             attn_out, kv_out = attn_out
         x = drop(attn_out, deterministic=deterministic) + x_q
@@ -527,19 +599,63 @@ class SelfAttentionLayer(nn.Module):
     attn_impl: str = "auto"
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=True, attn_mask=None,
+                 causal_offset=None, return_kv=False,
+                 cache=None, cache_index=None, cache_pad=None):
+        """Three modes sharing one weight set:
+
+        - plain (default): the MLM path, unchanged.
+        - dense causal (``causal_offset``/``attn_mask``): the AR training /
+          prefill forward. ``return_kv=True`` additionally returns this
+          layer's post-norm (k, v) of the full stream — exactly the rows a
+          decode cache ring holds, so prefill builds its caches from the
+          SAME tensors the dense forward attends over (parity by
+          construction).
+        - incremental (``cache``): ``x`` is the (B, 1, C) new-row stream;
+          the layer projects the row's k/v, writes them at ``cache_index``
+          (scalar int array) into the (B, S_cap, E) rings, attends the
+          single query over the updated rings under ``cache_pad`` (B, S_cap;
+          True = empty/invalid slot), and returns ``(out, updated_cache)``.
+        """
+        import jax.lax as lax
+
         drop = nn.Dropout(rate=self.dropout)
-        attn_out = SelfAttention(
+        attn = SelfAttention(
             num_channels=self.num_channels,
             num_heads=self.num_heads,
             dropout=self.dropout,
             dtype=self.dtype,
             attn_impl=self.attn_impl,
             name="self_attention",
-        )(x, deterministic=deterministic)
+        )
+        if cache is not None:
+            k_ring, v_ring = cache
+            k_new, v_new = attn(x, kv_only=True)
+            zero = jnp.zeros((), jnp.int32)
+            k_ring = lax.dynamic_update_slice(
+                k_ring, k_new.astype(k_ring.dtype), (zero, cache_index, zero))
+            v_ring = lax.dynamic_update_slice(
+                v_ring, v_new.astype(v_ring.dtype), (zero, cache_index, zero))
+            attn_out = attn(x, pad_mask=cache_pad, kv=(k_ring, v_ring),
+                            deterministic=deterministic)
+        elif return_kv:
+            k_full, v_full = attn(x, kv_only=True)
+            attn_out = attn(x, attn_mask=attn_mask,
+                            causal_offset=causal_offset,
+                            kv=(k_full, v_full),
+                            deterministic=deterministic)
+        else:
+            attn_out = attn(x, attn_mask=attn_mask,
+                            causal_offset=causal_offset,
+                            deterministic=deterministic)
         x = drop(attn_out, deterministic=deterministic) + x
         mlp_out = MLP(self.num_channels, dtype=self.dtype, name="mlp")(x)
-        return drop(mlp_out, deterministic=deterministic) + x
+        out = drop(mlp_out, deterministic=deterministic) + x
+        if cache is not None:
+            return out, (k_ring, v_ring)
+        if return_kv:
+            return out, (k_full, v_full)
+        return out
 
 
 class SelfAttentionBlock(nn.Module):
@@ -558,14 +674,38 @@ class SelfAttentionBlock(nn.Module):
     attn_impl: str = "auto"
 
     @nn.compact
-    def __call__(self, x, deterministic=True):
+    def __call__(self, x, deterministic=True, attn_mask=None,
+                 causal_offset=None, return_kv=False,
+                 cache=None, cache_index=None, cache_pad=None):
+        """Causal/cache surface mirrors :class:`SelfAttentionLayer`, with
+        ``cache`` (and the ``return_kv`` harvest) as a LIST of per-layer
+        (k, v) pairs — each stacked layer owns one ring."""
+        kvs = []
+        updated = []
         for i in range(self.num_layers):
-            x = SelfAttentionLayer(
+            layer = SelfAttentionLayer(
                 num_channels=self.num_channels,
                 num_heads=self.num_heads,
                 dropout=self.dropout,
                 dtype=self.dtype,
                 attn_impl=self.attn_impl,
                 name=f"layer_{i}",
-            )(x, deterministic=deterministic)
+            )
+            if cache is not None:
+                x, ring = layer(x, deterministic=deterministic,
+                                cache=cache[i], cache_index=cache_index,
+                                cache_pad=cache_pad)
+                updated.append(ring)
+            elif return_kv:
+                x, kv = layer(x, deterministic=deterministic,
+                              attn_mask=attn_mask,
+                              causal_offset=causal_offset, return_kv=True)
+                kvs.append(kv)
+            else:
+                x = layer(x, deterministic=deterministic,
+                          attn_mask=attn_mask, causal_offset=causal_offset)
+        if cache is not None:
+            return x, updated
+        if return_kv:
+            return x, kvs
         return x

@@ -22,7 +22,7 @@ given (key, batch) — the TPU-native replacement for per-step CUDA RNG.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +30,82 @@ import jax.numpy as jnp
 Array = jax.Array
 
 IGNORE_LABEL = -100
+
+
+# -- causal attention masks (the Perceiver-AR decode path) --------------------
+#
+# Mask convention throughout ops/attention.py: True = masked OUT (the torch
+# ``key_padding_mask`` sense). A causal mask is a pure function of the query
+# row's absolute position: query row i sits at position ``offset + i`` and may
+# attend key j iff ``j <= offset + i``. offset = 0 is the square causal
+# self-attention mask; offset = L - N is the Perceiver-AR cross-attention
+# mask, where N latent queries cover the LAST N positions of an L-token input
+# and each latent sees the full prefix up to (and including) its own token.
+
+
+def causal_mask(num_queries: int, num_keys: int, offset: int = 0) -> Array:
+    """(T, S) bool causal mask, True = masked out: query row ``i`` (absolute
+    position ``offset + i``) may attend key positions ``<= offset + i``.
+
+    Composes with a (B, S) pad mask by OR — ``ops.attention`` applies both
+    independently, which is exactly that composition (a position is masked
+    when padded OR acausal). The fused Pallas kernel takes the same rule as
+    a ``causal_offset`` flag and applies it in-kernel instead of reading a
+    materialized (T, S) mask (``ops.pallas_attention.fused_attention``)."""
+    rows = jnp.arange(num_queries, dtype=jnp.int32)[:, None]
+    cols = jnp.arange(num_keys, dtype=jnp.int32)[None, :]
+    return cols > rows + offset
+
+
+def combine_attention_masks(
+    pad_mask: Optional[Array],
+    attn_mask: Optional[Array],
+    num_queries: Optional[int] = None,
+) -> Optional[Array]:
+    """The effective (B, T, S) True=masked-out mask the attention paths apply
+    — pad (B, S) OR'd with a (T, S)/(B, T, S) structural mask. The dense
+    oracle the masking-parity tests check the kernel paths against; returns
+    None when neither input masks anything."""
+    if pad_mask is None and attn_mask is None:
+        return None
+    if attn_mask is not None and attn_mask.ndim == 2:
+        attn_mask = attn_mask[None]
+    if pad_mask is None:
+        return attn_mask
+    pad = pad_mask[:, None, :]
+    if num_queries is not None:
+        pad = jnp.broadcast_to(
+            pad, (pad_mask.shape[0], num_queries, pad_mask.shape[-1])
+        )
+    if attn_mask is None:
+        return pad
+    return pad | attn_mask
+
+
+def shift_ar_labels(token_ids: Array, pad_mask: Optional[Array],
+                    latent_offset: int = 0) -> Array:
+    """Next-token labels for the causal AR window: the query at absolute
+    position ``latent_offset + i`` predicts ``token_ids[:, latent_offset + i
+    + 1]``. Returns (B, L - latent_offset) int32 labels with
+    :data:`IGNORE_LABEL` at the final position (no successor) and wherever
+    the TARGET token is padding — the same ignore convention MLM's CE uses,
+    so ``cross_entropy_with_ignore`` applies unchanged."""
+    b, l = token_ids.shape
+    n = l - latent_offset
+    # Successor ids via roll-then-slice, NOT concat: under a seq-sharded
+    # batch (shard_seq=True with tp x sp meshes) this XLA build's SPMD
+    # partitioner miscompiles a concat along the sharded axis (the r6
+    # fused-QKV repro — here it surfaced as NaN loss in the dp2/tp2/sp2
+    # dry run); roll lowers to a collective permute, which partitions
+    # correctly. The wrapped-around element lands at the final slot, which
+    # is ignored anyway (no successor exists there).
+    succ = jnp.roll(token_ids, -1, axis=1)[:, latent_offset:]
+    labels = succ.astype(jnp.int32)
+    last = jnp.arange(n, dtype=jnp.int32)[None, :] == n - 1
+    invalid = jnp.broadcast_to(last, (b, n))
+    if pad_mask is not None:
+        invalid = invalid | jnp.roll(pad_mask, -1, axis=1)[:, latent_offset:]
+    return jnp.where(invalid, IGNORE_LABEL, labels)
 
 
 def apply_text_masking(

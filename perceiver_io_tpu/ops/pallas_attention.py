@@ -178,8 +178,22 @@ def _kv_block_size(s: int, requested: int, alignment: int) -> int:
     return best if best * 2 >= requested else 0
 
 
+def _causal_bias(t_blk: int, s_blk: int, t_idx, s_idx, offset: int):
+    """(T_blk, S_blk) additive causal bias for the current grid tile: query
+    row i (GLOBAL row ``t_idx*t_blk + i``, absolute position row + offset)
+    may attend key ``j <= row + offset`` — the in-kernel twin of
+    ``ops.masking.causal_mask``. Additive MASK_VALUE (not a where) so a
+    fully-masked row keeps the uniform-softmax semantics of the pad path."""
+    rows = t_idx * t_blk + jax.lax.broadcasted_iota(
+        jnp.int32, (t_blk, s_blk), 0)
+    cols = s_idx * s_blk + jax.lax.broadcasted_iota(
+        jnp.int32, (t_blk, s_blk), 1)
+    return jnp.where(cols > rows + offset, MASK_VALUE, 0.0)
+
+
 def _attention_kernel(bias_ref, q_ref, k_ref, v_ref, out_ref, *rest,
-                      scale: float, with_lse: bool):
+                      scale: float, with_lse: bool,
+                      causal_offset: Optional[int]):
     if with_lse:
         m_out, l_out, m_ref, l_ref, acc_ref = rest
         lse_ref = (m_out, l_out)
@@ -197,6 +211,9 @@ def _attention_kernel(bias_ref, q_ref, k_ref, v_ref, out_ref, *rest,
     k = k_ref[0, 0]  # (S_blk, D)
     logits = _dot(q, k, (1, 1)) * scale  # (T_blk, S_blk)
     logits += bias_ref[0]  # (1, S_blk) broadcasts over T_blk
+    if causal_offset is not None:
+        logits += _causal_bias(q.shape[0], k.shape[0], pl.program_id(2),
+                               s_idx, causal_offset)
 
     m_prev = m_ref[:, :1]  # (T_blk, 1)
     l_prev = l_ref[:, :1]
@@ -221,11 +238,14 @@ def _attention_kernel(bias_ref, q_ref, k_ref, v_ref, out_ref, *rest,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("t_blk", "s_blk", "interpret", "with_lse")
+    jax.jit,
+    static_argnames=("t_blk", "s_blk", "interpret", "with_lse",
+                     "causal_offset"),
 )
 def _fused_attention_fwd_impl(
     q: Array, k: Array, v: Array, bias: Array,
     t_blk: int, s_blk: int, interpret: bool, with_lse: bool = False,
+    causal_offset: Optional[int] = None,
 ):
     """(B, H, T, D) q against (B, H, S, D) k/v with (B, S) additive bias.
     ``t_blk``/``s_blk`` must divide T/S (the wrapper guarantees it).
@@ -250,7 +270,8 @@ def _fused_attention_fwd_impl(
 
     bias = bias[:, None, :]  # (B, 1, S)
     kernel = pl.pallas_call(
-        functools.partial(_attention_kernel, scale=scale, with_lse=with_lse),
+        functools.partial(_attention_kernel, scale=scale, with_lse=with_lse,
+                          causal_offset=causal_offset),
         grid=grid,
         in_specs=[
             # (B, 1, S) so the block's trailing dims satisfy TPU tiling
@@ -277,18 +298,27 @@ def _fused_attention_fwd_impl(
 
 
 def _recompute_probs_and_ds(bias_ref, q_ref, k_ref, v_ref, g_ref,
-                            m_ref, l_ref, di_ref, *, scale: float):
+                            m_ref, l_ref, di_ref, *, scale: float,
+                            causal_offset: Optional[int],
+                            t_idx, s_idx):
     """Shared backward tile math: recompute p = exp(logits − m)/l for this
     (T_blk, S_blk) tile and the softmax gradient ds = p·(dp − delta).
 
     ds is zeroed on fully padded rows (m pinned at MASK_VALUE) so dq/dk
     reproduce the XLA path's where-masking; p is left intact there (uniform
-    1/l) because dv keeps the uniform contribution on that path."""
+    1/l) because dv keeps the uniform contribution on that path. With a
+    ``causal_offset`` the tile recomputes the same in-kernel causal bias the
+    forward applied (``t_idx``/``s_idx`` are the GLOBAL query/key block
+    indices — the two backward kernels run swapped grids, so the caller
+    passes whichever program_id carries each axis)."""
     q = q_ref[0, 0]  # (T_blk, D)
     k = k_ref[0, 0]  # (S_blk, D)
     g = g_ref[0, 0]  # (T_blk, D)
     logits = _dot(q, k, (1, 1)) * scale  # (T_blk, S_blk)
     logits += bias_ref[0]  # (1, S_blk) broadcasts over T_blk
+    if causal_offset is not None:
+        logits += _causal_bias(q.shape[0], k.shape[0], t_idx, s_idx,
+                               causal_offset)
     m = m_ref[0, 0][:, :1]  # (T_blk, 1)
     l = l_ref[0, 0][:, :1]
     p = jnp.exp(logits - m) / l
@@ -299,7 +329,8 @@ def _recompute_probs_and_ds(bias_ref, q_ref, k_ref, v_ref, g_ref,
 
 
 def _bwd_dq_kernel(bias_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, di_ref,
-                   dq_ref, acc_ref, *, scale: float):
+                   dq_ref, acc_ref, *, scale: float,
+                   causal_offset: Optional[int]):
     s_idx = pl.program_id(3)
 
     @pl.when(s_idx == 0)
@@ -307,7 +338,9 @@ def _bwd_dq_kernel(bias_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, di_ref,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     _, ds, _, k, _ = _recompute_probs_and_ds(
-        bias_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, di_ref, scale=scale
+        bias_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, di_ref,
+        scale=scale, causal_offset=causal_offset,
+        t_idx=pl.program_id(2), s_idx=s_idx,
     )
     acc_ref[:] += _dot(ds.astype(k.dtype), k, (1, 0))  # (T_blk, D)
 
@@ -317,7 +350,8 @@ def _bwd_dq_kernel(bias_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, di_ref,
 
 
 def _bwd_dkv_kernel(bias_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, di_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float):
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                    causal_offset: Optional[int]):
     t_idx = pl.program_id(3)
 
     @pl.when(t_idx == 0)
@@ -326,7 +360,9 @@ def _bwd_dkv_kernel(bias_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, di_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     p, ds, q, _, g = _recompute_probs_and_ds(
-        bias_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, di_ref, scale=scale
+        bias_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, di_ref,
+        scale=scale, causal_offset=causal_offset,
+        t_idx=t_idx, s_idx=pl.program_id(2),
     )
     # contract the query axis: (T_blk, S_blk)ᵀ·(T_blk, D) → (S_blk, D)
     dv_acc[:] += _dot(p.astype(g.dtype), g, (0, 0))
@@ -338,11 +374,15 @@ def _bwd_dkv_kernel(bias_ref, q_ref, k_ref, v_ref, g_ref, m_ref, l_ref, di_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("t_blk", "s_blk", "interpret"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("t_blk", "s_blk", "interpret", "causal_offset"),
+)
 def _fused_attention_bwd_impl(
     q: Array, k: Array, v: Array, bias: Array, out: Array,
     m: Array, l: Array,
     g: Array, t_blk: int, s_blk: int, interpret: bool,
+    causal_offset: Optional[int] = None,
 ):
     b, h, t, d = q.shape
     s = k.shape[2]
@@ -360,7 +400,8 @@ def _fused_attention_bwd_impl(
     bias_spec = pl.BlockSpec((1, 1, s_blk), lambda bi, hi, ti, si: (bi, 0, si))
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale),
+        functools.partial(_bwd_dq_kernel, scale=scale,
+                          causal_offset=causal_offset),
         grid=(b, h, t // t_blk, s // s_blk),  # KV axis sequential
         in_specs=[bias_spec, qo_spec, kv_spec, kv_spec, qo_spec,
                   lm_spec, lm_spec, lm_spec],
@@ -381,7 +422,8 @@ def _fused_attention_bwd_impl(
                             lambda bi, hi, si, ti: (bi, hi, ti, 0))
     bias_spec2 = pl.BlockSpec((1, 1, s_blk), lambda bi, hi, si, ti: (bi, 0, si))
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale),
+        functools.partial(_bwd_dkv_kernel, scale=scale,
+                          causal_offset=causal_offset),
         grid=(b, h, s // s_blk, t // t_blk),  # query axis sequential
         in_specs=[bias_spec2, qo_spec2, kv_spec2, kv_spec2, qo_spec2,
                   lm_spec2, lm_spec2, lm_spec2],
@@ -398,22 +440,25 @@ def _fused_attention_bwd_impl(
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _fused_attention(q, k, v, bias, t_blk, s_blk, interpret):
-    return _fused_attention_fwd_impl(q, k, v, bias, t_blk, s_blk, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _fused_attention(q, k, v, bias, t_blk, s_blk, interpret, causal_offset):
+    return _fused_attention_fwd_impl(q, k, v, bias, t_blk, s_blk, interpret,
+                                     causal_offset=causal_offset)
 
 
-def _fwd(q, k, v, bias, t_blk, s_blk, interpret):
+def _fwd(q, k, v, bias, t_blk, s_blk, interpret, causal_offset):
     out, m, l = _fused_attention_fwd_impl(
-        q, k, v, bias, t_blk, s_blk, interpret, with_lse=True
+        q, k, v, bias, t_blk, s_blk, interpret, with_lse=True,
+        causal_offset=causal_offset,
     )
     return out, (q, k, v, bias, out, m, l)
 
 
-def _bwd(t_blk, s_blk, interpret, residuals, g):
+def _bwd(t_blk, s_blk, interpret, causal_offset, residuals, g):
     q, k, v, bias, out, m, l = residuals
     dq, dk, dv = _fused_attention_bwd_impl(
-        q, k, v, bias, out, m, l, g, t_blk, s_blk, interpret
+        q, k, v, bias, out, m, l, g, t_blk, s_blk, interpret,
+        causal_offset=causal_offset,
     )
     return dq, dk, dv, jnp.zeros_like(bias)
 
@@ -489,16 +534,22 @@ def fused_attention(
     kv_block_size: Optional[int] = None,
     q_block_size: Optional[int] = None,
     interpret: Optional[bool] = None,
+    causal_offset: Optional[int] = None,
 ) -> Array:
     """Fused multi-head attention over (B, T, H, D) q and (B, S, H, D) k/v.
 
     ``pad_mask``: optional (B, S) bool, True = key position masked out (the
-    torch ``key_padding_mask`` convention). ``kv_block_size=None`` (default)
-    resolves per shape — wider KV streaming for shallow heads at long S (see
-    ``_auto_kv_block``); ``q_block_size=None`` (default) resolves per shape
-    after KV-block sizing (see LONG_KV_Q_BLOCK). Off-TPU backends run the
-    kernel in interpreter mode (slow — for tests), overridable via
-    ``interpret``.
+    torch ``key_padding_mask`` convention). ``causal_offset``: static int —
+    query row i may attend key positions ``<= i + causal_offset`` (the
+    ``ops.masking.causal_mask`` rule applied IN-KERNEL as an additive bias,
+    never a materialized (T, S) mask; composes with ``pad_mask`` by
+    addition, i.e. OR). 0 = square causal self-attention; L − N = the
+    Perceiver-AR latent-window cross-attention. Covers forward AND both
+    backward kernels. ``kv_block_size=None`` (default) resolves per shape —
+    wider KV streaming for shallow heads at long S (see ``_auto_kv_block``);
+    ``q_block_size=None`` (default) resolves per shape after KV-block sizing
+    (see LONG_KV_Q_BLOCK). Off-TPU backends run the kernel in interpreter
+    mode (slow — for tests), overridable via ``interpret``.
     """
     if q.ndim != 4 or k.ndim != 4 or v.ndim != 4:
         raise ValueError(f"expected (B, T/S, H, D) tensors, got {q.shape=} {k.shape=}")
@@ -515,7 +566,10 @@ def fused_attention(
     q, k, v, bias, t_blk, s_blk, t_pad = _prepare_blocks(
         q, k, v, bias, kv_block_size, q_block_size, interpret
     )
-    out = _fused_attention(q, k, v, bias, t_blk, s_blk, interpret)
+    out = _fused_attention(
+        q, k, v, bias, t_blk, s_blk, interpret,
+        None if causal_offset is None else int(causal_offset),
+    )
     if t_pad:
         out = out[:, :, :t]
     return jnp.transpose(out, (0, 2, 1, 3))

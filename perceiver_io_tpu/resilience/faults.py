@@ -79,6 +79,11 @@ SITES = (
     # queue slot or token is consumed)
     "autoscale.scale",
     "router.admit",
+    # the generative decode path (perceiver_io_tpu.inference.generate): the
+    # prefix encode and the chunked decode dispatch — the mid-stream chaos
+    # drills target a replica's step path without code changes
+    "generation.prefill",
+    "generation.step",
 )
 _SUFFIXED = ("engine.dispatch", "engine.complete")
 

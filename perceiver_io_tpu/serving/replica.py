@@ -109,6 +109,50 @@ _ERROR_KINDS = {
 }
 
 
+# -- streamed-frame wire format (the generate RPC) ---------------------------
+#
+# A generate response is a SEQUENCE of length-prefixed frames — 4-byte
+# big-endian length + a JSON payload — written incrementally (chunked
+# transfer encoding on the HTTP twin), so the router/caller observes tokens
+# as they decode instead of waiting out the stream. Token-chunk frames carry
+# per-step phase timestamps (`chunk_ms`, `pos`, `steps`); the terminal frame
+# is either the `done` summary or an `error` frame mirroring the replica
+# exception (the streaming counterpart of `_wire_error` — by the time a
+# mid-stream error occurs, the 200 status line is long gone).
+
+
+def pack_frame(payload: Dict[str, Any]) -> bytes:
+    body = json.dumps(payload).encode()
+    return len(body).to_bytes(4, "big") + body
+
+
+def read_frames(read: Callable[[int], bytes]):
+    """Yield JSON frames from a ``read(n)`` byte source until EOF. ``read``
+    may return short; EOF mid-frame raises ConnectionError (the dead-replica
+    signature the failover policy re-routes)."""
+
+    def read_exact(n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            part = read(n - len(buf))
+            if not part:
+                if buf:
+                    raise ConnectionError(
+                        "generate stream truncated mid-frame")
+                return None
+            buf += part
+        return buf
+
+    while True:
+        header = read_exact(4)
+        if header is None:
+            return
+        body = read_exact(int.from_bytes(header, "big"))
+        if body is None:
+            raise ConnectionError("generate stream truncated at frame body")
+        yield json.loads(body.decode())
+
+
 def _wire_error(exc: BaseException) -> bytes:
     for cls, kind in _ERROR_KINDS.items():
         if isinstance(exc, cls):
@@ -162,6 +206,7 @@ class ReplicaApp:
         registry: Optional[obs.MetricsRegistry] = None,
         assume_ready: bool = False,
         drain_timeout_s: float = 60.0,
+        generator=None,
     ):
         if not engines:
             raise ValueError("ReplicaApp needs at least one engine")
@@ -177,6 +222,34 @@ class ReplicaApp:
         self._sessions_lock = threading.Lock()
         self.quit_event = threading.Event()
         reg = registry if registry is not None else obs.get_registry()
+        # the generative workload (task=generate): an ARGenerator serving
+        # streamed continuations with replica-resident session caches —
+        # pinned by the router exactly like the latent-cache sessions
+        self.generator = generator
+        self._gen_store = None
+        self._gen_lock = threading.Lock()
+        self._gen_active = 0        # streams in flight (under _gen_lock)
+        self._gen_requests = 0      # streams served (under _gen_lock)
+        self._gen_draining = threading.Event()
+        if generator is not None:
+            from perceiver_io_tpu.inference.generate import (
+                GenerateSessionStore,
+            )
+
+            self._gen_store = GenerateSessionStore(
+                registry=reg, name=name)
+            self._m_gen_requests = reg.counter(
+                "replica_generate_requests_total",
+                "streamed generate RPCs served",
+                {"replica": name, "task": "generate"})
+            self._m_gen_tokens = reg.counter(
+                "replica_generate_tokens_total",
+                "tokens streamed to callers",
+                {"replica": name, "task": "generate"})
+            self._m_gen_active = reg.gauge(
+                "replica_generate_active",
+                "generate streams in flight",
+                {"replica": name, "task": "generate"})
         self._m_version = reg.gauge(
             "replica_params_version",
             "monotonic count of installed param trees (0 = the boot tree)",
@@ -253,6 +326,94 @@ class ReplicaApp:
             return [np.asarray(np.asarray(out).shape, np.int64)]
         return [np.asarray(leaf) for leaf in jax.tree.leaves(out)]
 
+    # -- the generative workload (task=generate) -----------------------------
+
+    def generate(self, prefix: Sequence[int],
+                 session: Optional[str] = None,
+                 max_new: int = 16,
+                 temperature: float = 0.0,
+                 top_k: int = 0,
+                 seed: int = 0,
+                 on_frame: Optional[Callable[[Dict[str, Any]], None]] = None,
+                 trace: Optional[obs.TraceContext] = None) -> Dict[str, Any]:
+        """Serve one streamed continuation of ``prefix`` (the FULL accepted
+        sequence — prompt plus any previously streamed tokens the caller
+        holds). When ``session`` names a resident cache whose sequence is
+        exactly ``prefix``, decoding continues incrementally; anything else
+        (first call, evicted, replica restarted, spilled pin) re-encodes
+        from the prefix — which, with the position-folded sampling keys,
+        reproduces the identical stream. Frames go to ``on_frame``: token
+        chunks with per-step phase timestamps, then a final ``done``
+        summary. Returns the summary."""
+        if self.generator is None:
+            raise ValueError(
+                f"replica {self.name!r} serves no generate task")
+        if self._gen_draining.is_set():
+            raise RejectedError(
+                f"replica {self.name!r} is draining — not admitting new "
+                "generate streams")
+        from perceiver_io_tpu.inference.generate import SamplingConfig
+
+        prefix = [int(t) for t in np.asarray(prefix).reshape(-1)]
+        sampling = SamplingConfig(temperature=temperature, top_k=top_k,
+                                  seed=seed).normalized()
+        with self._gen_lock:
+            self._gen_active += 1
+            self._m_gen_active.set(self._gen_active)
+        t0 = time.monotonic()
+        serve_ctx = trace.child() if trace is not None else None
+        resident = self._gen_store.match(session, prefix)
+        chunks = 0
+
+        def chunk_cb(tokens: List[int], info: Dict[str, Any]) -> None:
+            nonlocal chunks
+            chunks += 1
+            self._m_gen_tokens.inc(len(tokens))
+            if serve_ctx is not None:
+                # one span per chunked decode dispatch: multi-step tail
+                # attribution — which chunk of which stream burned the time
+                dur = info["chunk_ms"] / 1e3
+                obs.record_span(
+                    "generate_step", serve_ctx.child(),
+                    time.monotonic() - dur, dur, replica=self.name,
+                    pos=info["pos"], steps=info["steps"])
+            if on_frame is not None:
+                on_frame({"tokens": tokens, **info})
+
+        try:
+            tokens, ses = self.generator.generate(
+                prefix, max_new, sampling, on_chunk=chunk_cb,
+                session=resident)
+        except BaseException as e:
+            if serve_ctx is not None:
+                obs.record_span(
+                    "replica_generate", serve_ctx, t0,
+                    time.monotonic() - t0, replica=self.name, ok=False,
+                    error=type(e).__name__)
+            raise
+        finally:
+            with self._gen_lock:
+                self._gen_active -= 1
+                self._gen_requests += 1
+                self._m_gen_active.set(self._gen_active)
+        self._gen_store.put(session, ses)
+        self._m_gen_requests.inc()
+        summary = {
+            "done": True,
+            "tokens_total": len(tokens),
+            "chunks": chunks,
+            "resumed": resident is not None,
+            "ms": round((time.monotonic() - t0) * 1e3, 3),
+        }
+        if serve_ctx is not None:
+            obs.record_span(
+                "replica_generate", serve_ctx, t0, time.monotonic() - t0,
+                replica=self.name, ok=True, tokens=len(tokens),
+                resumed=resident is not None)
+        if on_frame is not None:
+            on_frame(summary)
+        return summary
+
     # -- rollout surface -----------------------------------------------------
 
     def update_params(self, spec: Dict[str, Any]) -> int:
@@ -302,9 +463,26 @@ class ReplicaApp:
         timeout_s = self.drain_timeout_s if timeout_s is None else timeout_s
         from perceiver_io_tpu.inference.engine import drain_engines
 
-        return drain_engines(self.engines.values(), timeout_s)
+        # close every door first (drain_engines discipline): generate
+        # streams stop admitting before the engines drain, then accepted
+        # streams finish within the shared deadline
+        self._gen_draining.set()
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + timeout_s)
+        ok = drain_engines(self.engines.values(), timeout_s)
+        while True:
+            with self._gen_lock:
+                active = self._gen_active
+            if active == 0:
+                return ok
+            if deadline is not None and time.monotonic() >= deadline:
+                obs.event("replica_generate_drain_timeout",
+                          replica=self.name, active=active)
+                return False
+            time.sleep(0.01)
 
     def resume(self) -> None:
+        self._gen_draining.clear()
         for engine in self.engines.values():
             engine.resume_admission()
 
@@ -340,18 +518,27 @@ class ReplicaApp:
             }
         with self._sessions_lock:
             sessions = len(self._sessions)
+        with self._gen_lock:
+            gen_active, gen_requests = self._gen_active, self._gen_requests
         return {
             "name": self.name,
             "ready": self.ready,
-            "requests_total": sum(
+            # generate streams count as requests (the autoscaler's offered-
+            # rate signal must see the second traffic class) and as load
+            # (queue_depth steers least-loaded placement)
+            "requests_total": gen_requests + sum(
                 e.requests_served for e in self.engines.values()),
-            "draining": any(e.draining for e in self.engines.values()),
-            "queue_depth": queue_depth,
-            "inflight": inflight,
+            "draining": (self._gen_draining.is_set()
+                         or any(e.draining for e in self.engines.values())),
+            "queue_depth": queue_depth + gen_active,
+            "inflight": inflight + gen_active,
             "breaker_open": breaker_open,
             "slo_burn": round(slo_burn, 4),
             "params_version": int(self._m_version.value),
             "sessions": sessions,
+            "generate_sessions": (len(self._gen_store)
+                                  if self._gen_store is not None else 0),
+            "generate_active": gen_active,
             "engines": engines,
         }
 
@@ -448,11 +635,63 @@ class ReplicaServer:
                 else:
                     self._reply(404, _error_body("not_found", path))
 
+            def _stream_generate(self, q: Dict[str, str]) -> None:
+                """The generate RPC: body = npz([prefix ids]); response =
+                length-prefixed JSON frames under chunked transfer encoding
+                (the streaming twin of the arrays-in/arrays-out verbs)."""
+                trace = obs.TraceContext.from_headers(self.headers)
+                arrays = unpack_arrays(self._body())
+                prefix = arrays[0].reshape(-1)
+                started = False
+
+                def send_chunk(data: bytes) -> None:
+                    self.wfile.write(f"{len(data):X}\r\n".encode()
+                                     + data + b"\r\n")
+                    self.wfile.flush()
+
+                def on_frame(frame: Dict[str, Any]) -> None:
+                    nonlocal started
+                    if not started:
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/octet-stream")
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        started = True
+                    send_chunk(pack_frame(frame))
+
+                try:
+                    app.generate(
+                        prefix,
+                        session=q.get("session"),
+                        max_new=int(q.get("max_new", 16)),
+                        temperature=float(q.get("temperature", 0.0)),
+                        top_k=int(q.get("top_k", 0)),
+                        seed=int(q.get("seed", 0)),
+                        on_frame=on_frame,
+                        trace=trace,
+                    )
+                except BaseException as e:
+                    if not started:
+                        self._reply(503, _wire_error(e))
+                        return
+                    # mid-stream failure: the status line is gone — mirror
+                    # the exception as a terminal error frame instead
+                    err = json.loads(_wire_error(e).decode())
+                    send_chunk(pack_frame(err))
+                if not started:
+                    self._reply(200, b"")  # degenerate: nothing streamed
+                    return
+                self.wfile.write(b"0\r\n\r\n")  # terminal chunk
+                self.wfile.flush()
+
             def do_POST(self) -> None:
                 path = self.path.split("?", 1)[0]
                 q = self._query()
                 try:
-                    if path.startswith("/rpc/"):
+                    if path == "/rpc/generate":
+                        self._stream_generate(q)
+                    elif path.startswith("/rpc/"):
                         kind = path[len("/rpc/"):]
                         timeout_s = (float(q["timeout_s"])
                                      if "timeout_s" in q else None)
@@ -589,6 +828,68 @@ class HttpReplicaClient:
                             meta=meta)
         return unpack_arrays(out)
 
+    def generate_stream(self, prefix: Sequence[int],
+                        session: Optional[str] = None,
+                        max_new: int = 16,
+                        temperature: float = 0.0,
+                        top_k: int = 0,
+                        seed: int = 0,
+                        on_frame: Optional[Callable[[Dict[str, Any]], None]]
+                        = None,
+                        timeout_s: Optional[float] = None,
+                        trace: Optional[obs.TraceContext] = None
+                        ) -> Dict[str, Any]:
+        """The streamed generate RPC: frames (token chunks with per-step
+        phase stamps, then the ``done`` summary) are delivered to
+        ``on_frame`` AS THEY ARRIVE; returns the summary. A mid-stream
+        error frame re-raises the replica's mirrored exception; a cut
+        connection raises ConnectionError — the caller (router) decides
+        what already-received tokens mean (they are accepted: re-encode
+        from the extended prefix)."""
+        import urllib.error
+        import urllib.request
+
+        q = [f"max_new={int(max_new)}", f"temperature={float(temperature):g}",
+             f"top_k={int(top_k)}", f"seed={int(seed)}"]
+        if session is not None:
+            q.append(f"session={session}")
+        req = urllib.request.Request(
+            self.base_url + "/rpc/generate?" + "&".join(q),
+            data=pack_arrays([np.asarray(prefix, np.int64)]),
+            method="POST",
+            headers={"Content-Type": "application/octet-stream",
+                     **(trace.to_headers() if trace is not None else {})},
+        )
+        summary: Optional[Dict[str, Any]] = None
+        try:
+            with urllib.request.urlopen(
+                req, timeout=timeout_s if timeout_s is not None
+                else self.timeout_s
+            ) as resp:
+                for frame in read_frames(resp.read):
+                    if "error" in frame:
+                        raise_wire_error(
+                            json.dumps(frame).encode(), self.name)
+                    if frame.get("done"):
+                        summary = frame
+                    if on_frame is not None:
+                        on_frame(frame)
+        except urllib.error.HTTPError as e:
+            raise_wire_error(e.read(), self.name)
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            if isinstance(e, ConnectionError) and "truncated" in str(e):
+                raise
+            reason = getattr(e, "reason", e)
+            raise ConnectionError(
+                f"replica {self.name!r}: connection closed / failed to "
+                f"connect ({type(reason).__name__}: {reason})"
+            ) from e
+        if summary is None:
+            raise ConnectionError(
+                f"replica {self.name!r}: generate stream ended without a "
+                "done frame")
+        return summary
+
     def scrape(self, timeout_s: float = 5.0) -> Dict[str, Any]:
         """The replica's ``/statz`` ``replica`` block, plus ``up``. Never
         raises: an unreachable replica scrapes as ``{"up": False}``."""
@@ -662,6 +963,36 @@ class LocalReplica:
         self._check_dead()
         return out
 
+    def generate_stream(self, prefix: Sequence[int],
+                        session: Optional[str] = None,
+                        max_new: int = 16,
+                        temperature: float = 0.0,
+                        top_k: int = 0,
+                        seed: int = 0,
+                        on_frame: Optional[Callable[[Dict[str, Any]], None]]
+                        = None,
+                        timeout_s: Optional[float] = None,
+                        trace: Optional[obs.TraceContext] = None
+                        ) -> Dict[str, Any]:
+        """In-process twin of the streamed generate RPC, with the kill
+        semantics of a cut connection: a ``kill()`` landing mid-stream
+        suppresses every later frame and raises the dead-replica
+        ConnectionError — frames already delivered were accepted (exactly
+        the at-most-once boundary the HTTP twin has)."""
+        self._check_dead()
+
+        def gated(frame: Dict[str, Any]) -> None:
+            self._check_dead()  # the wire died: nothing further arrives
+            if on_frame is not None:
+                on_frame(frame)
+
+        summary = self.app.generate(
+            prefix, session=session, max_new=max_new,
+            temperature=temperature, top_k=top_k, seed=seed,
+            on_frame=gated, trace=trace)
+        self._check_dead()
+        return summary
+
     def scrape(self, timeout_s: float = 5.0) -> Dict[str, Any]:
         if self._dead.is_set():
             return {"up": False, "error": "replica killed"}
@@ -689,6 +1020,9 @@ class LocalReplica:
         self._dead.set()
         with self.app._sessions_lock:
             self.app._sessions.clear()
+        if self.app._gen_store is not None:
+            # the generation caches died with the 'process'
+            self.app._gen_store.clear()
 
     def revive(self) -> None:
         self.app.resume()
@@ -720,16 +1054,26 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--cpu", action="store_true",
                         help="pin the CPU backend before jax initializes")
     src = parser.add_argument_group("model source")
+    src.add_argument("--task", choices=("mlm", "generate"), default="mlm",
+                     help="workload class: 'mlm' = the fill-mask engines "
+                          "(infer/encode/decode); 'generate' = the "
+                          "Perceiver-AR causal LM with the streamed "
+                          "generate RPC + session cache")
     src.add_argument("--preset", choices=("tiny", "flagship"), default=None,
                      help="synthetic-init preset (tests/benches; no "
-                          "checkpoint needed)")
+                          "checkpoint needed; task picks the mlm or ar "
+                          "variant)")
     src.add_argument("--seed", type=int, default=0,
                      help="preset mode: param init seed")
     src.add_argument("--checkpoint", default=None,
-                     help="serve a train_mlm checkpoint dir instead")
+                     help="serve a train_mlm (or, with --task generate, "
+                          "train_ar) checkpoint dir instead")
     src.add_argument("--tokenizer", default=None,
                      help="tokenizer json (checkpoint mode)")
     src.add_argument("--step", type=int, default=None)
+    src.add_argument("--generate_chunk", type=int, default=8,
+                     help="generate task: decode steps per chunked "
+                          "dispatch (= streaming granularity)")
     eng = parser.add_argument_group("engine (mirrors cli/serve.py)")
     eng.add_argument("--max_batch", type=int, default=8)
     eng.add_argument("--max_delay_ms", type=float, default=0.0)
@@ -778,6 +1122,8 @@ def _build_app(args):
 
     from perceiver_io_tpu.inference.engine import ServingEngine, mlm_apply_fns
 
+    if args.task == "generate":
+        return _build_generate_app(args)
     if args.checkpoint:
         if not args.tokenizer:
             raise SystemExit("--checkpoint mode needs --tokenizer")
@@ -859,7 +1205,118 @@ def _build_app(args):
     return app, max_seq_len
 
 
+def _build_generate_app(args):
+    """The generate-task replica: a Perceiver-AR model behind the streamed
+    RPC (plus a dense-forward ``infer`` engine — scoring/perplexity calls
+    ride the ordinary arrays verb)."""
+    import jax
+
+    from perceiver_io_tpu.inference.engine import ServingEngine
+    from perceiver_io_tpu.inference.generate import ARGenerator
+
+    compute_dtype = "bfloat16" if args.dtype == "bfloat16" else None
+    if args.checkpoint:
+        if not args.tokenizer:
+            raise SystemExit("--checkpoint mode needs --tokenizer")
+        from perceiver_io_tpu.data.tokenizer import load_tokenizer
+        from perceiver_io_tpu.inference.generate import load_ar_checkpoint
+
+        tokenizer = load_tokenizer(args.tokenizer)
+        model, params, max_seq_len = load_ar_checkpoint(
+            args.checkpoint, tokenizer, step=args.step,
+            dtype="bfloat16" if args.dtype == "bfloat16" else None,
+        )
+
+        def params_factory(spec):
+            if spec.get("kind") == "publication":
+                return _load_publication_spec(spec)
+            if spec.get("kind") != "checkpoint":
+                raise ValueError(f"checkpoint replica got spec {spec!r}")
+            _, new_params, _ = load_ar_checkpoint(
+                spec.get("path", args.checkpoint), tokenizer,
+                step=spec.get("step"),
+                dtype="bfloat16" if args.dtype == "bfloat16" else None,
+            )
+            return new_params
+    else:
+        from perceiver_io_tpu.models.presets import flagship_ar, tiny_ar
+
+        tiny = (args.preset or "tiny") == "tiny"
+        build = tiny_ar if tiny else flagship_ar
+        max_seq_len = 64 if tiny else 512
+        model = build()
+        ids0 = np.zeros((1, max_seq_len), np.int32)
+
+        def init_params(seed: int):
+            import jax as _jax
+
+            return model.init(
+                {"params": _jax.random.key(seed)}, ids0, ids0 == 0,
+            )["params"]
+
+        params = init_params(args.seed)
+
+        def params_factory(spec):
+            if spec.get("kind") == "publication":
+                return _load_publication_spec(spec)
+            if spec.get("kind") != "reinit":
+                raise ValueError(f"preset replica got spec {spec!r}")
+            return init_params(int(spec.get("seed", 0)))
+
+    generator = ARGenerator(
+        model, params, max_seq_len=max_seq_len, chunk=args.generate_chunk,
+        compute_dtype=compute_dtype, name=f"{args.name}-gen",
+    )
+
+    def infer_apply(p, token_ids, pad_mask):
+        return model.apply({"params": p}, token_ids, pad_mask)
+
+    slo = None
+    if args.slo_p99_ms is not None:
+        slo = obs.SLO(latency_target_s=args.slo_p99_ms / 1e3,
+                      availability_target=args.slo_availability,
+                      name=args.name, burn_alert=None)
+    engines = {
+        "infer": ServingEngine(
+            infer_apply, params, name=f"{args.name}-infer",
+            max_batch=args.max_batch, max_delay_ms=args.max_delay_ms,
+            compute_dtype=compute_dtype,
+            queue_limit=args.queue_limit,
+            request_deadline_s=args.request_deadline_s,
+            dispatch_retries=args.dispatch_retries,
+            breaker_failures=args.breaker_failures,
+            breaker_cooldown_s=args.breaker_cooldown_s,
+            heartbeat_deadline_s=args.heartbeat_deadline_s,
+            compile_cache=args.compile_cache,
+            slo=slo,
+            trace_sample=args.trace_sample,
+        ),
+    }
+    app = ReplicaApp(
+        engines, params, params_factory=params_factory, name=args.name,
+        assume_ready=args.no_warmup, drain_timeout_s=args.drain_timeout_s,
+        generator=generator,
+    )
+    return app, max_seq_len
+
+
 def _warm(app: ReplicaApp, args, max_seq_len: int) -> None:
+    if args.task == "generate":
+        # prefill-width family + the chunked decode program, then the dense
+        # scoring engine's buckets — off the serving path
+        def warm_generate():
+            try:
+                app.generator.warmup()
+                ids = np.zeros((1, max_seq_len), np.int32)
+                pad = np.zeros((1, max_seq_len), bool)
+                app.engines["infer"].warmup(ids, pad)
+            except Exception as e:
+                print(f"replica: generate warmup failed "
+                      f"({type(e).__name__}: {e})", file=sys.stderr)
+
+        threading.Thread(target=warm_generate, name="replica-warm-generate",
+                         daemon=True).start()
+        return
     ids = np.zeros((1, max_seq_len), np.int32)
     pad = np.zeros((1, max_seq_len), bool)
     positions = np.zeros((1, 2), np.int32)

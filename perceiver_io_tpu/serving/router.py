@@ -210,6 +210,25 @@ class Router:
         self._m_latency = reg.histogram(
             "router_latency_seconds", "submit → result via the router",
             labels)
+        # the generative traffic class rides its OWN instruments (labeled
+        # task=generate): a multi-second stream classified into the
+        # one-shot latency histogram would wreck every capacity fit and
+        # SLO burn gauge built over it
+        gen_labels = {**labels, "task": "generate"}
+        self._m_gen_requests = reg.counter(
+            "router_generate_total", "generate streams admitted", gen_labels)
+        self._m_gen_completed = reg.counter(
+            "router_generate_completed_total",
+            "generate streams fully delivered", gen_labels)
+        self._m_gen_failed = reg.counter(
+            "router_generate_failed_total",
+            "generate streams failed after admission", gen_labels)
+        self._m_gen_tokens = reg.counter(
+            "router_generate_tokens_total",
+            "continuation tokens delivered to callers", gen_labels)
+        self._m_gen_latency = reg.histogram(
+            "router_generate_seconds",
+            "generate stream wall time (admission → last frame)", gen_labels)
         self._gauges = _fleet.ReplicaGauges(fleet=name, registry=reg)
         # fleet_scrape_age_s is computed at EXPORT time (registry collector,
         # weakref so a closed router's collector drops itself): the wedged-
@@ -651,6 +670,179 @@ class Router:
     def pinned(self, session: str) -> Optional[str]:
         with self._lock:
             return self._pins.get(session)
+
+    # -- the generative workload (task=generate) -----------------------------
+
+    def generate(self, prefix, session: Optional[str] = None,
+                 max_new: int = 16, temperature: float = 0.0,
+                 top_k: int = 0, seed: int = 0,
+                 on_tokens=None,
+                 timeout_s: Optional[float] = None,
+                 client: Optional[str] = None,
+                 priority: Optional[str] = None) -> Dict[str, Any]:
+        """Route one streamed continuation (synchronous — generation is a
+        long-lived stream, so it runs on the CALLER's thread; wrap it
+        yourself for concurrency). Semantics:
+
+        - ``session`` pins like the latent-cache sessions: the stream runs
+          on the pinned replica while it lives, and SUCCESS (re-)pins.
+        - tokens are ACCEPTED as frames arrive (``on_tokens(tokens, info)``
+          per chunk). A replica dying mid-stream does not lose them: the
+          pin is dropped, the spill is counted, and the stream resumes on
+          another replica by re-encoding from the EXTENDED prefix — with
+          the position-folded sampling keys, the continuation is the
+          identical stream (the mid-stream chaos drill pins
+          ``lost_accepted=0`` by content).
+        - admission (``client``/``priority``) draws the stream against the
+          caller's class/quota exactly like ``submit``.
+
+        Returns ``{"tokens", "attempts", "reroutes", "spills", "replica",
+        "resumed"}``."""
+        if self._closed.is_set():
+            raise RouterClosed(f"generate() on closed router {self.name!r}")
+        ticket = None
+        if self.admission is not None:
+            try:
+                ticket = self.admission.admit(client=client,
+                                              priority=priority)
+            except BaseException:
+                self._m_shed.inc()
+                raise
+        self._m_gen_requests.inc()
+        tr = obs.maybe_trace(self.trace_sample)
+        t0 = time.monotonic()
+        deadline = None if timeout_s is None else t0 + timeout_s
+        prefix = [int(t) for t in np.asarray(prefix).reshape(-1)]
+        accepted: list = []
+        tried: set = set()
+        attempt = 0
+        reroutes = spills = 0
+        summary: Dict[str, Any] = {}
+        ok = False
+        try:
+            while True:
+                attempt += 1
+                try:
+                    slot = self._pick(tried, session=session)
+                except AffinityLost:
+                    # a dead pin is NOT fatal for generation: the accepted
+                    # tokens live with the caller, so re-encoding from the
+                    # extended prefix on any live replica resumes the
+                    # stream (spill-on-death re-encode). _pick already
+                    # dropped the pin and counted the spill.
+                    spills += 1
+                    if tr is not None:
+                        obs.record_span(
+                            "router_affinity_spill", tr.child(),
+                            time.monotonic(), 0.0, router=self.name,
+                            session=session or "", kind="generate")
+                    slot = self._pick(tried, session=None)
+                left = None
+                if deadline is not None:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        from perceiver_io_tpu.resilience import (
+                            DeadlineExceeded,
+                        )
+
+                        raise DeadlineExceeded(
+                            "generate deadline expired mid-stream")
+                self._note_inflight(slot, 1)
+                attempt_ctx = tr.child() if tr is not None else None
+                t_attempt = time.monotonic()
+
+                def chunk_cb(frame: Dict[str, Any]) -> None:
+                    toks = frame.get("tokens")
+                    if toks:
+                        accepted.extend(int(t) for t in toks)
+                        self._m_gen_tokens.inc(len(toks))
+                        if on_tokens is not None:
+                            on_tokens(toks, frame)
+
+                try:
+                    summary = slot.client.generate_stream(
+                        prefix + accepted, session=session,
+                        max_new=max_new - len(accepted),
+                        temperature=temperature, top_k=top_k, seed=seed,
+                        on_frame=chunk_cb, timeout_s=left,
+                        trace=attempt_ctx)
+                except BaseException as e:
+                    if attempt_ctx is not None:
+                        obs.record_span(
+                            "router_attempt", attempt_ctx, t_attempt,
+                            time.monotonic() - t_attempt, router=self.name,
+                            replica=slot.name, kind="generate",
+                            attempt=attempt, ok=False,
+                            error=type(e).__name__)
+                    slot.failures += 1
+                    obs.event("router_request_failed", router=self.name,
+                              replica=slot.name, kind="generate",
+                              error=type(e).__name__, attempt=attempt)
+                    if self.policy.should_reroute(e, attempt):
+                        # no result is lost by re-placing: received frames
+                        # are accepted, the next attempt's prefix carries
+                        # them, and the replica-side cache (if any) died
+                        # with the replica
+                        tried.add(slot.name)
+                        if session is not None:
+                            with self._lock:
+                                stale = self._pins.get(session) == slot.name
+                                if stale:
+                                    self._pins.pop(session, None)
+                            if stale:
+                                self._m_spills.inc()
+                                spills += 1
+                        self._m_reroutes.inc()
+                        reroutes += 1
+                        pause = self.policy.backoff.backoff_s(attempt)
+                        t_hop = time.monotonic()
+                        if pause > 0:
+                            time.sleep(pause)
+                        if tr is not None:
+                            obs.record_span(
+                                "router_reroute", tr.child(), t_hop,
+                                time.monotonic() - t_hop, router=self.name,
+                                from_replica=slot.name, attempt=attempt,
+                                error=type(e).__name__)
+                        continue
+                    raise
+                finally:
+                    self._note_inflight(slot, -1)
+                if attempt_ctx is not None:
+                    obs.record_span(
+                        "router_attempt", attempt_ctx, t_attempt,
+                        time.monotonic() - t_attempt, router=self.name,
+                        replica=slot.name, kind="generate", attempt=attempt,
+                        ok=True)
+                slot.failures = 0
+                if session is not None:
+                    with self._lock:
+                        self._pins[session] = slot.name
+                ok = True
+                self._m_gen_completed.inc()
+                return {
+                    "tokens": accepted,
+                    "attempts": attempt,
+                    "reroutes": reroutes,
+                    "spills": spills,
+                    "replica": slot.name,
+                    "resumed": bool(summary.get("resumed")),
+                }
+        except BaseException:
+            self._m_gen_failed.inc()
+            raise
+        finally:
+            latency = time.monotonic() - t0
+            self._m_gen_latency.observe(
+                latency, exemplar=tr.trace_id if tr is not None else None)
+            if ticket is not None:
+                self.admission.on_result(ticket, latency, ok)
+            if tr is not None:
+                obs.record_span(
+                    "router_request", tr, t0, latency, router=self.name,
+                    kind="generate", attempts=attempt, ok=ok)
+                self.traces.add(tr.trace_id, latency, ok=ok,
+                                kind="generate", attempts=attempt)
 
     # -- drain / rollout -----------------------------------------------------
 

@@ -5,6 +5,7 @@ from perceiver_io_tpu.training.losses import (
 from perceiver_io_tpu.training.optim import OptimizerConfig, make_optimizer
 from perceiver_io_tpu.training.train_state import TrainState
 from perceiver_io_tpu.training.steps import (
+    make_ar_steps,
     make_mlm_steps,
     make_classifier_steps,
     make_flow_steps,
@@ -38,6 +39,7 @@ __all__ = [
     "OptimizerConfig",
     "make_optimizer",
     "TrainState",
+    "make_ar_steps",
     "make_mlm_steps",
     "mlm_gather_capacity",
     "make_classifier_steps",

@@ -189,6 +189,52 @@ def make_mlm_steps(
     return train_step, eval_step, predict_fn
 
 
+def make_ar_steps(model, schedule: Optional[Schedule] = None,
+                  latent_offset: Optional[int] = None):
+    """(train_step, eval_step, predict_fn) for a ``PerceiverARLM``.
+
+    Next-token CE over the causal latent window: the dense forward's logits
+    row i predicts the token at absolute position ``offset + i + 1``
+    (``ops.masking.shift_ar_labels`` — final position and pad targets carry
+    ``IGNORE_LABEL``, the same convention MLM's CE uses). No masking RNG —
+    causality is structural, not sampled; dropout is the only stochastic
+    stream."""
+
+    def loss_fn(params, batch, rngs, deterministic):
+        from perceiver_io_tpu.ops.masking import shift_ar_labels
+
+        ids, pad = batch["token_ids"], batch["pad_mask"]
+        logits = model.apply(
+            {"params": params}, ids, pad, rngs=rngs,
+            deterministic=deterministic, latent_offset=latent_offset,
+        )
+        o = (ids.shape[1] - logits.shape[1] if latent_offset is None
+             else latent_offset)
+        labels = shift_ar_labels(ids, pad, o)
+        return cross_entropy_with_ignore(logits, labels)
+
+    def train_step(state: TrainState, batch) -> Tuple[TrainState, Metrics]:
+        rngs = state.step_rngs("dropout")
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, batch, rngs, False
+        )
+        metrics = {"loss": loss, **_lr_metric(schedule, state.step)}
+        return state.apply_gradients(grads), metrics
+
+    def eval_step(state: TrainState, batch, key: Optional[Array] = None
+                  ) -> Metrics:
+        # the key parameter is the Trainer's stochastic-eval slot (MLM
+        # masking); AR eval is deterministic, so it is accepted and unused
+        loss = loss_fn(state.params, batch, {}, True)
+        return {"loss": loss}
+
+    def predict_fn(params, token_ids, pad_mask):
+        return model.apply({"params": params}, token_ids, pad_mask,
+                           latent_offset=latent_offset)
+
+    return train_step, eval_step, predict_fn
+
+
 def make_classifier_steps(
     model,
     schedule: Optional[Schedule] = None,

@@ -399,6 +399,10 @@ def test_json_emitters_keep_one_line_stdout_contract(tmp_path):
     assert report["total"] > 0 and report["skipped"]
     # the weight-only int8 path is registered in the per-round smoke
     assert "quant-int8w-dequant" in report["skipped"]
+    # the generative causal decode geometries are registered too (the
+    # in-kernel causal flag at guard boundaries + the q_len=1 step shape)
+    assert "attn-causal-prefill-d128" in report["skipped"]
+    assert "attn-q1-decode-32k" in report["skipped"]
     with open(tmp_path / "ks.json") as f:
         assert json.loads(f.read()) == report
 
@@ -537,6 +541,13 @@ def test_load_bench_dry_emits_schema_json_line():
                 "abuser_shed_drill", "victim_p99_unprotected_ms",
                 "sheds_by_reason", "null"):
         assert key in record["admission_keys"], record
+    # the generative traffic class (--generate_rps) declares its block's
+    # keys the same way — the second, stateful class the r17 policies see
+    assert record["generate"] is None
+    for key in ("offered_streams", "completed", "failed", "tokens_total",
+                "steps_per_s", "stream_p99_ms", "followups", "resumed",
+                "reroutes", "spills"):
+        assert key in record["generate_keys"], record
 
 
 def test_load_bench_cpu_sweep_shows_saturation_signature(tmp_path):

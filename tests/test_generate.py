@@ -1,0 +1,436 @@
+"""The Perceiver-AR generative decode subsystem: causal model, incremental
+engine, and the serving workload.
+
+The correctness spine is INCREMENTAL PARITY (the acceptance bar): for every
+AR preset on the f32 path, token-t logits from the cached incremental step
+must match a dense full-prefix forward within 2e-5 — pinned here per preset,
+per step. Around it: structural causality (a future-token perturbation
+cannot move an earlier prediction), split-consistent sampling (the
+position-folded key stream reproduces identically across ANY re-encode
+point — what makes spill-on-death content-lossless), the streamed replica
+RPC on both transports, and THE end-to-end drill: train_ar on synthetic
+data → checkpoint → serve on a 2-replica fleet → streamed
+``generate(session=...)`` with a mid-stream ``kill()`` of the pinned
+replica → the assembled continuation is bit-identical to the uninterrupted
+oracle (``lost_accepted=0`` by content, not just by count).
+"""
+
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.inference.generate import (
+    ARGenerator,
+    GenerateSessionStore,
+    SamplingConfig,
+)
+from perceiver_io_tpu.models.presets import flagship_ar, tiny_ar
+
+VOCAB = 503
+
+
+def _init(model, max_seq_len, seed=0):
+    ids = np.zeros((1, max_seq_len), np.int32)
+    return model.init({"params": jax.random.key(seed)}, ids, ids == 0)[
+        "params"]
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = tiny_ar()
+    return model, _init(model, 64)
+
+
+# -- incremental parity: the correctness spine --------------------------------
+
+
+# every preset on the f32 path (flagship_ar at its structural config —
+# C=512, 3 layers x 6-block — with seq/window shrunk for CPU runtime; the
+# parity property is per-position algebra, not width-dependent)
+PRESETS = {
+    "tiny_ar": (lambda: tiny_ar(), 64),
+    # blocks shrunk 6 -> 3 for CPU compile wall; the parity property is
+    # per-position algebra over the same module structure
+    "flagship_ar": (lambda: flagship_ar(
+        max_seq_len=64, num_latents=16,
+        num_self_attention_layers_per_block=3, dtype=jnp.float32), 64),
+}
+
+
+@pytest.mark.parametrize("name", sorted(PRESETS))
+def test_incremental_matches_dense_forward(name, rng):
+    """Token-t logits from the cached step == dense full-prefix forward at
+    2e-5 (f32) — for every step of a short generation, including across
+    the prefill's padded width."""
+    build, max_seq_len = PRESETS[name]
+    model = build()
+    params = _init(model, max_seq_len)
+    cap = model.num_latents
+    # steps 0 and 1 cover the two structural step regimes (first append,
+    # subsequent ring append); extra steps add wall, not coverage
+    b, p, steps = (2, 9, 3) if name == "tiny_ar" else (1, 9, 2)
+    w = p + steps + 3  # a padded prefill width inside the window constraint
+    assert w <= p - 1 + cap
+    ids = np.zeros((b, w), np.int32)
+    ids[:, :p] = rng.integers(3, VOCAB, (b, p))
+    pad = np.broadcast_to(np.arange(w)[None, :] >= p, (b, w)).copy()
+
+    # deliberately UNJITTED: at a handful of calls, eager execution is
+    # cheaper than compiling three programs of a C=512 model on CPU
+    _, cache = model.apply(
+        {"params": params}, jnp.asarray(ids), jnp.asarray(pad),
+        length=jnp.asarray(p, jnp.int32), method="prefill")
+
+    cur = ids.copy()
+    for t in range(steps):
+        tok = rng.integers(3, VOCAB, (b, 1)).astype(np.int32)
+        step_logits, cache = model.apply(
+            {"params": params}, cache, jnp.asarray(tok), method="step")
+        cur[:, p + t] = tok[:, 0]
+        pad_t = np.broadcast_to(
+            np.arange(w)[None, :] >= p + t + 1, (b, w))
+        dense = model.apply(
+            {"params": params}, jnp.asarray(cur), jnp.asarray(pad_t))
+        row = (p + t) - (w - min(cap, w))
+        err = float(np.max(np.abs(
+            np.asarray(step_logits, np.float32)
+            - np.asarray(dense[:, row], np.float32))))
+        assert err < 2e-5, f"{name} step {t}: parity error {err}"
+
+
+def test_dense_forward_is_causal(tiny, rng):
+    """Perturbing a suffix token must leave every earlier window row's
+    logits EXACTLY unchanged — causality is structural, not approximate."""
+    model, params = tiny
+    b, l = 2, 24
+    ids = rng.integers(3, VOCAB, (b, l)).astype(np.int32)
+    pad = np.zeros((b, l), bool)
+    base = np.asarray(model.apply(
+        {"params": params}, jnp.asarray(ids), jnp.asarray(pad)))
+    o = l - model.num_latents
+    flip = 20
+    ids2 = ids.copy()
+    ids2[:, flip] = (ids2[:, flip] + 7) % (VOCAB - 3) + 3
+    out2 = np.asarray(model.apply(
+        {"params": params}, jnp.asarray(ids2), jnp.asarray(pad)))
+    for i in range(base.shape[1]):
+        if o + i < flip:
+            np.testing.assert_array_equal(
+                base[:, i], out2[:, i],
+                err_msg=f"future token leaked into window row {i}")
+    # and the perturbed position itself must move (the model is not inert)
+    assert np.abs(base[:, flip - o:] - out2[:, flip - o:]).max() > 0
+
+
+def test_prefill_width_invariance(tiny, rng):
+    """The same prefix prefilled at two padded widths with the SAME
+    latent-window anchor yields identical next-token logits — padding is
+    masked dead weight, not signal."""
+    model, params = tiny
+    p, anchor = 9, 8  # window [8, w) fits num_latents=16 for both widths
+    prefix = rng.integers(3, VOCAB, (1, p)).astype(np.int32)
+    rows = []
+    for w in (20, 24):
+        ids = np.zeros((1, w), np.int32)
+        ids[:, :p] = prefix
+        pad = np.arange(w)[None, :] >= p
+        logits, _ = model.apply(
+            {"params": params}, jnp.asarray(ids), jnp.asarray(pad),
+            length=jnp.asarray(p, jnp.int32), latent_offset=anchor,
+            method="prefill")
+        rows.append(np.asarray(logits[:, p - 1 - anchor], np.float32))
+    np.testing.assert_allclose(rows[0], rows[1], atol=2e-5)
+
+
+# -- the generation engine ----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def generator(tiny):
+    model, params = tiny
+    return ARGenerator(model, params, max_seq_len=64, chunk=4, name="t-gen")
+
+
+@pytest.mark.slow  # coverage retained: test_router_generate_chaos_drill
+# pins the same position-folded re-encode property tier-1 — a SAMPLED
+# stream split by a mid-stream kill continues byte-identically — and
+# test_generate_session_fast_path pins the no-re-encode continuation
+def test_generate_split_consistency(generator, rng):
+    """Re-encoding from the prefix at a split point continues the identical
+    SAMPLED stream (the position-folded key property) — including a cut
+    that crosses an episode-grid re-prefill."""
+    prefix = [int(t) for t in rng.integers(3, VOCAB, 9)]
+    sampling = SamplingConfig(temperature=0.8, top_k=16, seed=3)
+    full, _ = generator.generate(prefix, 12, sampling)
+    assert len(full) == 12
+    for cut in (3, 7):  # 7 = the width-16 episode boundary for a 9-prefix
+        a, _ = generator.generate(prefix, cut, sampling)
+        b, _ = generator.generate(prefix + a, 12 - cut, sampling)
+        assert a + b == full, f"diverged at cut {cut}"
+
+
+def test_generate_session_fast_path(generator, rng):
+    """Passing the session back continues WITHOUT a re-encode and yields
+    the same stream; a diverged session re-encodes instead of serving a
+    stale cache."""
+    prefix = [int(t) for t in rng.integers(3, VOCAB, 7)]
+    sampling = SamplingConfig(temperature=0.8, top_k=16, seed=5)
+    # 8 steps stay inside one episode (width 16 for a 7-token prefix), so
+    # the resumed continuation must take ZERO further prefix encodes
+    full, _ = generator.generate(prefix, 8, sampling)
+    a, ses = generator.generate(prefix, 4, sampling)
+    prefills_before = generator._m_prefills.value
+    b, _ = generator.generate(prefix + a, 4, sampling, session=ses)
+    assert a + b == full
+    assert generator._m_prefills.value == prefills_before  # no re-encode
+    # diverged prefix: the session must NOT be trusted
+    other = [int(t) for t in rng.integers(3, VOCAB, 7)]
+    c, _ = generator.generate(other, 4, sampling, session=ses)
+    assert generator._m_prefills.value > prefills_before
+
+
+def test_sampling_modes(generator, rng):
+    prefix = [int(t) for t in rng.integers(3, VOCAB, 8)]
+    greedy, _ = generator.generate(prefix, 8, SamplingConfig())
+    greedy2, _ = generator.generate(prefix, 8, SamplingConfig(seed=99))
+    assert greedy == greedy2  # temperature 0 ignores the seed
+    s1, _ = generator.generate(prefix, 8, SamplingConfig(0.8, 16, seed=1))
+    s2, _ = generator.generate(prefix, 8, SamplingConfig(0.8, 16, seed=2))
+    assert s1 != s2  # different seeds diverge (astronomically likely)
+    assert all(0 <= t < VOCAB for t in s1)
+    with pytest.raises(ValueError):
+        SamplingConfig(temperature=-1).normalized()
+
+
+def test_session_store_contract():
+    store = GenerateSessionStore(max_sessions=2, name="t")
+
+    class FakeSession:
+        def __init__(self, seq):
+            self.seq = seq
+
+    store.put("a", FakeSession([1, 2]))
+    store.put("b", FakeSession([3]))
+    assert store.match("a", [1, 2]).seq == [1, 2]
+    assert store.match("a", [1, 2, 3]) is None   # diverged -> re-encode
+    assert store.match(None, [1, 2]) is None
+    store.put("c", FakeSession([4]))             # FIFO eviction
+    assert store.match("a", [1, 2]) is None
+    assert len(store) == 2
+    store.clear()
+    assert len(store) == 0
+
+
+# -- serving: the streamed RPC + the chaos drill ------------------------------
+
+
+def _make_fleet(model, params, names=("r0", "r1"), shared_gen=None):
+    """In-process replicas. ``shared_gen``: one ARGenerator shared across
+    replicas — it is stateless (sessions live in each app's store, the jit
+    cache is thread-safe), so sharing is semantically a fleet whose
+    replicas compiled the same programs, at one compile family's cost."""
+    from perceiver_io_tpu.inference.engine import ServingEngine
+    from perceiver_io_tpu.serving.replica import LocalReplica, ReplicaApp
+
+    def apply_fn(p, token_ids, pad_mask):
+        return model.apply({"params": p}, token_ids, pad_mask)
+
+    reps = []
+    for name in names:
+        gen = shared_gen if shared_gen is not None else ARGenerator(
+            model, params, max_seq_len=64, chunk=4, name=f"{name}-gen")
+        eng = ServingEngine(apply_fn, params, name=f"{name}-inf",
+                            max_batch=2)
+        reps.append(LocalReplica(ReplicaApp(
+            {"infer": eng}, params, name=name, assume_ready=True,
+            generator=gen)))
+    return reps
+
+
+def test_generate_http_twin_parity(tiny, generator, rng):
+    """The HTTP transport streams the same tokens the in-process engine
+    produces (length-prefixed frames under chunked encoding), frames carry
+    the per-step phase stamps, a session follow-up resumes over the wire,
+    and the scrape surfaces the stateful class for autoscale/least-loaded
+    placement."""
+    from perceiver_io_tpu.serving.replica import (
+        HttpReplicaClient,
+        ReplicaServer,
+    )
+
+    model, params = tiny
+    (remote,) = _make_fleet(model, params, names=("rem",),
+                            shared_gen=generator)
+    server = ReplicaServer(remote.app)
+    client = HttpReplicaClient("rem", server.start())
+    prefix = [int(t) for t in rng.integers(3, VOCAB, 8)]
+    h_frames = []
+    client.generate_stream(prefix, session="h", max_new=5, seed=4,
+                           on_frame=h_frames.append)
+    h_toks = [t for f in h_frames for t in f.get("tokens", [])]
+    # transport parity: the wire stream equals the in-process engine (the
+    # module generator shares the model/params — and its warm programs)
+    want, _ = generator.generate(prefix, 5, SamplingConfig(seed=4))
+    assert h_toks == want and len(h_toks) == 5
+    # chunk frames carry the per-step phase stamps (tail attribution)
+    chunk_frames = [f for f in h_frames if "tokens" in f]
+    assert chunk_frames and h_frames[-1]["done"]
+    assert all("chunk_ms" in f and "pos" in f for f in chunk_frames)
+    s2 = client.generate_stream(prefix + h_toks, session="h", max_new=2,
+                                seed=4)
+    assert s2["resumed"] is True
+    # scrape surfaces the stateful class for autoscale/least-loaded
+    sc = client.scrape()
+    assert sc["generate_sessions"] == 1
+    assert sc["requests_total"] >= 2
+    server.close()
+    remote.app.close()
+
+
+def test_router_generate_chaos_drill(tiny, generator, rng):
+    """THE acceptance drill: streamed generate(session=...) through the
+    router; the pinned replica is killed MID-STREAM; the stream reroutes,
+    re-encodes from the accepted prefix on the survivor, and the assembled
+    continuation equals the uninterrupted oracle exactly —
+    lost_accepted=0 by content. Plus: the follow-up call resumes on the
+    new pin, and retiring a replica tombstones its pins."""
+    from perceiver_io_tpu.serving.router import Router
+
+    model, params = tiny
+    reps = _make_fleet(model, params, names=("c0", "c1"),
+                       shared_gen=generator)
+    by_name = {r.name: r for r in reps}
+    router = Router(reps, name="chaos", scrape_interval_s=0.05)
+    time.sleep(0.12)
+    prefix = [int(t) for t in rng.integers(3, VOCAB, 9)]
+
+    # the module generator doubles as the uninterrupted oracle (same
+    # model/params, warm programs — no third compile family)
+    oracle = generator
+    want, _ = oracle.generate(prefix, 7, SamplingConfig(
+        temperature=0.8, top_k=16, seed=11))
+
+    got = []
+    killed = {"name": None}
+
+    def on_tokens(toks, frame):
+        got.extend(toks)
+        if len(got) >= 4 and killed["name"] is None:
+            for name, r in by_name.items():
+                if r.app._gen_active > 0:
+                    killed["name"] = name
+                    r.kill()
+
+    res = router.generate(prefix, session="drill", max_new=7,
+                          temperature=0.8, top_k=16, seed=11,
+                          on_tokens=on_tokens)
+    assert killed["name"] is not None, "the kill never landed mid-stream"
+    assert res["tokens"] == want, "continuation diverged across the kill"
+    assert got == want
+    assert res["reroutes"] >= 1
+    assert res["replica"] != killed["name"]
+    # lost_accepted=0: every streamed token is in the final sequence, and
+    # the router recorded no failed generate streams
+    assert int(router._m_gen_failed.value) == 0
+
+    # the pin moved to the survivor (a follow-up resumes there — the
+    # resumed fast path itself is pinned by test_generate_http_twin_parity)
+    pinned = router.pinned("drill")
+    assert pinned == res["replica"]
+    # tombstone: retiring the pinned replica drops its session pins
+    router.remove_replica(pinned)
+    assert router.pinned("drill") is None
+    router.close()
+    for r in reps:
+        r.app.close()
+
+
+@pytest.mark.slow  # coverage retained: test_router_generate_chaos_drill
+# pins the kill/reroute/content contract on LocalReplicas; this variant
+# only adds the real checkpoint + real train loop around the same path
+def test_e2e_train_checkpoint_serve_stream(tmp_path, rng):
+    """train_ar (synthetic, offline) → checkpoint → fleet serve → streamed
+    session with a mid-stream kill → content-lossless continuation."""
+    from perceiver_io_tpu.cli import train_ar
+    from perceiver_io_tpu.data.imdb import IMDBDataModule
+    from perceiver_io_tpu.inference.generate import load_ar_checkpoint
+    from perceiver_io_tpu.serving.router import Router
+
+    # batch divisible by the conftest's 8-device data axis
+    run_dir = train_ar.main([
+        "--synthetic", "--max_steps", "8", "--batch_size", "8",
+        "--max_seq_len", "48", "--vocab_size", "200",
+        "--synthetic_size", "32", "--num_latents", "16",
+        "--num_latent_channels", "32", "--num_encoder_layers", "2",
+        "--num_self_attention_layers_per_block", "1",
+        "--logdir", str(tmp_path), "--root", str(tmp_path / "data"),
+        "--dtype", "float32", "--sample_prefix_len", "0",
+    ])
+    dm = IMDBDataModule(root=str(tmp_path / "data"), max_seq_len=48,
+                        vocab_size=200, batch_size=4, synthetic=True,
+                        synthetic_size=32)
+    dm.prepare_data()
+    dm.setup()
+    model, params, msl = load_ar_checkpoint(
+        str(Path(str(run_dir)) / "checkpoints"), dm.tokenizer)
+    from perceiver_io_tpu.inference.engine import ServingEngine
+    from perceiver_io_tpu.serving.replica import LocalReplica, ReplicaApp
+
+    def apply_fn(p, token_ids, pad_mask):
+        return model.apply({"params": p}, token_ids, pad_mask)
+
+    reps = []
+    for name in ("e0", "e1"):
+        gen = ARGenerator(model, params, max_seq_len=msl, chunk=4,
+                          name=f"{name}-gen")
+        eng = ServingEngine(apply_fn, params, name=f"{name}-inf",
+                            max_batch=2)
+        reps.append(LocalReplica(ReplicaApp(
+            {"infer": eng}, params, name=name, assume_ready=True,
+            generator=gen)))
+    by_name = {r.name: r for r in reps}
+    router = Router(reps, name="e2e", scrape_interval_s=0.05)
+    time.sleep(0.12)
+    prefix = dm.tokenizer.encode_ids("the movie was")[:8] or [5, 6, 7]
+    oracle = ARGenerator(model, params, max_seq_len=msl, chunk=4,
+                         name="e-oracle")
+    want, _ = oracle.generate(prefix, 12, SamplingConfig(seed=3))
+
+    got = []
+    killed = {"name": None}
+
+    def on_tokens(toks, frame):
+        got.extend(toks)
+        if len(got) >= 4 and killed["name"] is None:
+            for name, r in by_name.items():
+                if r.app._gen_active > 0:
+                    killed["name"] = name
+                    r.kill()
+
+    res = router.generate(prefix, session="e2e", max_new=12, seed=3,
+                          on_tokens=on_tokens)
+    assert res["tokens"] == want and got == want
+    assert killed["name"] is not None and res["reroutes"] >= 1
+    assert int(router._m_gen_failed.value) == 0
+    router.close()
+    for r in reps:
+        r.app.close()
+
+
+def test_generate_drain_refuses_new_streams(tiny, generator, rng):
+    from perceiver_io_tpu.resilience import RejectedError
+
+    model, params = tiny
+    (rep,) = _make_fleet(model, params, names=("d0",),
+                         shared_gen=generator)
+    prefix = [int(t) for t in rng.integers(3, VOCAB, 6)]
+    assert rep.app.drain(timeout_s=5.0)
+    with pytest.raises(RejectedError):
+        rep.app.generate(prefix, max_new=2)
+    rep.app.resume()
+    rep.app.generate(prefix, max_new=2)  # admitted again
+    rep.app.close()

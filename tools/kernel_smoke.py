@@ -50,7 +50,7 @@ from perceiver_io_tpu.utils.platform import probe_backend
 import numpy as np
 
 
-def _attention_case(b, t, s, h, d, seed=0):
+def _attention_case(b, t, s, h, d, seed=0, causal_offset=None):
     import jax
     import jax.numpy as jnp
 
@@ -66,12 +66,18 @@ def _attention_case(b, t, s, h, d, seed=0):
             "bthd,bshd->bhts", q * (d ** -0.5), k,
             preferred_element_type=jnp.float32,
         )
+        if causal_offset is not None:
+            from perceiver_io_tpu.ops.masking import causal_mask
+
+            logits = jnp.where(
+                causal_mask(t, s, causal_offset)[None, None],
+                jnp.finfo(jnp.float32).min, logits)
         probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
         out = jnp.einsum("bhts,bshd->bthd", probs, v)
         return jnp.sum(out.astype(jnp.float32) ** 2)
 
     def ker_loss(q, k, v):
-        out = fused_attention(q, k, v)
+        out = fused_attention(q, k, v, causal_offset=causal_offset)
         return jnp.sum(out.astype(jnp.float32) ** 2)
 
     ref = jax.jit(jax.value_and_grad(ref_loss, argnums=(0, 1, 2)))(q, k, v)
@@ -204,6 +210,21 @@ CASES = {
     # weight-only int8: in-program dequant feeding a bf16 matmul stays
     # within parity vs the f32 oracle (the serving engines' int8w path)
     "quant-int8w-dequant": _quant_case,
+    # -- generative decode geometries (the in-kernel causal flag) --
+    # causal prefill at the d<=128 wide-KV tier (kv resolves to 2048 with
+    # the q-bump interplay): fwd + BOTH backward kernels recompute the same
+    # in-kernel causal bias — parity vs the masked-einsum oracle
+    "attn-causal-prefill-d128": lambda: _attention_case(
+        2, 512, 8192, 8, 128, causal_offset=7680),
+    # square-causal self-attention exactly ON the q-bump s_blk*d guard
+    # (must resolve to the safe default like its non-causal twin)
+    "attn-causal-deep-d512": lambda: _attention_case(
+        1, 2048, 2048, 1, 512, causal_offset=0),
+    # the q_len=1 incremental decode cross over a long token ring at the
+    # VMEM-guard KV tier — the serving step shape (ring validity rides the
+    # causal offset here; the engine uses a pad mask, same masking math)
+    "attn-q1-decode-32k": lambda: _attention_case(
+        1, 1, 32768, 4, 128, causal_offset=32767),
 }
 
 

@@ -142,6 +142,24 @@ ADMISSION_KEYS = ("classes", "abuser_quota_rps", "flood_factor", "pairs",
                   "sheds_by_reason")
 
 
+# the generate block of a --generate_rps run (null otherwise): the SECOND,
+# stateful traffic class — streamed Perceiver-AR continuations with
+# variable prefix lengths, geometric continuation lengths, and the sweep's
+# arrival process — running CONCURRENTLY with the one-shot sweep so the
+# r17 autoscale/admission policies (and least-loaded placement) see mixed
+# traffic. Streams are sessions: ~a third issue a follow-up continuation
+# against their replica-resident cache (`resumed` counts the fast path).
+GENERATE_KEYS = ("offered_streams", "completed", "failed", "shed",
+                 "tokens_total", "steps_per_s", "stream_p50_ms",
+                 "stream_p95_ms", "stream_p99_ms", "followups", "resumed",
+                 "reroutes", "spills", "mean_new", "prefix_lens",
+                 "concurrency")
+# the generate class's sampling shape — ONE definition shared by the load
+# generator and the per-replica warmup (greedy vs top-k are distinct decode
+# programs; a mismatch would re-introduce mid-stream compile stalls)
+GENERATE_TEMPERATURE, GENERATE_TOP_K = 0.8, 16
+
+
 def _pct(values: List[float], q: float) -> Optional[float]:
     """Sorted-index percentile; None when nothing was observed (a fully-shed
     sweep point) — the record carries null, never NaN (invalid JSON)."""
@@ -553,6 +571,147 @@ def _noisy_neighbor(router, reqs, rng, duration: float, victim_rps: float,
     }
 
 
+class _GenerateLoad:
+    """Open-loop generative traffic: streams launched at the offered rate
+    on daemon threads (bounded concurrency; an arrival finding the pool
+    full is SHED and counted — open-loop honesty, never self-throttling),
+    each a `router.generate(session=...)` with a random prefix and a
+    geometric continuation budget. Runs until `stop()`; aggregates the
+    stream-level record."""
+
+    def __init__(self, router, rps: float, prefix_lens: List[int],
+                 mean_new: int, vocab: int, max_seq_len: int, seed: int,
+                 arrival: str, burst: int, concurrency: int = 12,
+                 client: Optional[str] = None):
+        self.router = router
+        self.rps = rps
+        self.prefix_lens = prefix_lens
+        self.mean_new = mean_new
+        self.vocab = vocab
+        self.max_seq_len = max_seq_len
+        self.rng = np.random.default_rng(seed + 7)
+        self.seed = seed
+        self.arrival = arrival
+        self.burst = burst
+        self.client = client
+        self._sem = threading.Semaphore(concurrency)
+        self.concurrency = concurrency
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._walls: List[float] = []
+        self._threads: List[threading.Thread] = []
+        self.offered = self.completed = self.failed = self.shed = 0
+        self.tokens = self.steps_window_tokens = 0
+        self.followups = self.resumed = 0
+        self.reroutes = self.spills = 0
+        self._t0 = None
+        self._launcher = threading.Thread(target=self._run,
+                                          name="genload", daemon=True)
+
+    def start(self) -> "_GenerateLoad":
+        self._t0 = time.monotonic()
+        self._launcher.start()
+        return self
+
+    def _stream(self, i: int, plen: int, max_new: int,
+                followup: bool) -> None:
+        try:
+            prefix = [int(t) for t in
+                      self.rng.integers(3, self.vocab, plen)]
+            t0 = time.monotonic()
+            res = self.router.generate(
+                prefix, session=f"genload-{i}", max_new=max_new,
+                temperature=GENERATE_TEMPERATURE, top_k=GENERATE_TOP_K,
+                seed=self.seed, client=self.client)
+            toks = res["tokens"]
+            res2 = None
+            if followup and toks and len(prefix) + len(toks) + 4 < self.max_seq_len:
+                res2 = self.router.generate(
+                    prefix + toks, session=f"genload-{i}", max_new=3,
+                    temperature=GENERATE_TEMPERATURE, top_k=GENERATE_TOP_K,
+                    seed=self.seed, client=self.client)
+                toks = toks + res2["tokens"]
+            wall = time.monotonic() - t0
+            with self._lock:
+                self.completed += 1
+                self.tokens += len(toks)
+                self.reroutes += res["reroutes"]
+                self.spills += res["spills"]
+                if res2 is not None:
+                    self.followups += 1
+                    self.resumed += 1 if res2["resumed"] else 0
+                    self.reroutes += res2["reroutes"]
+                    self.spills += res2["spills"]
+                self._walls.append(wall)
+        except Exception:
+            with self._lock:
+                self.failed += 1
+        finally:
+            self._sem.release()
+
+    def _run(self) -> None:
+        i = 0
+        mean_gap = 1.0 / max(self.rps, 1e-6)
+        while not self._stop.is_set():
+            if self.arrival == "bursty":
+                n, gap = self.burst, self.burst * mean_gap
+            else:
+                n, gap = 1, float(self.rng.exponential(mean_gap))
+            for _ in range(n):
+                if self._stop.is_set():
+                    return
+                self.offered += 1
+                if not self._sem.acquire(blocking=False):
+                    self.shed += 1
+                    continue
+                plen = int(self.rng.choice(self.prefix_lens))
+                max_new = int(min(
+                    self.rng.geometric(1.0 / max(self.mean_new, 1)),
+                    self.max_seq_len - plen - 1))
+                followup = self.rng.random() < 0.33
+                t = threading.Thread(
+                    target=self._stream, args=(i, plen, max(1, max_new),
+                                               followup),
+                    name=f"genload-{i}", daemon=True)
+                self._threads.append(t)
+                t.start()
+                i += 1
+            self._stop.wait(gap)
+
+    def stop_and_record(self, timeout_s: float) -> Dict:
+        self._stop.set()
+        self._launcher.join(timeout=5)
+        deadline = time.monotonic() + timeout_s
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        total_s = time.monotonic() - self._t0
+        with self._lock:
+            walls = list(self._walls)
+            return {
+                "offered_streams": self.offered,
+                "completed": self.completed,
+                "failed": self.failed,
+                "shed": self.shed,
+                "tokens_total": self.tokens,
+                "steps_per_s": (round(self.tokens / total_s, 3)
+                                if total_s > 0 else None),
+                "stream_p50_ms": _ms(_pct(walls, 0.5)),
+                "stream_p95_ms": _ms(_pct(walls, 0.95)),
+                "stream_p99_ms": _ms(_pct(walls, 0.99)),
+                "followups": self.followups,
+                "resumed": self.resumed,
+                "reroutes": self.reroutes,
+                "spills": self.spills,
+                "mean_new": self.mean_new,
+                "prefix_lens": self.prefix_lens,
+                "concurrency": self.concurrency,
+            }
+
+
+def _ms(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(v * 1e3, 3)
+
+
 def _point_for_record(p: Dict) -> Dict:
     """Seconds → ms for the emitted record (fit_capacity reads the _s keys)."""
     out = {k: p[k] for k in ("offered_rps", "submitted", "completed", "shed",
@@ -760,11 +919,32 @@ def main() -> None:
                      help="null control: the abuser stays polite in BOTH "
                           "arms — measures the drill's own noise floor "
                           "the isolation verdict is judged against")
+    gen = parser.add_argument_group(
+        "generative traffic class (task=generate)")
+    gen.add_argument("--generate_rps", type=float, default=0.0,
+                     help="offered generate-STREAM starts/s, running "
+                          "CONCURRENTLY with the one-shot sweep (0 = off). "
+                          "Each stream is a pinned session with a random "
+                          "prefix and a geometric continuation budget — "
+                          "the second, stateful, bursty class the r17 "
+                          "autoscale/admission policies balance. Needs "
+                          "--replicas >= 1 in inprocess mode")
+    gen.add_argument("--generate_mean_new", type=int, default=16,
+                     help="mean of the geometric continuation length")
+    gen.add_argument("--generate_prefix_lens", default="6,12,24",
+                     help="prefix lengths sampled uniformly per stream")
+    gen.add_argument("--generate_chunk", type=int, default=4,
+                     help="decode steps per chunked dispatch")
     args = parser.parse_args()
 
     if (args.autoscale or args.noisy_neighbor) and args.replicas < 1:
         parser.error("--autoscale/--noisy_neighbor need --replicas >= 1 "
                      "(the control loop lives at the router tier)")
+    if args.generate_rps > 0 and (args.replicas < 1
+                                  or args.replica_mode != "inprocess"):
+        parser.error("--generate_rps needs --replicas >= 1 with "
+                     "--replica_mode inprocess (process replicas serve "
+                     "generation via `serving.replica --task generate`)")
 
     if args.dry:
         record = {
@@ -777,9 +957,10 @@ def main() -> None:
             "series_ab_keys": list(SERIES_AB_KEYS),
             "autoscale_keys": list(AUTOSCALE_KEYS),
             "admission_keys": list(ADMISSION_KEYS),
+            "generate_keys": list(GENERATE_KEYS),
             "sweep": [], "capacity": None, "fleet": None, "deploy": None,
             "trace": None, "alerts": None, "series_ab": None,
-            "autoscale": None, "admission": None,
+            "autoscale": None, "admission": None, "generate": None,
         }
         emit_json_line(record)
         return
@@ -874,6 +1055,17 @@ def main() -> None:
             from perceiver_io_tpu.serving import LocalReplica, ReplicaApp
 
             gathered_apply, params = build_model_apply()
+            ar_model = ar_params = None
+            if args.generate_rps > 0:
+                # the stateful class shares one tiny AR tree; each replica
+                # gets its own generator (its own session caches/programs)
+                from perceiver_io_tpu.models.presets import tiny_ar
+
+                ar_model = tiny_ar()
+                ids0 = np.zeros((1, 64), np.int32)
+                ar_params = ar_model.init(
+                    {"params": jax.random.key(0)}, ids0, ids0 == 0,
+                )["params"]
             made = [0]
             compile_cache = None
             if args.autoscale:
@@ -901,8 +1093,29 @@ def main() -> None:
                 # scrapes as JOINING until its program is live, exactly
                 # like a supervised process replica
                 eng.warmup(*reqs[0], background=background)
+                generator = None
+                if ar_model is not None:
+                    from perceiver_io_tpu.inference.generate import (
+                        ARGenerator,
+                        SamplingConfig,
+                    )
+
+                    generator = ARGenerator(
+                        ar_model, ar_params, max_seq_len=64,
+                        chunk=args.generate_chunk, name=f"lb_r{i}-gen",
+                        registry=registry)
+                    warm_sampling = SamplingConfig(
+                        temperature=GENERATE_TEMPERATURE,
+                        top_k=GENERATE_TOP_K)
+                    if background:
+                        threading.Thread(
+                            target=generator.warmup,
+                            kwargs={"sampling": warm_sampling},
+                            daemon=True).start()
+                    else:
+                        generator.warmup(sampling=warm_sampling)
                 app = ReplicaApp({"infer": eng}, params, name=f"r{i}",
-                                 registry=registry)
+                                 registry=registry, generator=generator)
                 rep = LocalReplica(app)
                 local_replicas.append(rep)
                 return rep
@@ -1151,6 +1364,20 @@ def main() -> None:
         _log(f"autoscale: {rps_per_replica:.1f} req/s/replica fit, fleet "
              f"[{args.min_replicas}, {max_reps}], tick {tick:g}s")
 
+    gen_load = None
+    if args.generate_rps > 0:
+        gen_load = _GenerateLoad(
+            router, rps=args.generate_rps,
+            prefix_lens=[int(p) for p in
+                         args.generate_prefix_lens.split(",")],
+            mean_new=args.generate_mean_new, vocab=503, max_seq_len=64,
+            seed=args.seed, arrival=args.arrival, burst=args.burst,
+            client="genload" if admission is not None else None).start()
+        _log(f"generate class: {args.generate_rps:g} streams/s "
+             f"({args.arrival}), mean_new {args.generate_mean_new}, "
+             f"prefixes {args.generate_prefix_lens} — concurrent with the "
+             "one-shot sweep")
+
     rng = np.random.default_rng(args.seed)
     points = []
     for idx, rate in enumerate(rates):
@@ -1241,6 +1468,13 @@ def main() -> None:
             "lost_accepted": lost,
         }
         _log(f"autoscale: {json.dumps(autoscale_record)}")
+
+    generate_record = None
+    if gen_load is not None:
+        # stopped AFTER the sweep (and the autoscale drill riding it): the
+        # stateful class overlapped every segment
+        generate_record = gen_load.stop_and_record(args.drain_timeout_s)
+        _log(f"generate: {json.dumps(generate_record)}")
 
     admission_record = None
     if args.noisy_neighbor:
@@ -1354,6 +1588,7 @@ def main() -> None:
         "series_ab": series_ab_record,
         "autoscale": autoscale_record,
         "admission": admission_record,
+        "generate": generate_record,
     }
     if args.events_jsonl:
         obs.configure_event_log(None)  # flush + release the sweep's log
