@@ -127,6 +127,19 @@ def add_trainer_args(parser: argparse.ArgumentParser) -> None:
                         "failure that escapes the per-step retries, "
                         "auto-resume from the newest checkpoint "
                         "(fit_with_recovery supervisor). 1 = no supervisor")
+    g.add_argument("--step_timeout_s", type=float, default=None,
+                   help="bounded-exit deadline on the train dispatch cycle: "
+                        "if no step completion is observed within this many "
+                        "seconds (a dead peer wedging a collective), dump "
+                        "thread stacks and exit with the transient code 75 "
+                        "so --spawn_attempts supervision restarts the world "
+                        "(resilience/multihost.py). Default: off")
+    g.add_argument("--peer_heartbeat_s", type=float, default=0.0,
+                   help="multi-host peer-liveness heartbeat cadence over the "
+                        "jax.distributed KV store; a peer that stops beating "
+                        "for 5 intervals is declared dead and this host "
+                        "exits transient (75) instead of hanging in its "
+                        "next collective. 0 = off")
     g.add_argument("--compile_cache", default=None, metavar="DIR",
                    help="cold start: persist XLA compilations here (jax's "
                         "persistent compilation cache, min compile time 0) "
@@ -180,6 +193,15 @@ def add_mesh_args(parser: argparse.ArgumentParser) -> None:
                         "(localhost coordinator, CPU backend per child — a "
                         "dev/simulation helper; real TPU pods auto-detect "
                         "via --multihost with one launch per host)")
+    g.add_argument("--spawn_attempts", type=int, default=1, metavar="K",
+                   help="restart-the-world supervision for --spawn_hosts: "
+                        "on ANY child death the launcher kills the whole "
+                        "world, re-resolves a fresh coordinator port, and "
+                        "relaunches all N hosts with --resume from the "
+                        "newest digest-verified checkpoint, up to K total "
+                        "world launches (capped backoff between restarts; a "
+                        "crash loop of consecutive fast failures detaches "
+                        "early). 1 = today's fail-fast behavior")
     g.add_argument("--multihost", action="store_true",
                    help="call jax.distributed.initialize() before touching "
                         "devices (TPU pods auto-detect the coordinator); "
@@ -310,6 +332,8 @@ def trainer_config(args) -> TrainerConfig:
         rollback_after_bad_steps=getattr(args, "rollback_after_bad_steps", 3),
         dispatch_error_retries=getattr(args, "dispatch_error_retries", 0),
         fit_attempts=getattr(args, "fit_attempts", 1),
+        step_timeout_s=getattr(args, "step_timeout_s", None),
+        peer_heartbeat_s=getattr(args, "peer_heartbeat_s", 0.0),
         compile_cache=getattr(args, "compile_cache", None),
         publish_dir=getattr(args, "publish_dir", None),
         publish_every_n_steps=getattr(args, "publish_every_n_steps", 0),
@@ -540,9 +564,16 @@ def maybe_spawn_hosts(args, argv=None) -> bool:
     during init, well before training starts — so a launch whose first
     failure lands within ``_SPAWN_RETRY_WINDOW_S`` is retried (fresh port,
     same command) up to two more times before the failure is reported.
+
+    Supervision (``--spawn_attempts K``, r19): the launch runs under a
+    :class:`WorldSupervisor` — any child death kills the surviving world,
+    the supervisor re-resolves a fresh coordinator port, and relaunches all
+    N hosts with ``--resume`` pointing at the newest resumable run (the one
+    whose restore will be digest-verified by ``restore_train_state``), with
+    capped backoff between restarts and a crash-loop detach after
+    consecutive fast failures. ``K=1`` (the default) keeps the historical
+    fail-fast behavior.
     """
-    import socket
-    import subprocess
     import sys
 
     n = getattr(args, "spawn_hosts", None)
@@ -554,9 +585,9 @@ def maybe_spawn_hosts(args, argv=None) -> bool:
         if skip:
             skip = False
             continue
-        if a == "--spawn_hosts":
-            skip = True  # drop the flag and its value
-        elif a.startswith("--spawn_hosts="):
+        if a in ("--spawn_hosts", "--spawn_attempts"):
+            skip = True  # drop the launcher-only flag and its value
+        elif a.startswith(("--spawn_hosts=", "--spawn_attempts=")):
             pass
         else:
             child_argv.append(a)
@@ -569,9 +600,6 @@ def maybe_spawn_hosts(args, argv=None) -> bool:
         else:
             # a script's own main(argv) — its file path is still the command
             target = [sys.executable, sys.argv[0]]
-    import tempfile
-    import time
-
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     if len(target) == 3:
@@ -583,141 +611,345 @@ def maybe_spawn_hosts(args, argv=None) -> bool:
             os.path.abspath(perceiver_io_tpu.__file__)))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
 
-    import signal
+    supervisor = WorldSupervisor(
+        launch=lambda resume_dir: _launch_world(
+            target, child_argv, env, n, resume_dir),
+        n=n,
+        attempts=getattr(args, "spawn_attempts", 1) or 1,
+        find_resume=lambda: _newest_resumable_run(
+            getattr(args, "logdir", None), getattr(args, "experiment", None)),
+    )
+    supervisor.run()
+    return True
 
-    last_failure = None
-    for attempt in range(_SPAWN_PORT_RETRIES + 1):
-        with socket.socket() as s:
-            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            s.bind(("localhost", 0))
-            port = s.getsockname()[1]
-        procs, logs = [], []
-        for rank in range(n):
-            cmd = [*target, *child_argv,
-                   "--coordinator_address", f"localhost:{port}",
-                   "--num_processes", str(n), "--process_id", str(rank)]
-            # rank 0 inherits stdout/stderr (it owns logging/checkpoints); the
-            # others write to temp files — NEVER undrained pipes, which fill
-            # the OS buffer once a child emits ~64KB and deadlock the whole
-            # cluster — replayed only on failure
-            if rank == 0:
-                out, log = None, None
-            else:
-                log = tempfile.NamedTemporaryFile(
-                    mode="w+", prefix=f"spawn_hosts_rank{rank}_", suffix=".log",
-                    delete=False,
-                )
-                out = log
-            logs.append(log)
-            procs.append(subprocess.Popen(
-                cmd, env=env, stdout=out,
-                stderr=subprocess.STDOUT if rank else None, text=True,
-            ))
-        print(f"--spawn_hosts: launched {n} processes "
-              f"(coordinator localhost:{port})", file=sys.stderr)
-        started = time.monotonic()
 
-        def _reap(live):
-            for r in live:
-                procs[r].terminate()
-            for r in live:
+def _pick_coordinator_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _launch_world(target, child_argv, env, n, resume_dir=None):
+    """Start all N ranks of one world on a fresh coordinator port. Returns
+    ``(procs, logs)`` — rank 0 inherits stdout/stderr (it owns
+    logging/checkpoints); the others write to temp files — NEVER undrained
+    pipes, which fill the OS buffer once a child emits ~64KB and deadlock
+    the whole cluster — replayed only on failure.
+
+    ``resume_dir`` (world restarts) appends ``--resume`` AFTER the user's
+    argv, so argparse's last-wins gives the supervisor's choice precedence
+    over any ``--resume`` the original command carried.
+    """
+    import subprocess
+    import sys
+    import tempfile
+
+    port = _pick_coordinator_port()
+    extra = ["--resume", str(resume_dir)] if resume_dir else []
+    procs, logs = [], []
+    for rank in range(n):
+        cmd = [*target, *child_argv, *extra,
+               "--coordinator_address", f"localhost:{port}",
+               "--num_processes", str(n), "--process_id", str(rank)]
+        if rank == 0:
+            out, log = None, None
+        else:
+            log = tempfile.NamedTemporaryFile(
+                mode="w+", prefix=f"spawn_hosts_rank{rank}_", suffix=".log",
+                delete=False,
+            )
+            out = log
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            cmd, env=env, stdout=out,
+            stderr=subprocess.STDOUT if rank else None, text=True,
+        ))
+    print(f"--spawn_hosts: launched {n} processes "
+          f"(coordinator localhost:{port})"
+          + (f", resuming {resume_dir}" if resume_dir else ""),
+          file=sys.stderr)
+    return procs, logs
+
+
+def _newest_resumable_run(logdir, experiment):
+    """The newest ``version_N`` run dir under ``logdir/experiment`` holding
+    both embedded hparams and at least one committed checkpoint step (main
+    slot or the preemption ``last/`` slot) — i.e. a dir ``--resume`` will
+    accept and ``restore_train_state`` will digest-verify. None when the
+    world died before its first checkpoint (restart fresh instead)."""
+    import re
+
+    if not logdir or not experiment:
+        return None
+    base = os.path.join(logdir, experiment)
+    try:
+        names = os.listdir(base)
+    except OSError:
+        return None
+    versions = []
+    for name in names:
+        m = re.fullmatch(r"version_(\d+)", name)
+        if m:
+            versions.append((int(m.group(1)), name))
+    for _, name in sorted(versions, reverse=True):
+        run = os.path.join(base, name)
+        ckpt = os.path.join(run, "checkpoints")
+        if not os.path.isfile(os.path.join(ckpt, "hparams.json")):
+            continue
+        for slot in (ckpt, os.path.join(ckpt, "last")):
+            try:
+                entries = os.listdir(slot)
+            except OSError:
+                continue
+            for entry in entries:
+                if entry.isdigit() and os.path.exists(
+                    os.path.join(slot, entry, "_CHECKPOINT_METADATA")
+                ):
+                    return run
+    return None
+
+
+class WorldSupervisor:
+    """Elastic restart-the-world supervision over one ``--spawn_hosts`` job.
+
+    One ``run()`` call owns the whole job lifetime: launch a world, watch
+    every child, and on ANY child death kill the survivors and relaunch all
+    N ranks from the newest resumable checkpoint — the process-level twin of
+    the serving tier's ``ReplicaSupervisor`` (r12), except that multi-host
+    training cannot restart one rank (its peers' collectives reference the
+    dead one's program), so the restart unit is the WORLD.
+
+    Injectable collaborators keep the policy tier-1-testable with fake
+    children: ``launch(resume_dir) -> (procs, logs)`` where each proc
+    exposes ``poll/terminate/kill/wait``; ``find_resume() -> run_dir|None``;
+    ``sleep`` for the backoff. Three failure disciplines compose:
+
+    - **port-race retry** (pre-existing): a fast failure with connect/bind
+      evidence in a child log relaunches on a fresh port WITHOUT consuming
+      a supervision attempt (bounded by ``_SPAWN_PORT_RETRIES`` per world);
+    - **world restart**: up to ``attempts`` total world launches, capped
+      exponential backoff between them, ``spawn_world_restarts_total``
+      counting actuations;
+    - **crash-loop detach**: ``_CRASHLOOP_LIMIT`` consecutive worlds dying
+      within ``_CRASHLOOP_WINDOW_S`` of launch abandon the job early with
+      the last exit code — a deterministic failure must not burn the whole
+      attempt budget at backoff cadence.
+
+    The chaos hook ``spawn.child_exit`` fires once per watch poll; an
+    injected raise is treated as an observed child death (simulated-failure
+    drills restart real worlds without killing real processes).
+    """
+
+    def __init__(self, launch, n, attempts=1, find_resume=None,
+                 poll_s=0.2, backoff=None, sleep=None, reap_wait_s=10.0):
+        import time as _time
+
+        import perceiver_io_tpu.obs as obs
+        from perceiver_io_tpu.resilience import RetryPolicy
+
+        self._launch = launch
+        self.n = int(n)
+        self.attempts = max(1, int(attempts))
+        self._find_resume = find_resume or (lambda: None)
+        self._poll_s = poll_s
+        self._backoff = backoff or RetryPolicy(
+            max_retries=self.attempts, base_s=1.0, multiplier=2.0, max_s=30.0)
+        self._sleep = sleep or _time.sleep
+        self._reap_wait_s = reap_wait_s
+        self._m_restarts = obs.get_registry().counter(
+            "spawn_world_restarts_total",
+            "whole-world relaunches after a child death under "
+            "--spawn_attempts supervision")
+        self.procs = []  # the CURRENT world, for the signal handlers
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _reap(self) -> None:
+        import subprocess
+
+        live = [p for p in self.procs if p.poll() is None]
+        for p in live:
+            p.terminate()
+        for p in live:
+            try:
+                p.wait(timeout=self._reap_wait_s)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                # wait out the SIGKILL too: the NEXT world must never
+                # overlap a dying one (zombie reaping, port/file handles,
+                # and CPU contention during its successor's compile)
                 try:
-                    procs[r].wait(timeout=10)
+                    p.wait(timeout=self._reap_wait_s)
                 except subprocess.TimeoutExpired:
-                    procs[r].kill()
+                    pass
 
-        # the launcher must never outlive-orphan its children: SIGTERM/SIGINT
-        # (Ctrl-C, `timeout`, a scheduler preemption) reaps them before exiting
+    def _watch(self):
+        """Poll until the world succeeds (-> None) or any child dies
+        (-> (rank|None, rc)); rank None marks an injected simulated death."""
+        import time as _time
+
+        from perceiver_io_tpu.resilience import faults
+
+        live = list(range(self.n))
+        while live:
+            try:
+                # chaos hook: a raise simulates an observed child death
+                faults.inject("spawn.child_exit")
+            except Exception as e:
+                import sys
+
+                print(f"--spawn_hosts: injected child death "
+                      f"({type(e).__name__})", file=sys.stderr)
+                return None, 1
+            for r in list(live):
+                rc = self.procs[r].poll()
+                if rc is not None:
+                    live.remove(r)
+                    if rc != 0:
+                        return r, rc
+            if live:
+                _time.sleep(self._poll_s)
+        return None
+
+    def _replay_log(self, logs, rank, label="") -> bool:
+        """Dump a failed rank's captured output tail to stderr; returns
+        whether there was a log to replay (rank 0 streams directly)."""
+        import sys
+
+        if rank is None or rank >= len(logs) or logs[rank] is None:
+            return False
+        logs[rank].flush()
+        logs[rank].seek(0)
+        print(f"--- rank {rank} output{label} ---\n"
+              f"{logs[rank].read()[-4000:]}", file=sys.stderr)
+        return True
+
+    def _close_logs(self, logs, keep=None) -> None:
+        """Close every log handle; delete all but ``keep``'s (kept for
+        post-mortem) so repeated dev runs don't litter /tmp."""
+        for rank, log in enumerate(logs):
+            if log is None:
+                continue
+            log.close()
+            if rank != keep:
+                try:
+                    os.unlink(log.name)
+                except OSError:
+                    pass
+
+    # -- the supervision loop ------------------------------------------------
+
+    def run(self) -> None:
+        """Supervise to completion; raises SystemExit on final failure."""
+        import signal
+
+        # the launcher must never outlive-orphan its children:
+        # SIGTERM/SIGINT (Ctrl-C, `timeout`, a scheduler preemption) reaps
+        # the current world before exiting
         prev_handlers = {}
 
         def _on_signal(signum, frame):
-            _reap([r for r in range(n) if procs[r].poll() is None])
+            self._reap()
             raise SystemExit(128 + signum)
 
         for sig in (signal.SIGTERM, signal.SIGINT):
             try:
                 prev_handlers[sig] = signal.signal(sig, _on_signal)
             except ValueError:
-                pass  # non-main thread (programmatic use) — skip the handlers
-        # poll rather than wait in rank order: a crashed child leaves the
-        # survivors blocked in collectives, so the first non-zero exit
-        # terminates the rest instead of hanging the launcher forever
-        failed = None
-        retrying = False
-        live = list(range(n))
+                pass  # non-main thread (programmatic use) — skip handlers
         try:
-            while live and failed is None:
-                for r in list(live):
-                    rc = procs[r].poll()
-                    if rc is not None:
-                        live.remove(r)
-                        if rc != 0:
-                            failed = (r, rc)
-                            break
-                time.sleep(0.2)
-            if failed is not None:
-                rank, rc = failed
-                _reap(live)
-                fast = time.monotonic() - started < _SPAWN_RETRY_WINDOW_S
-                # Retry ONLY with evidence of a coordinator bring-up problem
-                # in some child's log (rank 0 streams to the console, but on
-                # a port race the client ranks fail with connect/coordination
-                # errors too) — a deterministic fast failure (bad flag, import
-                # error) must surface immediately, not be retried twice with a
-                # misleading race diagnostic.
-                retrying = (fast and attempt < _SPAWN_PORT_RETRIES
-                            and _logs_show_coordination_failure(logs))
-                if retrying:
-                    print(
-                        f"--spawn_hosts: rank {rank} failed (rc={rc}) within "
-                        f"{_SPAWN_RETRY_WINDOW_S:.0f}s with connect/bind "
-                        "errors in the child logs — likely a coordinator-port "
-                        "race; retrying with a fresh port",
-                        file=sys.stderr,
-                    )
-                    # show the evidence on EVERY retry (ADVICE r5): if this
-                    # is actually a deterministic failure that happens to
-                    # match a connect/bind marker, the user sees the real
-                    # error now instead of after two blind retries
-                    if logs[rank] is not None:
-                        logs[rank].flush()
-                        logs[rank].seek(0)
-                        print(
-                            f"--- rank {rank} output (retry {attempt + 1}) ---"
-                            f"\n{logs[rank].read()[-2000:]}",
-                            file=sys.stderr,
-                        )
-                    last_failure = failed
-                    continue
-                if logs[rank] is not None:
-                    logs[rank].flush()
-                    logs[rank].seek(0)
-                    print(
-                        f"--- rank {rank} output ---\n{logs[rank].read()[-4000:]}",
-                        file=sys.stderr,
-                    )
-                    print(f"(full rank-{rank} log kept at {logs[rank].name})",
-                          file=sys.stderr)
-                raise SystemExit(rc)
+            self._run_supervised()
         finally:
             for sig, h in prev_handlers.items():
                 signal.signal(sig, h)
-            # close every log handle; delete all but a failed rank's (kept for
-            # replay) so repeated dev runs don't litter /tmp
-            keep = failed[0] if failed is not None and not retrying else None
-            for rank, log in enumerate(logs):
-                if log is None:
-                    continue
-                log.close()
-                if rank != keep:
-                    try:
-                        os.unlink(log.name)
-                    except OSError:
-                        pass
-        return True
-    # unreachable: the final attempt either returns or raises above — kept
-    # for clarity if the retry constants change
-    raise SystemExit(last_failure[1] if last_failure else 1)
+
+    def _run_supervised(self) -> None:
+        import sys
+        import time as _time
+
+        launches = 0          # FAILED worlds counted against the budget
+        port_retries = 0      # per-world coordinator-port retries
+        fast_failures = 0     # consecutive crash-loop candidates
+        resume_dir = None
+        while True:
+            self.procs, logs = self._launch(resume_dir)
+            started = _time.monotonic()
+            failed = self._watch()
+            if failed is None:
+                self._close_logs(logs)
+                return
+            rank, rc = failed
+            self._reap()
+            elapsed = _time.monotonic() - started
+            # Port-race retry ONLY with evidence of a coordinator bring-up
+            # problem in some child's log — a deterministic fast failure
+            # (bad flag, import error) must surface immediately, not be
+            # retried with a misleading race diagnostic. Doesn't consume a
+            # supervision attempt (hence counted before `launches` moves).
+            if (elapsed < _SPAWN_RETRY_WINDOW_S
+                    and port_retries < _SPAWN_PORT_RETRIES
+                    and _logs_show_coordination_failure(logs)):
+                port_retries += 1
+                print(
+                    f"--spawn_hosts: rank {rank} failed (rc={rc}) within "
+                    f"{_SPAWN_RETRY_WINDOW_S:.0f}s with connect/bind "
+                    "errors in the child logs — likely a coordinator-port "
+                    "race; retrying with a fresh port",
+                    file=sys.stderr,
+                )
+                # show the evidence on EVERY retry (ADVICE r5): if this is
+                # actually a deterministic failure that happens to match a
+                # connect/bind marker, the user sees the real error now
+                self._replay_log(logs, rank, f" (retry {port_retries})")
+                self._close_logs(logs)
+                continue
+            port_retries = 0
+            launches += 1
+            out_of_attempts = launches >= self.attempts
+            crash_loop = False
+            if elapsed < _CRASHLOOP_WINDOW_S:
+                fast_failures += 1
+                crash_loop = fast_failures >= _CRASHLOOP_LIMIT
+            else:
+                fast_failures = 0
+            if out_of_attempts or crash_loop:
+                replayed = self._replay_log(logs, rank)
+                if replayed:
+                    print(f"(full rank-{rank} log kept at "
+                          f"{logs[rank].name})", file=sys.stderr)
+                self._close_logs(logs, keep=rank)
+                if crash_loop and not out_of_attempts:
+                    print(
+                        f"--spawn_hosts: {fast_failures} consecutive worlds "
+                        f"died within {_CRASHLOOP_WINDOW_S:.0f}s of launch — "
+                        f"crash loop, detaching with "
+                        f"{self.attempts - launches} attempt(s) unused",
+                        file=sys.stderr,
+                    )
+                raise SystemExit(rc)
+            self._replay_log(logs, rank)
+            self._close_logs(logs)
+            self._m_restarts.inc()
+            resume_dir = self._find_resume()
+            pause = self._backoff.backoff_s(launches)
+            print(
+                f"--spawn_hosts: world attempt {launches}/{self.attempts} "
+                f"failed ({'injected' if rank is None else f'rank {rank}'} "
+                f"rc={rc}); restarting all {self.n} hosts in {pause:.1f}s"
+                + (f" with --resume {resume_dir}" if resume_dir
+                   else " fresh (no checkpoint yet)"),
+                file=sys.stderr,
+            )
+            import perceiver_io_tpu.obs as obs
+
+            obs.event("spawn_world_restart", attempt=launches, rc=rc,
+                      rank=rank, resume_dir=resume_dir,
+                      backoff_s=round(pause, 3))
+            if pause > 0:
+                self._sleep(pause)
 
 
 # Children that die this quickly never started training — a candidate for
@@ -725,6 +957,13 @@ def maybe_spawn_hosts(args, argv=None) -> bool:
 # only when the child logs actually show coordination/bind errors.
 _SPAWN_RETRY_WINDOW_S = 20.0
 _SPAWN_PORT_RETRIES = 2
+
+# Crash-loop detach (--spawn_attempts supervision): this many CONSECUTIVE
+# worlds dying within the window of their launch abandon the job early — a
+# deterministic failure (shape bug, poisoned checkpoint) must not burn the
+# whole attempt budget at backoff cadence while looking like recovery.
+_CRASHLOOP_WINDOW_S = 15.0
+_CRASHLOOP_LIMIT = 3
 
 # Signatures of a failed jax.distributed bring-up in a child's output —
 # CONNECT/BIND-specific only (ADVICE r5): broad markers like
@@ -827,6 +1066,8 @@ def parse_with_resume(parser: argparse.ArgumentParser, argv):
     # flags have no --no_* spelling to override with)
     env_flags = {"resume", "multihost", "coordinator_address", "num_processes",
                  "process_id", "dp", "tp", "sp", "shard_seq", "zero_opt",
+                 # launcher topology/supervision describe THIS invocation
+                 "spawn_hosts", "spawn_attempts",
                  # local paths: never inherit across hosts/invocations
                  "compile_cache", "publish_dir", "publish_every_n_steps"}
     defaults = {

@@ -148,6 +148,25 @@ def batch_shardings(
     }
 
 
+# width of the coordination bitmask carried by the coord_flags channel
+# (bit 0: preemption — training/trainer.py _PREEMPT_BIT; room to grow)
+_COORD_FLAG_BITS = 8
+
+
+def coord_flags_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding of the ``(num_devices,)`` int32 coordination-flags vector —
+    the multi-host agreement channel (``make_sharded_train_step(coord_flags=
+    True)``): one element per device, every element of a host's shard
+    holding that host's local flag bitmask. A host builds its slice with
+    ``jax.make_array_from_process_local_data`` (all-equal values, so the
+    device-order permutation inside the shard is irrelevant), and the step
+    reduces the vector on device — the same all-reduce a ``psum`` would
+    lower to — so the agreed value comes back replicated and bit-identical
+    on every host, riding the training dispatch itself (no extra host
+    round-trip, no side channel that could observe a different step)."""
+    return NamedSharding(mesh, P(tuple(mesh.axis_names)))
+
+
 def _with_data_axis(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
     """Add ``data`` over the first free, divisible dimension of ``spec``."""
     dp = mesh.shape[AXIS_DATA]
@@ -334,6 +353,7 @@ def make_sharded_train_step(
     donate_state: bool = True,
     zero_opt=False,  # False | True (opt-state over data) | 'params' (ZeRO-3)
     stacked: bool = False,
+    coord_flags: bool = False,
 ):
     """jit the pure ``(state, batch) → (state, metrics)`` step with explicit
     in/out shardings over the mesh. Returns ``(step_fn, sharded_state,
@@ -344,6 +364,15 @@ def make_sharded_train_step(
     selects only the contracted keys, so loader output feeds in directly.
     Batches can be host numpy (dispatch places them per the shardings) or
     pre-placed via ``jax.device_put(batch, batch_shardings)``.
+
+    ``coord_flags=True`` grows the step a third input — the
+    :func:`coord_flags_sharding` ``(num_devices,)`` int32 vector of per-host
+    flag bitmasks — and a ``metrics['coord_flags']`` output scalar holding
+    the fleet-wide OR (a bitwise-or reduce over the sharded vector, which GSPMD
+    lowers to the cross-host all-reduce a psum would use). The trainer's
+    multi-host preemption agreement rides this channel; the returned step
+    then has signature ``(state, batch, flags)`` and exposes the flags
+    sharding as ``step.coord_flags_sharding``.
     """
     keys = tuple(sorted(example_batch))
     sharded_state, state_shardings = shard_train_state(state, mesh, rules, zero_opt=zero_opt)
@@ -364,15 +393,46 @@ def make_sharded_train_step(
             with sequence_parallel_context(mesh):
                 return inner_step(state, batch)
 
-    jitted = jax.jit(
-        train_step,
-        in_shardings=(state_shardings, b_shardings),
-        out_shardings=(state_shardings, None),
-        donate_argnums=(0,) if donate_state else (),
-    )
+    if coord_flags:
+        flags_sharding = coord_flags_sharding(mesh)
+        base_step = train_step
 
-    def step(state, batch):
-        return jitted(state, {k: batch[k] for k in keys})
+        def coordinated(state, batch, flags):
+            new_state, metrics = base_step(state, batch)
+            metrics = dict(metrics)
+            # fleet-wide OR of the per-host bitmasks, replicated everywhere.
+            # A plain max would drop bits once two hosts raise DIFFERENT
+            # bits, and XLA's cross-device reduce has no integer `or` — so
+            # OR = per-bit any = per-bit MAX, recombined (8 flag bits).
+            bit_positions = jnp.arange(_COORD_FLAG_BITS, dtype=jnp.int32)
+            bits = (flags[:, None] >> bit_positions) & 1
+            metrics["coord_flags"] = jnp.sum(
+                jnp.max(bits, axis=0) << bit_positions, dtype=jnp.int32)
+            return new_state, metrics
+
+        jitted = jax.jit(
+            coordinated,
+            in_shardings=(state_shardings, b_shardings, flags_sharding),
+            out_shardings=(state_shardings, None),
+            donate_argnums=(0,) if donate_state else (),
+        )
+
+        def step(state, batch, flags):
+            return jitted(state, {k: batch[k] for k in keys}, flags)
+
+        step.coord_flags_sharding = flags_sharding
+    else:
+        jitted = jax.jit(
+            train_step,
+            in_shardings=(state_shardings, b_shardings),
+            out_shardings=(state_shardings, None),
+            donate_argnums=(0,) if donate_state else (),
+        )
+
+        def step(state, batch):
+            return jitted(state, {k: batch[k] for k in keys})
+
+        step.coord_flags_sharding = None
 
     # expose the underlying jit wrapper for lowering/cost-analysis reuse
     step.jitted = jitted

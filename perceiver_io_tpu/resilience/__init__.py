@@ -16,6 +16,10 @@ chaos substrate that proves it works without real hardware failures:
   displace a request to ANOTHER replica (rejections and dead-replica socket
   errors re-route, deadline expiry and lost session affinity never do), and
   how many placements one request may burn.
+- :mod:`multihost` — bounded-exit failure detection for multi-host
+  training: the KV-store peer-liveness monitor and the per-step deadline,
+  both exiting with :data:`~perceiver_io_tpu.resilience.multihost
+  .EXIT_TRANSIENT` so restart-the-world supervision relaunches the job.
 
 Consumers: ``inference/engine.py`` (deadline shedding, bounded-queue
 admission, transient re-dispatch, breaker-gated submission),
@@ -33,6 +37,13 @@ from perceiver_io_tpu.resilience.faults import (
     InjectedFatalError,
     InjectedTransientError,
 )
+from perceiver_io_tpu.resilience.multihost import (
+    EXIT_TRANSIENT,
+    InMemoryKV,
+    PeerLivenessMonitor,
+    StepDeadline,
+    abort_transient,
+)
 from perceiver_io_tpu.resilience.retry import (
     DeadlineExceeded,
     RejectedError,
@@ -47,13 +58,18 @@ __all__ = [
     "BreakerOpen",
     "CircuitBreaker",
     "DeadlineExceeded",
+    "EXIT_TRANSIENT",
     "FailoverPolicy",
     "FaultInjector",
     "FaultSpec",
+    "InMemoryKV",
     "InjectedFatalError",
     "InjectedTransientError",
+    "PeerLivenessMonitor",
     "RejectedError",
     "RetryPolicy",
+    "StepDeadline",
+    "abort_transient",
     "call_with_retry",
     "classify_error",
     "is_transient",

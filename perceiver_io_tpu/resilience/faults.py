@@ -28,7 +28,14 @@ Instrumented sites (grep for ``faults.inject`` / ``faults.corrupt``):
 - ``deploy.publish`` / ``deploy.gate`` / ``deploy.swap`` — the train→serve
   deployment loop (``perceiver_io_tpu.deploy``): checkpoint publication
   (``fire`` hook: raise kinds AND nan corruption of the published tree),
-  the serving-side admission gate, and the fleet hot-swap.
+  the serving-side admission gate, and the fleet hot-swap;
+- ``trainer.collective`` — ``fire`` hook over the host-local batch right
+  before every train dispatch (multi-host chaos: per-host NaN corruption,
+  wedged-host hangs, per-step throttling);
+- ``multihost.heartbeat`` — the peer-liveness publisher
+  (``resilience/multihost.py``);
+- ``spawn.child_exit`` — the restart-the-world supervisor's child watch
+  loop (``cli/common.py``).
 
 The registered sites live in :data:`SITES`; :func:`parse_spec` validates
 every clause against them (and the kind set), so a typo'd drill fails
@@ -84,6 +91,19 @@ SITES = (
     # drills target a replica's step path without code changes
     "generation.prefill",
     "generation.step",
+    # multi-host training fault tolerance (r19): the collective train-step
+    # edge (fire hook over the HOST-LOCAL batch before dispatch — nan =
+    # one host's shard corrupted, whose NaN then rides the global loss
+    # reduction to every peer; hang = a wedged host inside the collective;
+    # slow = per-step throttle for drill timing), the peer-liveness
+    # publisher (resilience/multihost.py — transient = a KV-store write
+    # failing; hang = this host stops beating, so PEERS mark it down), and
+    # the world supervisor's child watch loop (cli/common.py — a raise is
+    # treated as an observed child death, driving restart drills without
+    # killing real processes)
+    "trainer.collective",
+    "multihost.heartbeat",
+    "spawn.child_exit",
 )
 _SUFFIXED = ("engine.dispatch", "engine.complete")
 

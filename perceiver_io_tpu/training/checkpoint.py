@@ -40,11 +40,21 @@ DIGESTS_FILE = "digests.json"
 
 
 def _record_digest(directory: str, step: int, params) -> None:
-    """Append ``{step: digest}`` to the sidecar (atomic tmp+replace; single
-    process only — a multi-host global tree is not addressable from one
-    process, and every host racing one json would corrupt it anyway)."""
+    """Append ``{step: digest}`` to the sidecar (atomic tmp+replace).
+
+    Multi-process: process 0 alone writes (every host racing one json would
+    corrupt it), and only when every leaf is fully REPLICATED (the standard
+    data-parallel layout — note a multi-host global array is never fully
+    *addressable*, but a replicated one is device_get-able from any one
+    host's replica). A ZeRO-3 tree is sharded across hosts and gets no
+    sidecar; its restores fall back to Orbax's atomic-commit guarantee, as
+    before r19."""
+    leaves = jax.tree.leaves(params)
     if jax.process_count() > 1:
-        return
+        if jax.process_index() != 0 or not all(
+            getattr(leaf, "is_fully_replicated", True) for leaf in leaves
+        ):
+            return
     from perceiver_io_tpu.utils.treepath import tree_digest
 
     digest = tree_digest(jax.device_get(params))
@@ -362,9 +372,21 @@ def restore_train_state(
                 # digest sidecar: a restore can SUCCEED while holding
                 # silently corrupted bytes — verify the params content
                 # against the digest recorded at save time before trusting
-                # the step (no sidecar entry = pre-digest checkpoint: trust)
+                # the step (no sidecar entry = pre-digest checkpoint: trust).
+                # Multi-process: every host verifies whenever the restored
+                # tree is fully REPLICATED (each host hashes its own full
+                # replica); hosts read the same bytes off the shared
+                # checkpoint filesystem, so a mismatch — and the fallback
+                # to the previous candidate — is observed identically on
+                # every rank and the restore collectives stay in lockstep.
+                # (single-process trees are always verifiable — sharded or
+                # not, every leaf is host-addressable, as pre-r19)
+                verifiable = jax.process_count() == 1 or all(
+                    getattr(leaf, "is_fully_replicated", True)
+                    for leaf in jax.tree.leaves(restored["params"])
+                )
                 expected = (_expected_digest(cand_dir, cand_step)
-                            if jax.process_count() == 1 else None)
+                            if verifiable else None)
                 if expected is not None:
                     from perceiver_io_tpu.utils.treepath import tree_digest
 

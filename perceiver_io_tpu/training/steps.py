@@ -70,7 +70,10 @@ def make_scanned_step(train_step):
     the per-call overhead that otherwise gates the whole training loop
     (PERF.md: the flagship trainer loop reached ~40% of the pure device-step
     rate on the tunneled backend). Float metrics come back as the window
-    mean, others (e.g. step counters) as the last value.
+    mean; integer metrics as the window MAX (for a monotonic counter that is
+    its last value, and an any-fired flag — :func:`make_guarded_step`'s
+    ``bad_step`` — survives the reduction instead of being masked by a clean
+    final sub-step); anything else as the last value.
     """
 
     def scanned(state, stacked):
@@ -82,11 +85,64 @@ def make_scanned_step(train_step):
         def reduce(leaf):
             if jnp.issubdtype(leaf.dtype, jnp.floating):
                 return leaf.mean(axis=0)
+            if jnp.issubdtype(leaf.dtype, jnp.integer):
+                return leaf.max(axis=0)
             return leaf[-1]
 
         return state, jax.tree.map(reduce, ms)
 
     return scanned
+
+
+def make_guarded_step(train_step):
+    """Collective-consistent non-finite-step guard: wrap a ``(state, batch) →
+    (state, metrics)`` step so a non-finite loss SKIPS the update ON DEVICE —
+    every leaf of the returned state is selected between the pre-step and
+    post-step value by the same device-resident flag, and ``metrics`` gains
+    ``bad_step`` (int32 0/1, deliberately non-float so a host-side NaN
+    corruption of the fetched metrics cannot forge or erase it).
+
+    This is what lifts the r9 single-process-only restriction on
+    ``skip_nonfinite_steps``: under a multi-host data-sharded mesh the loss
+    is already the output of the compiler-inserted cross-host psum (a NaN in
+    ANY host's batch shard poisons the global scalar for every peer
+    identically), so the flag derived from it — and therefore the
+    skip-or-keep select — is bit-identical on all hosts by construction. No
+    host ever makes a local decision that could desynchronize the fleet's
+    collective programs, and no extra host round-trip is spent agreeing.
+
+    Wrap BEFORE :func:`make_scanned_step` so each sub-step of a multi-step
+    dispatch window selects independently (a mid-window bad step discards
+    only its own update).
+    """
+
+    def select(bad, old, new):
+        if jax.dtypes.issubdtype(new.dtype, jax.dtypes.prng_key):
+            # typed PRNG keys carry an extended dtype jnp.where rejects;
+            # select their raw key data and re-wrap
+            data = jnp.where(bad, jax.random.key_data(old),
+                             jax.random.key_data(new))
+            return jax.random.wrap_key_data(
+                data, impl=jax.random.key_impl(new))
+        return jnp.where(bad, old, new)
+
+    def guarded(state, batch):
+        new_state, metrics = train_step(state, batch)
+        loss = metrics.get("loss")
+        if loss is None:
+            # no loss metric = nothing to guard on (the pre-r19 host-side
+            # check was a no-op here too); pass through with the flag down
+            metrics = dict(metrics)
+            metrics["bad_step"] = jnp.int32(0)
+            return new_state, metrics
+        bad = jnp.logical_not(jnp.all(jnp.isfinite(loss)))
+        kept = jax.tree.map(
+            lambda old, new: select(bad, old, new), state, new_state)
+        metrics = dict(metrics)
+        metrics["bad_step"] = bad.astype(jnp.int32)
+        return kept, metrics
+
+    return guarded
 
 
 def mlm_gather_capacity(seq_len: int, mask_p: float = 0.15) -> int:

@@ -52,6 +52,10 @@ from perceiver_io_tpu.utils import profiling
 Batch = Dict[str, np.ndarray]
 Metrics = Dict[str, Any]
 
+# bit 0 of the coordination-flags bitmask: this host observed SIGTERM and
+# asks the fleet to checkpoint-and-exit at the next agreed step boundary
+_PREEMPT_BIT = 1
+
 
 @dataclasses.dataclass(frozen=True)
 class TrainerConfig:
@@ -131,6 +135,31 @@ class TrainerConfig:
     # transient failure escaping the per-dispatch retries, auto-resume from
     # the newest checkpoint up to this many total attempts.
     fit_attempts: int = 1
+    # MULTI-HOST FAULT TOLERANCE (resilience/multihost.py, PERF.md
+    # §Multi-host recovery). step_timeout_s: bounded-exit deadline on the
+    # dispatch cycle — if the host observes no step completion within this
+    # window (the wedged-dead-collective signature) it dumps thread stacks
+    # and exits with the TRANSIENT code so the restart-the-world supervisor
+    # (--spawn_attempts) relaunches from the newest checkpoint. None = off.
+    step_timeout_s: Optional[float] = None
+    # peer_heartbeat_s: publish/scan cadence of the KV-store peer-liveness
+    # monitor (multi-host only; detects a SILENTLY dead peer even between
+    # collectives). Peer declared down after 5 missed beats. 0 = off.
+    peer_heartbeat_s: float = 0.0
+    # coord_check_dispatches: cadence (in dispatches) of the agreement-flag
+    # READ on the coordination channel. The flag always rides every
+    # dispatch on device; fetching its scalar is a host sync on the
+    # previous dispatch, so 1 (the default, and what the chaos drills pin)
+    # trades host run-ahead for a 2-dispatch preemption response, while a
+    # dispatch-latency-bound transport (the axon tunnel: ~100 ms per scalar
+    # fetch, PERF.md) should raise it — the schedule is identical on every
+    # host for ANY value, so agreement stays deadlock-free, just later.
+    coord_check_dispatches: int = 1
+    # testing only: run the multi-host coordination channel on a single
+    # process (agreement degenerates to one host's flags) — the tier-1
+    # harness for the preemption-agreement plumbing, which otherwise only
+    # executes under jax.process_count() > 1.
+    force_coordination: bool = False
     # CONTINUOUS DEPLOYMENT (perceiver_io_tpu.deploy, PERF.md §Deployment):
     # every publish_every_n_steps optimizer steps, atomically publish the
     # CURRENT params to publish_dir with a manifest (step, val metrics,
@@ -162,6 +191,16 @@ class TrainerConfig:
             )
         if self.fit_attempts < 1:
             raise ValueError(f"fit_attempts must be >= 1, got {self.fit_attempts}")
+        if self.step_timeout_s is not None and self.step_timeout_s <= 0:
+            raise ValueError(
+                f"step_timeout_s must be positive, got {self.step_timeout_s}")
+        if self.peer_heartbeat_s < 0:
+            raise ValueError(
+                f"peer_heartbeat_s must be >= 0, got {self.peer_heartbeat_s}")
+        if self.coord_check_dispatches < 1:
+            raise ValueError(
+                f"coord_check_dispatches must be >= 1, got "
+                f"{self.coord_check_dispatches}")
         if (self.publish_dir is None) != (self.publish_every_n_steps <= 0):
             raise ValueError(
                 "checkpoint publication needs BOTH publish_dir and "
@@ -224,18 +263,33 @@ class Trainer:
             # before the first step compiles (reset_cache inside makes this
             # safe even though the backend is already up)
             enable_persistent_compilation_cache(config.compile_cache)
-        if ((config.recovery_active or config.fit_attempts > 1)
+        if ((config.dispatch_error_retries > 0 or config.fit_attempts > 1)
                 and jax.process_count() > 1):
-            # same per-host-divergence hazard the SIGTERM handler gates on:
-            # one host retrying/skipping/restarting a COLLECTIVE train step
-            # while the others advance deadlocks the job in mismatched
-            # programs. Multi-host failure recovery is restart-from-
-            # checkpoint (--resume), which every host performs identically.
+            # A dispatch retry RE-ENTERS a collective a peer already left
+            # (the peers advanced past the program the retry replays), and a
+            # fit_with_recovery restart does the same one level up — both
+            # deadlock the job in mismatched programs, so they stay
+            # single-process only. skip_nonfinite_steps is DIFFERENT since
+            # r19: the skip is a device-side select driven by the globally
+            # psummed loss (training/steps.py make_guarded_step), so every
+            # host takes the identical branch and no program diverges.
+            # Multi-host process-death recovery is restart-the-world
+            # (--spawn_attempts supervision / --resume), which every host
+            # performs identically.
             raise ValueError(
-                "trainer recovery (skip_nonfinite_steps / "
-                "dispatch_error_retries / fit_attempts > 1) is "
+                "trainer dispatch retries / fit attempts "
+                "(dispatch_error_retries / fit_attempts > 1) are "
                 "single-process only — multi-host runs recover by "
-                "restarting from the newest checkpoint (--resume)"
+                "restarting the world from the newest checkpoint "
+                "(--spawn_attempts / --resume)"
+            )
+        if (config.skip_nonfinite_steps and jax.process_count() > 1
+                and mesh is None):
+            raise ValueError(
+                "skip_nonfinite_steps under multiple processes needs a mesh: "
+                "without one there is no collective for hosts to agree on "
+                "the bad-step flag over (each host would train — and skip — "
+                "independently)"
             )
         self._publisher = None
         if config.publish_dir:
@@ -276,14 +330,29 @@ class Trainer:
             self._prev_debug_nans = jax.config.jax_debug_nans
             jax.config.update("jax_debug_nans", True)
         step_fn = train_step
+        if config.skip_nonfinite_steps:
+            # device-side collective-consistent skip: the select rides the
+            # step itself, so the decision is bit-identical on every host
+            # (and on every sub-step of a scanned window — wrap BEFORE scan)
+            from perceiver_io_tpu.training.steps import make_guarded_step
+
+            step_fn = make_guarded_step(step_fn)
         step_example = self._example_batch
         if self._k > 1:
             from perceiver_io_tpu.training.steps import make_scanned_step
 
-            step_fn = make_scanned_step(train_step)
+            step_fn = make_scanned_step(step_fn)
             step_example = {
                 k: np.stack([v]) for k, v in self._example_batch.items()
             }
+        # Multi-host coordination channel (the preemption-agreement psum):
+        # host-local flags ride every dispatch as a sharded int32 vector and
+        # come back agreed (see parallel/sharding.py coord_flags_sharding).
+        self._coord = (
+            mesh is not None
+            and config.checkpoint_on_sigterm
+            and (jax.process_count() > 1 or config.force_coordination)
+        )
         # donation is off under debug_nans (the de-optimized re-run replays
         # the original arguments) AND under recovery (a skipped bad step
         # keeps serving the PRE-step state, and a transient retry re-runs the
@@ -297,6 +366,7 @@ class Trainer:
                     rules=rules, shard_seq=shard_seq, zero_opt=zero_opt,
                     stacked=self._k > 1,
                     donate_state=not no_donate,
+                    coord_flags=self._coord,
                 )
             )
             # Eval batches are never stacked (no scan axis) — with
@@ -349,9 +419,21 @@ class Trainer:
         self._m_restarts = reg.counter(
             "trainer_fit_restarts_total",
             "fit_with_recovery auto-resumes after transient failures")
+        self._m_preempt_saves = reg.counter(
+            "trainer_preempt_saves_total",
+            "SIGTERM-triggered preemption checkpoints (coordinated across "
+            "all hosts under multi-process)")
+        self._g_agreed = reg.gauge(
+            "multihost_last_step_agreed",
+            "optimizer step of the newest completed cross-host flag "
+            "agreement round (coordination-channel liveness)")
         self._retry_policy = RetryPolicy(
             max_retries=config.dispatch_error_retries)
         self._bad_streak = 0
+        self._sigterm = False
+        self._pending_flags = None
+        self._agreed_preempt = False
+        self._coord_dispatch = 0
         self._last_val_metrics: Dict[str, float] = {}
         self._last_train_loss = float("nan")
 
@@ -503,6 +585,72 @@ class Trainer:
                 out["mfu"] = u
         return out
 
+    # -- multi-host coordination (resilience) --------------------------------
+
+    def _local_flags_array(self):
+        """This host's flag bitmask as its shard of the coordination vector
+        (one int32 per local device, all equal — see ``coord_flags_sharding``
+        for why the per-device layout is irrelevant)."""
+        bits = _PREEMPT_BIT if self._sigterm else 0
+        n = jax.local_device_count()
+        return jax.make_array_from_process_local_data(
+            self._train_step.coord_flags_sharding,
+            np.full((n,), bits, np.int32),
+            (jax.device_count(),),
+        )
+
+    def _dispatch(self, batch):
+        """One train dispatch; feeds the coordination flags when the
+        multi-host agreement channel is active."""
+        # chaos hook over the HOST-LOCAL batch: nan = one host's shard
+        # corrupted (its NaN rides the global loss reduction to every peer —
+        # the agreement drill), hang/slow = a wedged/throttled host
+        batch = faults.fire("trainer.collective", batch)
+        gb = self._to_global(batch)
+        if self._coord:
+            return self._train_step(self.state, gb, self._local_flags_array())
+        return self._train_step(self.state, gb)
+
+    def _note_coord(self, metrics: Metrics, step_i: int) -> None:
+        """Consume the agreed-flags output of THIS dispatch, and read the
+        one from the PREVIOUS dispatch (already complete, so the read never
+        waits on in-flight device work — though it IS one scalar fetch, a
+        host round-trip the ``coord_check_dispatches`` cadence amortizes on
+        dispatch-latency-bound transports). Every host runs this identical
+        deterministic schedule over identical device-agreed values, so
+        every host observes an agreed preemption at the same dispatch
+        boundary — ``coord_check_dispatches + 1`` dispatches after the
+        first host's SIGTERM at the latest."""
+        if not self._coord or metrics is None:
+            return
+        flags = metrics.pop("coord_flags", None)
+        prev, self._pending_flags = self._pending_flags, flags
+        self._coord_dispatch += 1
+        if prev is None or (
+                self._coord_dispatch % self.config.coord_check_dispatches):
+            return
+        agreed = int(jax.device_get(prev))
+        self._g_agreed.set(step_i)
+        if agreed & _PREEMPT_BIT:
+            self._agreed_preempt = True
+
+    def _preempt_save(self, step_i: int) -> None:
+        """The preemption checkpoint: save the CURRENT state to the
+        unconditional ``last/`` slot and flush logs. Under multi-process
+        every host reaches this at the SAME dispatch boundary (the agreed
+        flag is device-replicated), so the Orbax save's internal collectives
+        line up and every rank exits 0."""
+        self.checkpoints.save_last(step_i, self.state)
+        self._m_preempt_saves.inc()
+        obs.event("trainer_preempt_save", step=step_i,
+                  coordinated=self._coord)
+        self.logger.log_text(
+            "events", step_i,
+            f"SIGTERM: saved last/ checkpoint at step {step_i}"
+            + (" (coordinated across hosts)" if self._coord else ""),
+        )
+        self.logger.flush()
+
     # -- self-healing (resilience) -------------------------------------------
 
     def _ensure_rollback_target(self, step_i: int) -> None:
@@ -538,21 +686,30 @@ class Trainer:
         """One dispatch under the recovery config: transient-error retry with
         backoff, per-dispatch finite check, skip / rollback. Returns
         ``(status, metrics)`` with status ``'ok'`` (state advanced),
-        ``'skipped'`` (bad step discarded) or ``'rolled_back'`` (state
-        restored from checkpoint — the caller must re-read ``state.step``).
+        ``'skipped'`` (bad step discarded — the caller must re-read
+        ``state.step``, since a scanned window may have applied its good
+        sub-steps on device) or ``'rolled_back'`` (state restored from
+        checkpoint — same re-read contract).
 
         The ``float(loss)`` here is the recovery mode's per-dispatch host
         sync: it surfaces async dispatch errors INSIDE the retry scope and
         feeds the finite guard (the documented robustness/throughput trade).
+
+        The skip DECISION comes from two tiers: the device-agreed
+        ``bad_step`` flag (``make_guarded_step`` — the select already kept
+        the pre-step state on device, identically on every host), and — on a
+        single process only — the host-observed loss value, which catches
+        host-side corruption (the ``trainer.metrics`` chaos drills). Under
+        multiple processes the host-side observation deliberately does NOT
+        drive the decision: a per-host verdict on a per-host value is
+        exactly the program divergence that deadlocks collectives.
         """
         cfg = self.config
 
         def attempt():
             faults.inject("trainer.dispatch")  # chaos hook (no-op unless
             with profiling.annotate_step(step_i):  # an injector is live)
-                new_state, metrics = self._train_step(
-                    self.state, self._to_global(batch)
-                )
+                new_state, metrics = self._dispatch(batch)
             metrics = faults.corrupt("trainer.metrics", metrics)
             loss = float(metrics["loss"]) if "loss" in metrics else None
             return new_state, metrics, loss
@@ -571,8 +728,18 @@ class Trainer:
         new_state, metrics, loss = call_with_retry(
             attempt, policy=self._retry_policy, on_retry=on_retry
         )
-        if (cfg.skip_nonfinite_steps and loss is not None
-                and not np.isfinite(loss)):
+        self._note_coord(metrics, step_i)
+        flag = metrics.get("bad_step")
+        # int32 flag: immune to host-side NaN corruption of the metrics, and
+        # already the fleet-agreed verdict (see make_guarded_step)
+        device_bad = flag is not None and int(jax.device_get(flag)) > 0
+        host_bad = loss is not None and not np.isfinite(loss)
+        single = jax.process_count() == 1
+        if cfg.skip_nonfinite_steps and (device_bad or (host_bad and single)):
+            if device_bad:
+                # the device select already kept the pre-step state (and
+                # applied any good sub-steps of a scanned window) — adopt it
+                self.state = new_state
             self._bad_streak += 1
             self._m_bad_steps.inc()
             obs.event("trainer_bad_step", step=step_i, loss=str(loss),
@@ -743,6 +910,7 @@ class Trainer:
 
         window_start = time.perf_counter()
         window_steps = 0
+        seen_shapes: set = set()
         profiling_active = False
         profile_captured = False
         last_validated_step = step_i
@@ -753,22 +921,47 @@ class Trainer:
         # SIGTERM = preemption notice: finish the in-flight step, save the
         # newest state unconditionally, stop cleanly. The handler only sets a
         # flag — all real work happens on the main thread between steps.
-        # Single-process only: Orbax saves of mesh-sharded arrays are
-        # multi-host collectives, and hosts observe SIGTERM at different
-        # step boundaries — an unsynchronized save would deadlock. Multi-host
-        # preemption recovery is restart-from-checkpoint (--resume), which
-        # every host performs identically.
+        # Single-process: the flag is acted on directly at the next step
+        # boundary. Multi-process (coordination channel active): hosts
+        # observe SIGTERM at different step boundaries, and Orbax saves of
+        # mesh-sharded arrays are multi-host collectives — so the local flag
+        # only rides the next dispatch's agreement psum, and EVERY host acts
+        # on the agreed verdict at the same boundary (one coordinated
+        # save_last, every rank exits 0). Multi-process WITHOUT a mesh has
+        # no agreement channel: the handler stays uninstalled, and recovery
+        # is restart-the-world (--spawn_attempts / --resume).
         self._sigterm = False
+        self._pending_flags = None
+        self._agreed_preempt = False
+        self._coord_dispatch = 0
         handler_installed = False
         prev_handler = None
         if (cfg.checkpoint_on_sigterm
-                and jax.process_count() == 1
+                and (jax.process_count() == 1 or self._coord)
                 and threading.current_thread() is threading.main_thread()):
             def _on_sigterm(signum, frame):
                 self._sigterm = True
 
             prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
             handler_installed = True
+
+        # bounded-exit machinery (resilience/multihost.py): a per-step
+        # deadline on the dispatch cycle, and — multi-process — the KV-store
+        # peer-liveness monitor, so a surviving host never blocks past the
+        # configured window inside a collective whose peer died
+        step_guard = None
+        peer_monitor = None
+        if cfg.step_timeout_s:
+            from perceiver_io_tpu.resilience.multihost import StepDeadline
+
+            step_guard = StepDeadline("trainer_step", cfg.step_timeout_s)
+        if cfg.peer_heartbeat_s > 0 and jax.process_count() > 1:
+            from perceiver_io_tpu.resilience.multihost import (
+                PeerLivenessMonitor,
+            )
+
+            peer_monitor = PeerLivenessMonitor(
+                interval_s=cfg.peer_heartbeat_s).start()
 
         metrics: Metrics = {}
         try:
@@ -779,13 +972,12 @@ class Trainer:
                 batches_this_epoch = 0
                 for batch, ksteps in self._dispatch_batches(train_loader):
                     batches_this_epoch += 1
-                    if self._sigterm:
-                        self.checkpoints.save_last(step_i, self.state)
-                        self.logger.log_text(
-                            "events", step_i,
-                            f"SIGTERM: saved last/ checkpoint at step {step_i}",
-                        )
-                        self.logger.flush()
+                    # single-process: act on the local flag directly;
+                    # coordinated: only on the fleet-AGREED flag, which every
+                    # host observes at the same boundary
+                    if (self._agreed_preempt
+                            or (self._sigterm and not self._coord)):
+                        self._preempt_save(step_i)
                         done = True
                         break
                     if cfg.max_steps is not None:
@@ -809,8 +1001,22 @@ class Trainer:
                         profiling_active = True
                         profile_start = step_i
 
+                    # the first dispatch of every NEW batch-shape signature
+                    # carries a jit compile (tens of seconds on CPU, minutes
+                    # through a remote compiler — and width-bucketed loaders
+                    # introduce new shapes mid-run): the per-step deadline
+                    # only means something on already-compiled shapes; a
+                    # peer dead during a compile is the peer-liveness
+                    # monitor's catch
+                    sig = (ksteps,) + tuple(
+                        np.asarray(batch[k]).shape for k in self._keys)
+                    if step_guard is not None and sig in seen_shapes:
+                        step_guard.arm()
+                    seen_shapes.add(sig)
                     if cfg.recovery_active:
                         status, stepped = self._recovering_step(batch, step_i)
+                        if step_guard is not None:
+                            step_guard.disarm()  # the recovery path synced
                         if status == "rolled_back":
                             # the restored state's step is authoritative; the
                             # loader stream continues from its current
@@ -821,13 +1027,16 @@ class Trainer:
                             window_steps = 0
                             continue
                         if status == "skipped":
-                            continue  # state unchanged; batch consumed
+                            # batch consumed; a scanned window may still have
+                            # applied its good sub-steps on device — the
+                            # selected state's step is authoritative
+                            step_i = int(jax.device_get(self.state.step))
+                            continue
                         metrics = stepped
                     else:
                         with profiling.annotate_step(step_i):
-                            self.state, metrics = self._train_step(
-                                self.state, self._to_global(batch)
-                            )
+                            self.state, metrics = self._dispatch(batch)
+                        self._note_coord(metrics, step_i)
                     prev_step = step_i
                     step_i += ksteps
                     window_steps += ksteps
@@ -888,6 +1097,18 @@ class Trainer:
                         self.logger.log_scalars(step_i, host_metrics)
                         window_start, window_steps = now, 0
 
+                    if step_guard is not None:
+                        # DISARM (not beat) only now: the guard must cover
+                        # every host sync that can block on THIS dispatch —
+                        # _note_coord's pipelined flag read, the selfprof
+                        # tick, the log-boundary metric fetches — but not
+                        # the legitimately unbounded work past this point
+                        # (first-eval compiles, checkpoint saves). With no
+                        # sync this iteration the wedge is caught at the
+                        # next one that blocks (bounded by the log cadence
+                        # on the async fast path).
+                        step_guard.disarm()
+
                     ev = cfg.eval_every_n_steps
                     if ev and step_i // ev > prev_step // ev:
                         self._validate_and_checkpoint(step_i, val_loader)
@@ -904,7 +1125,8 @@ class Trainer:
                     if cfg.max_steps is not None and step_i >= cfg.max_steps:
                         done = True
                         break
-                if self._sigterm:
+                if (self._agreed_preempt
+                        or (self._sigterm and not self._coord)):
                     break
                 if batches_this_epoch == 0:
                     raise ValueError(
@@ -937,6 +1159,10 @@ class Trainer:
                 jax.profiler.stop_trace()
             if self._selfprof is not None:
                 self._selfprof.close()  # abort an open watchdog window
+            if step_guard is not None:
+                step_guard.close()
+            if peer_monitor is not None:
+                peer_monitor.close()
             if handler_installed:
                 # signal.signal returned None when the prior disposition was
                 # installed outside Python — restore the default, never leave
@@ -945,7 +1171,12 @@ class Trainer:
                     signal.SIGTERM,
                     prev_handler if prev_handler is not None else signal.SIG_DFL,
                 )
-        if step_i > last_validated_step and not self._sigterm:
+        # the final-interval guard must branch IDENTICALLY on every host:
+        # under coordination only the fleet-agreed preemption counts (the
+        # raw local flag is per-host and would diverge the final collectives)
+        preempted = self._agreed_preempt or (
+            self._sigterm and not self._coord)
+        if step_i > last_validated_step and not preempted:
             # final partial interval (eval_every_n_steps runs): don't lose the
             # tail — validate and give the checkpointer a shot at it
             if not np.isfinite(self._last_train_loss) and "loss" in metrics:

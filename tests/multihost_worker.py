@@ -5,6 +5,13 @@ Two CPU devices per process; ``jax.distributed`` over a localhost
 coordinator. Each rank writes a ``rank<R>.json`` with everything the test
 harness cross-checks, so assertions live in ONE place (the pytest side).
 
+``--phase recovery`` runs the r19 fault-tolerance drills instead of the
+base topology/fit battery: the PIT_FAULTS-driven NaN-agreement fit (rank 1
+corrupts its OWN batch shard; the psum-carried verdict must make both hosts
+skip the same step), the coordinated-SIGTERM preemption fit (only rank 1 is
+signalled; both ranks must save the same ``last/`` step and exit 0), and a
+real-KV peer-liveness round — reports land in ``rank<R>_recovery.json``.
+
 Not named test_* on purpose: pytest must not collect it.
 """
 
@@ -21,6 +28,8 @@ def main() -> None:
     parser.add_argument("--nprocs", type=int, required=True)
     parser.add_argument("--port", type=int, required=True)
     parser.add_argument("--workdir", required=True)
+    parser.add_argument("--phase", choices=("base", "recovery"),
+                        default="base")
     args = parser.parse_args()
 
     from perceiver_io_tpu.utils.platform import ensure_cpu_only
@@ -34,6 +43,10 @@ def main() -> None:
         num_processes=args.nprocs,
         process_id=args.rank,
     )
+
+    if args.phase == "recovery":
+        run_recovery(args)
+        return
 
     import jax
     import numpy as np
@@ -178,6 +191,124 @@ def main() -> None:
     with open(os.path.join(args.workdir, f"rank{args.rank}.json"), "w") as f:
         json.dump(out, f)
     print(f"rank {args.rank} done")
+
+
+def run_recovery(args) -> None:
+    """The r19 multi-host fault-tolerance drills (2 real processes)."""
+    import signal
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import perceiver_io_tpu.obs as obs
+    from perceiver_io_tpu.parallel import make_mesh
+    from perceiver_io_tpu.resilience import faults
+    from perceiver_io_tpu.resilience.multihost import PeerLivenessMonitor
+    from perceiver_io_tpu.training import TrainState
+    from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+    rank = jax.process_index()
+    out = {"process_index": rank, "process_count": jax.process_count()}
+    reg = obs.get_registry()
+    mesh = make_mesh()  # all 4 global devices on the data axis
+
+    def train_step(state, batch):
+        def loss_fn(params):
+            pred = batch["x"] @ params["w"]
+            return jnp.mean((pred - batch["y"]) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads), {"loss": loss}
+
+    # deterministic GLOBAL batches, identically generated on both hosts;
+    # each host feeds its own half (the per-host loader-shard contract)
+    rng = np.random.default_rng(0)
+    w_true = np.asarray([[1.0], [-2.0], [0.5]], np.float32)
+    half = 4
+
+    def local_batches(n):
+        out_batches = []
+        for _ in range(n):
+            x = rng.normal(0, 1, (2 * half, 3)).astype(np.float32)
+            y = x @ w_true
+            sl = slice(rank * half, (rank + 1) * half)
+            out_batches.append({"x": x[sl], "y": y[sl]})
+        return out_batches
+
+    def fresh_state():
+        return TrainState.create(
+            {"w": jnp.zeros((3, 1))}, optax.sgd(0.1), jax.random.key(0))
+
+    def cfg(run_name, **overrides):
+        kw = dict(
+            max_steps=6, log_every_n_steps=100,
+            logdir=os.path.join(args.workdir, "rlogs"), experiment=run_name,
+            use_tensorboard=False, compute_mfu=False, async_checkpoint=False,
+        )
+        kw.update(overrides)
+        return TrainerConfig(**kw)
+
+    # -- real-KV peer liveness: both hosts beat over the coordinator store --
+    peer_events = []
+    monitor = PeerLivenessMonitor(
+        interval_s=0.1, deadline_s=3.0,
+        on_peer_down=peer_events.append).start()
+
+    # -- drill A: NaN-agreement fit (PIT_FAULTS on rank 1 ONLY) -------------
+    bad0 = reg.counter("trainer_bad_steps_total").value
+    if rank == 1:
+        # corrupt THIS host's batch shard at the 3rd collective dispatch:
+        # its NaN rides the global loss psum, so the skip verdict must come
+        # back identically on BOTH hosts
+        faults.install(faults.parse_spec("trainer.collective:nan@3"))
+    trainer = Trainer(
+        train_step, None, fresh_state(),
+        cfg("agree", skip_nonfinite_steps=True, rollback_after_bad_steps=0),
+        example_batch=local_batches(1)[0], mesh=mesh,
+        run_dir=os.path.join(args.workdir, "agree_run"),
+    )
+    with trainer:
+        state = trainer.fit(local_batches(12))
+    faults.install(None)
+    out["agree_step"] = int(jax.device_get(state.step))
+    out["agree_bad_steps"] = (
+        reg.counter("trainer_bad_steps_total").value - bad0)
+    out["agree_w"] = np.asarray(
+        jax.device_get(state.params["w"])).ravel().tolist()
+    out["peer_events_mid"] = list(peer_events)
+    monitor.close()
+
+    # -- drill B: coordinated SIGTERM preemption (signal rank 1 ONLY) -------
+    class SigtermAt(list):
+        def __iter__(self):
+            for i, b in enumerate(list.__iter__(self)):
+                if i == 4 and rank == 1:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                yield b
+
+    saves0 = reg.counter("trainer_preempt_saves_total").value
+    preempt_dir = os.path.join(args.workdir, "preempt_run")
+    trainer2 = Trainer(
+        train_step, None, fresh_state(), cfg("preempt", max_steps=40),
+        example_batch=local_batches(1)[0], mesh=mesh, run_dir=preempt_dir,
+    )
+    with trainer2:
+        state2 = trainer2.fit(SigtermAt(local_batches(16)))
+    out["preempt_step"] = int(jax.device_get(state2.step))
+    out["preempt_saves"] = (
+        reg.counter("trainer_preempt_saves_total").value - saves0)
+    out["agreed_gauge"] = reg.gauge("multihost_last_step_agreed").value
+    last_dir = os.path.join(preempt_dir, "checkpoints", "last")
+    out["preempt_last_steps"] = sorted(
+        int(d) for d in os.listdir(last_dir) if d.isdigit()
+    ) if os.path.isdir(last_dir) else []
+
+    path = os.path.join(args.workdir, f"rank{args.rank}_recovery.json")
+    with open(path, "w") as f:
+        json.dump(out, f)
+    print(f"rank {args.rank} recovery done")
 
 
 if __name__ == "__main__":

@@ -41,6 +41,11 @@ def test_train_img_clf(tmp_path):
     assert os.path.isdir(os.path.join(run_dir, "checkpoints"))
 
 
+@pytest.mark.slow  # tier-1 budget (r19): hybrid ICI×DCN coverage stays
+# tier-1 in test_sharding.py (layout, validation, and
+# test_hybrid_dcn_mesh_matches_single_device numeric parity) and in the
+# 2-real-process granule check of test_multihost.py — this is the 20s
+# end-to-end CLI variant
 def test_train_mlm_hybrid_dcn_mesh(tmp_path):
     """--dcn_dp 2 --tp 2 trains end to end on the 8-device CPU mesh (the
     hybrid ICI×DCN layout is placement-only — the run must behave exactly
@@ -441,6 +446,11 @@ def test_quant_bench_cpu_emits_one_json_line(tmp_path):
     assert 0 < result["predicted_weight_stream_ratio"] < 1, result
 
 
+@pytest.mark.slow  # tier-1 budget (r19): the executable-cache tier keeps
+# its full tier-1 suite (test_aot_cache.py: warm-start bit-identity,
+# corruption fallback, fail-soft open); this 20s subprocess variant covers
+# the jax persistent-cache tier behind --compile_cache, whose enable path
+# is fail-soft config plumbing
 def test_train_cli_compile_cache_persists_step_compiles(tmp_path):
     """--compile_cache on a train CLI (tier 2: jax's persistent compilation
     cache) populates the directory with the step's compiled entries and the
@@ -860,6 +870,11 @@ def test_train_mlm_zero3(tmp_path):
     assert losses and np.isfinite(losses).all()
 
 
+@pytest.mark.slow  # tier-1 budget (r19): resume determinism stays tier-1 in
+# test_trainer.py::test_resume_fast_forwards_data_stream +
+# test_cli_resume_continues_run, and the bucket×K grouped-emission
+# contract in test_data.py's group_widths/group_size units — this is the
+# 30s full-CLI composition of both
 def test_bucketed_stacked_resume_is_bit_for_bit(tmp_path):
     """Deterministic resume survives the r4 composition: with width buckets
     AND steps_per_dispatch=2 active, a run STOPPED at step 4 (end-of-run

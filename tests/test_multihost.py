@@ -9,6 +9,7 @@ global mesh) over a localhost coordinator and cross-checks their reports.
 
 import json
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -171,6 +172,232 @@ def test_spawn_hosts_buckets_and_multi_step_dispatch(tmp_path):
     )
     assert proc.returncode == 0, tail
     assert losses and np.isfinite(losses).all(), tail
+
+
+# -- r19: multi-host training fault tolerance ---------------------------------
+
+
+@pytest.fixture(scope="module")
+def recovery_reports(tmp_path_factory):
+    """Two real jax.distributed CPU processes through the r19 recovery
+    drills (multihost_worker.py --phase recovery). Only slow-marked tests
+    consume this, so tier-1 wall is untouched — the in-process agreement /
+    preemption / bounded-exit units live in tests/test_multihost_recovery.py.
+    """
+    workdir = tmp_path_factory.mktemp("multihost_recovery")
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, "--rank", str(r), "--nprocs", "2",
+             "--port", str(port), "--workdir", str(workdir),
+             "--phase", "recovery"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in range(2)
+    ]
+    outs = [p.communicate(timeout=600)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"recovery worker failed:\n{out[-4000:]}"
+    loaded = []
+    for r in range(2):
+        with open(workdir / f"rank{r}_recovery.json") as f:
+            loaded.append(json.load(f))
+    return workdir, loaded
+
+
+@pytest.mark.slow  # 2-process cluster drill; tier-1 keeps the agreement
+# math + device-side skip units in tests/test_multihost_recovery.py
+def test_nan_on_one_host_skips_same_step_on_both(recovery_reports):
+    """The psum-agreement acceptance drill: PIT_FAULTS corrupts ONE host's
+    batch shard, and BOTH hosts must skip the same step — bit-identical
+    final params, identical skip counts, identical step counters."""
+    _, (r0, r1) = recovery_reports
+    assert r0["agree_bad_steps"] == r1["agree_bad_steps"] == 1
+    assert r0["agree_step"] == r1["agree_step"] == 6
+    assert r0["agree_w"] == r1["agree_w"]  # bit-identical trajectories
+    assert all(abs(w) > 0 for w in r0["agree_w"])  # it actually trained
+
+
+@pytest.mark.slow  # 2-process cluster drill; tier-1 keeps the coordinated
+# preemption plumbing unit (force_coordination) in test_multihost_recovery.py
+def test_sigterm_on_one_host_coordinates_save_on_all(recovery_reports):
+    """SIGTERM lands on rank 1 ONLY; the agreement channel must carry the
+    preemption to rank 0, every rank saves the SAME last/ step, counts one
+    preempt save, and exits 0 (the fixture already asserted return codes)."""
+    _, (r0, r1) = recovery_reports
+    assert r0["preempt_step"] == r1["preempt_step"] > 0
+    assert r0["preempt_step"] < 40  # stopped well before the schedule end
+    assert r0["preempt_saves"] == r1["preempt_saves"] == 1
+    assert r0["preempt_last_steps"] == r1["preempt_last_steps"] \
+        == [r0["preempt_step"]]
+    # the KV peer-liveness round saw both hosts alive throughout drill A
+    assert r0["peer_events_mid"] == r1["peer_events_mid"] == []
+
+
+_DRILL_MODULE = None
+
+
+def _drill_helpers():
+    """The chaos-drill plumbing (pid-of-rank /proc scan, poll-until,
+    metrics.jsonl merge) lives in tools/multihost_drill.py — ONE source, so
+    the measured drill and these pinned tests can never scan different
+    things. Loaded lazily (only the slow drills pay the import) and ONCE
+    (the wrappers run inside 50 ms poll loops)."""
+    global _DRILL_MODULE
+    if _DRILL_MODULE is None:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "multihost_drill",
+            os.path.join(REPO, "tools", "multihost_drill.py"))
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        _DRILL_MODULE = module
+    return _DRILL_MODULE
+
+
+def _find_spawned_rank_pid(rank: int):
+    return _drill_helpers()._pid_of_rank(rank)
+
+
+def _wait_for(predicate, timeout_s, poll_s=0.05):
+    return _drill_helpers().wait_for(predicate, timeout_s, poll_s)
+
+
+def _read_losses(logdir):
+    return _drill_helpers()._losses(str(logdir))
+
+
+_TINY_MLM = [
+    "--synthetic", "--synthetic_size", "64", "--batch_size", "16",
+    "--max_seq_len", "32", "--vocab_size", "90", "--num_latents", "8",
+    "--num_latent_channels", "16", "--num_encoder_layers", "2",
+    "--num_self_attention_layers_per_block", "1",
+    "--num_cross_attention_heads", "2", "--num_self_attention_heads", "2",
+    "--dtype", "float32", "--log_every_n_steps", "1",
+]
+
+
+def _spawned_mlm_cmd(tmp_path, extra):
+    return [sys.executable, os.path.join(REPO, "train", "train_mlm.py"),
+            "--spawn_hosts", "2", *_TINY_MLM,
+            "--logdir", str(tmp_path / "logs"),
+            "--root", str(tmp_path / "cache"), *extra]
+
+
+@pytest.mark.slow  # full-stack chaos drill (kill -9 + world restart ≈ two
+# spawned cluster runs); the supervisor policy itself is tier-1 with fake
+# children in tests/test_multihost_recovery.py
+def test_spawn_supervisor_restarts_world_after_kill9(tmp_path):
+    """Kill -9 one of two spawned hosts mid-fit: the supervisor kills the
+    world, relaunches all ranks with --resume from the newest checkpoint,
+    the job completes with exit 0, and the final loss trajectory matches an
+    uninterrupted run at checkpoint granularity."""
+    import numpy as np
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # throttle steps so the kill window after the first checkpoint is wide
+    env["PIT_FAULTS"] = "trainer.collective:slow@every:1@delay:0.4"
+    schedule = ["--max_steps", "10", "--eval_every_n_steps", "2",
+                "--max_to_keep", "3", "--step_timeout_s", "8"]
+
+    # the uninterrupted reference (same seed, same schedule, no kill)
+    ref = subprocess.run(
+        _spawned_mlm_cmd(tmp_path / "ref", schedule),
+        env=env, capture_output=True, text=True, timeout=600)
+    assert ref.returncode == 0, (ref.stdout + ref.stderr)[-4000:]
+    ref_losses = _read_losses(tmp_path / "ref" / "logs")
+    assert set(ref_losses) == set(range(1, 11))
+
+    proc = subprocess.Popen(
+        _spawned_mlm_cmd(tmp_path / "chaos", schedule
+                         + ["--spawn_attempts", "3"]),
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        # wait for a COMMITTED checkpoint through the supervisor's own
+        # scanner (an in-flight orbax tmp dir must not count — the drill
+        # needs the restart to actually resume)
+        from perceiver_io_tpu.cli.common import _newest_resumable_run
+
+        committed = _wait_for(
+            lambda: _newest_resumable_run(
+                str(tmp_path / "chaos" / "logs"), "mlm"),
+            timeout_s=240)
+        assert committed, "no checkpoint committed before the kill window"
+        victim = _wait_for(lambda: _find_spawned_rank_pid(1), timeout_s=30)
+        assert victim, "spawned rank-1 process not found"
+        os.kill(victim, signal.SIGKILL)
+        out, err = proc.communicate(timeout=480)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, (out + err)[-4000:]
+    assert "restarting all 2 hosts" in err, err[-4000:]
+    assert "--resume" in err, err[-4000:]
+    chaos_losses = _read_losses(tmp_path / "chaos" / "logs")
+    assert set(chaos_losses) >= set(range(1, 11)), sorted(chaos_losses)
+    # checkpoint-granularity trajectory parity: every step's (final) loss
+    # matches the uninterrupted run — the resumed world replayed the exact
+    # batches the dead one would have seen (deterministic resume). rtol:
+    # null-controlled clean repros are BIT-identical, but loaded
+    # multi-process CPU runs occasionally show reassociation-order drift in
+    # the cross-host reductions (measured ≤2.5e-4 relative over 10 steps);
+    # a wrong-checkpoint resume or a skipped batch moves losses by >>1e-2
+    for step in sorted(ref_losses):
+        np.testing.assert_allclose(
+            chaos_losses[step], ref_losses[step], rtol=1e-3,
+            err_msg=f"step {step} diverged after the world restart")
+
+
+@pytest.mark.slow  # full-stack preemption drill (spawned cluster + resume
+# run); the coordinated-save plumbing is tier-1 in test_multihost_recovery.py
+def test_spawn_sigterm_preempts_cleanly_and_resumes(tmp_path):
+    """SIGTERM one spawned host mid-fit: the preemption is agreed cross-host,
+    every rank saves and exits 0 (launcher exit 0, no restart), and --resume
+    continues from the preemption step to schedule end."""
+    import numpy as np
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PIT_FAULTS"] = "trainer.collective:slow@every:1@delay:0.4"
+    schedule = ["--max_steps", "12"]
+    proc = subprocess.Popen(
+        _spawned_mlm_cmd(tmp_path, schedule),
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        logdir = tmp_path / "logs"
+        # wait until training is demonstrably underway on rank 0
+        started = _wait_for(
+            lambda: len(_read_losses(logdir)) >= 2, timeout_s=240)
+        assert started, "training never produced metrics rows"
+        victim = _wait_for(lambda: _find_spawned_rank_pid(1), timeout_s=30)
+        assert victim, "spawned rank-1 process not found"
+        os.kill(victim, signal.SIGTERM)
+        out, err = proc.communicate(timeout=480)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, (out + err)[-4000:]
+    assert "restarting" not in err  # a clean preemption is NOT a failure
+    losses = _read_losses(logdir)
+    preempt_step = max(losses)
+    assert preempt_step < 12, "run completed before the preemption landed"
+    run_dir = sorted(logdir.glob("mlm/version_*"))[0]
+    last = run_dir / "checkpoints" / "last" / str(preempt_step)
+    assert last.is_dir(), f"no coordinated last/ save at {preempt_step}"
+
+    resumed = subprocess.run(
+        _spawned_mlm_cmd(tmp_path, schedule + ["--resume", str(run_dir)]),
+        env=env, capture_output=True, text=True, timeout=600)
+    assert resumed.returncode == 0, (resumed.stdout + resumed.stderr)[-4000:]
+    final = _read_losses(logdir)
+    assert set(final) >= set(range(preempt_step, 13)) - {0}
+    assert max(final) == 12
+    assert np.isfinite(list(final.values())).all()
 
 
 @pytest.mark.slow  # deep spawn variant (slow, like all spawn tests);

@@ -1,0 +1,513 @@
+"""Tier-1 units for the multi-host training fault-tolerance layer (r19).
+
+In-process, single-controller coverage of every policy the 2-process drills
+exercise end to end (tests/test_multihost.py keeps the real-cluster and
+kill/SIGTERM chaos versions behind slow marks):
+
+- the device-side collective-consistent bad-step guard
+  (``training/steps.py make_guarded_step``) and its scanned-window reduce;
+- the coordination-flags agreement channel
+  (``parallel/sharding.py coord_flags_sharding``) and the trainer's
+  SIGTERM-preemption plumbing over it (``force_coordination``);
+- bounded-exit detection (``resilience/multihost.py``): the per-step
+  deadline against a wedged dispatch (the wedged-peer fixture) and the
+  KV-store peer-liveness monitor;
+- the restart-the-world supervisor (``cli/common.py WorldSupervisor``)
+  against fake children: restart + resume wiring, attempt budget, backoff,
+  crash-loop detach, and the ``spawn.child_exit`` chaos site.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import perceiver_io_tpu.obs as obs
+from perceiver_io_tpu.resilience import faults
+from perceiver_io_tpu.resilience.multihost import (
+    InMemoryKV,
+    PeerLivenessMonitor,
+    StepDeadline,
+)
+from perceiver_io_tpu.training import TrainState
+from perceiver_io_tpu.training.steps import (
+    make_guarded_step,
+    make_scanned_step,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    previous = faults.install(None)
+    yield
+    faults.install(previous)
+
+
+def _toy_step():
+    def train_step(state, batch):
+        def loss_fn(params):
+            pred = batch["x"] @ params["w"]
+            return jnp.mean((pred - batch["y"]) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads), {"loss": loss}
+
+    return train_step
+
+
+def _toy_state():
+    return TrainState.create(
+        {"w": jnp.zeros((3, 1))}, optax.sgd(0.1), jax.random.key(0))
+
+
+def _toy_batch(n=4, bad=False):
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (n, 3)).astype(np.float32)
+    if bad:
+        x = np.full_like(x, np.nan)
+    return {"x": x, "y": (x @ np.asarray([[1.0], [-2.0], [0.5]], np.float32))}
+
+
+# -- the guarded step: device-side skip ---------------------------------------
+
+
+def test_guarded_step_skips_nonfinite_on_device():
+    """A NaN loss keeps EVERY pre-step leaf (params, opt_state, step, rng)
+    via the on-device select and raises the int32 bad_step flag; a finite
+    loss advances normally with the flag down."""
+    step = jax.jit(make_guarded_step(_toy_step()))
+    state = _toy_state()
+
+    good, metrics = step(state, _toy_batch())
+    assert int(metrics["bad_step"]) == 0
+    assert int(jax.device_get(good.step)) == 1
+
+    kept, metrics = step(good, _toy_batch(bad=True))
+    assert int(metrics["bad_step"]) == 1
+    assert not np.isfinite(float(metrics["loss"]))
+    assert int(jax.device_get(kept.step)) == 1  # not advanced
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(kept.params["w"])),
+        np.asarray(jax.device_get(good.params["w"])),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(kept.rng)),
+        np.asarray(jax.random.key_data(good.rng)),
+    )
+
+
+def test_guarded_step_under_scan_applies_good_substeps_only():
+    """Guarded-inside-scanned: a bad mid-window sub-step is skipped while
+    its neighbors apply, and the integer window-MAX reduce keeps the flag
+    visible (a float mean or last-value reduce would mask it)."""
+    step = jax.jit(make_scanned_step(make_guarded_step(_toy_step())))
+    state = _toy_state()
+    g, b = _toy_batch(), _toy_batch(bad=True)
+    stacked = {k: np.stack([g[k], b[k], g[k]]) for k in g}
+    out, metrics = step(state, stacked)
+    assert int(metrics["bad_step"]) == 1
+    assert int(jax.device_get(out.step)) == 2  # 2 of 3 sub-steps applied
+
+
+# -- the coordination channel -------------------------------------------------
+
+
+def test_coord_flags_agreement_rides_the_step():
+    """make_sharded_train_step(coord_flags=True): the per-device flag vector
+    reduces to the fleet-wide OR inside the dispatch and comes back
+    replicated — one raised element anywhere flips the agreed scalar."""
+    from perceiver_io_tpu.parallel import make_mesh
+    from perceiver_io_tpu.parallel.sharding import make_sharded_train_step
+
+    mesh = make_mesh()
+    n = mesh.size
+    batch = _toy_batch(n=2 * n)
+    step, sstate, _ = make_sharded_train_step(
+        _toy_step(), mesh, _toy_state(), batch,
+        donate_state=False, coord_flags=True,
+    )
+    sh = step.coord_flags_sharding
+    assert sh is not None
+
+    def flags(vec):
+        return jax.make_array_from_process_local_data(
+            sh, np.asarray(vec, np.int32), (n,))
+
+    _, metrics = step(sstate, batch, flags([0] * n))
+    assert int(jax.device_get(metrics["coord_flags"])) == 0
+    one_hot = [0] * n
+    one_hot[n // 2] = 1
+    _, metrics = step(sstate, batch, flags(one_hot))
+    assert int(jax.device_get(metrics["coord_flags"])) == 1
+    # a real bitwise OR, not a max: DIFFERENT bits from different hosts
+    # must both survive (a max would return 2 here and drop bit 0)
+    mixed = [0] * n
+    mixed[0], mixed[-1] = 1, 2
+    _, metrics = step(sstate, batch, flags(mixed))
+    assert int(jax.device_get(metrics["coord_flags"])) == 3
+
+
+def test_trainer_coordinated_sigterm_preempt_save(tmp_path):
+    """SIGTERM plumbing through the agreement channel (force_coordination:
+    the single-controller harness for the multi-host path): the local flag
+    rides the next dispatch, the AGREED verdict is acted on at a step
+    boundary — save_last + preempt counter + agreed gauge — and the run
+    stops cleanly well before max_steps."""
+    from perceiver_io_tpu.parallel import make_mesh
+    from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+    rng = np.random.default_rng(1)
+    w_true = np.asarray([[1.0], [-2.0], [0.5]], np.float32)
+    batches = []
+    for _ in range(16):
+        x = rng.normal(0, 1, (8, 3)).astype(np.float32)
+        batches.append({"x": x, "y": x @ w_true})
+
+    class SigtermAt(list):
+        def __iter__(self):
+            for i, b in enumerate(list.__iter__(self)):
+                if i == 3:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                yield b
+
+    saves0 = obs.get_registry().counter("trainer_preempt_saves_total").value
+    cfg = TrainerConfig(
+        max_steps=16, log_every_n_steps=100, logdir=str(tmp_path),
+        experiment="coord", use_tensorboard=False, compute_mfu=False,
+        async_checkpoint=False, force_coordination=True,
+    )
+    trainer = Trainer(_toy_step(), None, _toy_state(), cfg,
+                      example_batch=batches[0], mesh=make_mesh())
+    with trainer:
+        state = trainer.fit(SigtermAt(batches))
+    final = int(jax.device_get(state.step))
+    # signal lands before dispatch 4; the flag rides dispatch 4 and the
+    # agreement is read after dispatch 5 — stop at the boundary after that
+    assert 4 <= final <= 6
+    assert final < 16
+    reg = obs.get_registry()
+    assert reg.counter("trainer_preempt_saves_total").value == saves0 + 1
+    assert reg.gauge("multihost_last_step_agreed").value >= final - 1
+    last = os.path.join(trainer.run_dir, "checkpoints", "last", str(final))
+    assert os.path.isdir(last)
+    # the default disposition came back after fit()
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+
+
+def test_trainer_multiprocess_gates(monkeypatch, tmp_path):
+    """dispatch retries / fit attempts stay single-process-only; meshless
+    skip_nonfinite_steps under multiple processes is refused (no collective
+    to agree over)."""
+    from perceiver_io_tpu.training.trainer import Trainer, TrainerConfig
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    base = dict(max_steps=4, logdir=str(tmp_path), use_tensorboard=False)
+    with pytest.raises(ValueError, match="single-process only"):
+        Trainer(_toy_step(), None, _toy_state(),
+                TrainerConfig(dispatch_error_retries=2, **base),
+                example_batch=_toy_batch())
+    with pytest.raises(ValueError, match="single-process only"):
+        Trainer(_toy_step(), None, _toy_state(),
+                TrainerConfig(fit_attempts=2, **base),
+                example_batch=_toy_batch())
+    with pytest.raises(ValueError, match="needs a mesh"):
+        Trainer(_toy_step(), None, _toy_state(),
+                TrainerConfig(skip_nonfinite_steps=True, **base),
+                example_batch=_toy_batch())
+
+
+# -- bounded-exit detection ---------------------------------------------------
+
+
+def test_step_deadline_fires_within_bounded_window():
+    """The wedged-peer fixture: a dispatch that never completes expires the
+    per-step deadline within the configured window — once — and a beat
+    before the deadline keeps it quiet."""
+    fired = []
+    guard = StepDeadline("t_wedge", 0.3, on_expire=lambda: fired.append(
+        time.monotonic()))
+    try:
+        armed_at = time.monotonic()
+        guard.arm()
+        deadline = time.monotonic() + 3.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert fired, "deadline never fired on a wedged dispatch"
+        waited = fired[0] - armed_at
+        assert 0.3 <= waited < 1.5  # bounded: deadline + monitor cadence
+        time.sleep(0.5)
+        assert len(fired) == 1  # once per wedge, not per poll
+    finally:
+        guard.close()
+
+    quiet = StepDeadline("t_live", 0.4, on_expire=lambda: fired.append(None))
+    try:
+        quiet.arm()
+        for _ in range(6):
+            time.sleep(0.1)
+            quiet.beat()
+        assert len(fired) == 1  # no new firings while beating
+    finally:
+        quiet.close()
+
+
+def test_peer_liveness_monitor_detects_dead_peer():
+    """Two monitors over one shared KV: while both beat, no peer is down;
+    when one stops beating, the survivor declares it dead within the
+    deadline and bumps multihost_peer_down_total."""
+    kv = InMemoryKV()
+    down = []
+    down0 = obs.get_registry().counter("multihost_peer_down_total").value
+    a = PeerLivenessMonitor(
+        process_id=0, num_processes=2, kv=kv, interval_s=0.05,
+        deadline_s=0.4, on_peer_down=down.append).start()
+    b = PeerLivenessMonitor(
+        process_id=1, num_processes=2, kv=kv, interval_s=0.05,
+        deadline_s=0.4, on_peer_down=down.append).start()
+    try:
+        time.sleep(0.4)
+        assert not down and a.peers_down() == () and b.peers_down() == ()
+        b.close()  # peer 1 dies silently
+        deadline = time.monotonic() + 3.0
+        while not down and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert down == [1]
+        assert a.peers_down() == (1,)
+        assert (obs.get_registry().counter("multihost_peer_down_total").value
+                == down0 + 1)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_peer_liveness_heartbeat_fault_site():
+    """PIT_FAULTS-driven liveness drill: a hang injected at
+    multihost.heartbeat freezes one monitor's publisher, so its PEER marks
+    it down — no process killed."""
+    kv = InMemoryKV()
+    down = []
+    a = PeerLivenessMonitor(
+        process_id=0, num_processes=2, kv=kv, interval_s=0.05,
+        deadline_s=0.4, on_peer_down=down.append).start()
+    release = threading.Event()
+    # b's publisher wedges on its 3rd beat round (site counters are
+    # process-global: rounds 1-2 are a's startup beats)
+    injector = faults.FaultInjector([faults.FaultSpec(
+        site="multihost.heartbeat", kind="hang", every=1, release=release)])
+    b = PeerLivenessMonitor(
+        process_id=1, num_processes=2, kv=kv, interval_s=0.05,
+        deadline_s=0.4, on_peer_down=down.append)
+    faults.install(injector)
+    b.start()
+    try:
+        deadline = time.monotonic() + 3.0
+        while 1 not in down and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert 1 in down  # a declared the frozen b dead
+    finally:
+        release.set()
+        faults.install(None)
+        a.close()
+        b.close()
+
+
+def test_peer_liveness_kv_failure_escalates():
+    """Transient KV errors are tolerated and counted; past the consecutive
+    limit the coordinator itself is presumed gone — peer -1 down."""
+
+    class FlakyKV(InMemoryKV):
+        def __init__(self):
+            super().__init__()
+            self.fail = False
+
+        def key_value_set(self, key, value, allow_overwrite=False):
+            if self.fail:
+                raise ConnectionResetError("coordinator gone")
+            super().key_value_set(key, value, allow_overwrite)
+
+    kv = FlakyKV()
+    down = []
+    m = PeerLivenessMonitor(
+        process_id=0, num_processes=1, kv=kv, interval_s=0.02,
+        deadline_s=5.0, kv_failure_limit=3, on_peer_down=down.append)
+    m._beat_once()
+    assert m.kv_failures() == 0
+    kv.fail = True
+    m._beat_once()
+    m._beat_once()
+    assert m.kv_failures() == 2 and not down
+    m._beat_once()
+    assert down == [-1]
+
+
+def test_fault_sites_registered():
+    for site in ("trainer.collective", "multihost.heartbeat",
+                 "spawn.child_exit"):
+        assert faults.validate_site(site) == site
+    # and the grammar accepts drill specs against them
+    inj = faults.parse_spec(
+        "trainer.collective:nan@3;spawn.child_exit:transient@1")
+    assert inj is not None
+
+
+# -- the restart-the-world supervisor -----------------------------------------
+
+
+class FakeChild:
+    """A scripted child: exits with ``rc`` after ``after_polls`` polls
+    (None = runs forever until terminated)."""
+
+    def __init__(self, rc=0, after_polls=0):
+        self.rc = rc
+        self.after = after_polls
+        self.polls = 0
+        self.terminated = False
+        self.killed = False
+
+    def poll(self):
+        if self.terminated or self.killed:
+            return self.rc if self.rc is not None else -15
+        self.polls += 1
+        if self.after is not None and self.polls > self.after:
+            return self.rc
+        return None
+
+    def terminate(self):
+        self.terminated = True
+
+    def kill(self):
+        self.killed = True
+
+    def wait(self, timeout=None):
+        return self.poll()
+
+
+def _supervisor(worlds, **kw):
+    """A WorldSupervisor over a script of fake worlds: each entry is a list
+    of FakeChild. Returns (supervisor, launches[], sleeps[])."""
+    from perceiver_io_tpu.cli.common import WorldSupervisor
+
+    launches, sleeps = [], []
+    script = iter(worlds)
+
+    def launch(resume_dir):
+        launches.append(resume_dir)
+        return next(script), [None, None]
+
+    kw.setdefault("poll_s", 0.0)
+    sup = WorldSupervisor(
+        launch=launch, n=2, sleep=sleeps.append, **kw)
+    return sup, launches, sleeps
+
+
+def test_supervisor_success_needs_no_restart():
+    sup, launches, sleeps = _supervisor(
+        [[FakeChild(0), FakeChild(0)]], attempts=3)
+    sup.run()
+    assert launches == [None] and sleeps == []
+
+
+def test_supervisor_restarts_world_with_resume_and_backoff(tmp_path):
+    """First world dies (one child rc=-9) → the survivors are reaped, the
+    counter and backoff actuate, and the relaunch carries the newest
+    resumable run dir; second world completes."""
+    restarts0 = obs.get_registry().counter("spawn_world_restarts_total").value
+    survivor = FakeChild(0, after_polls=None)
+    worlds = [[FakeChild(-9, after_polls=2), survivor],
+              [FakeChild(0), FakeChild(0)]]
+    sup, launches, sleeps = _supervisor(
+        worlds, attempts=3, find_resume=lambda: str(tmp_path / "version_1"))
+    # defeat the crash-loop detector: fakes fail instantly by construction
+    import perceiver_io_tpu.cli.common as common
+
+    orig = common._CRASHLOOP_WINDOW_S
+    common._CRASHLOOP_WINDOW_S = -1.0
+    try:
+        sup.run()
+    finally:
+        common._CRASHLOOP_WINDOW_S = orig
+    assert launches == [None, str(tmp_path / "version_1")]
+    assert survivor.terminated  # the world is killed as a unit
+    assert len(sleeps) == 1 and sleeps[0] > 0
+    assert (obs.get_registry().counter("spawn_world_restarts_total").value
+            == restarts0 + 1)
+
+
+def test_supervisor_attempt_budget_exhausted_raises():
+    import perceiver_io_tpu.cli.common as common
+
+    worlds = [[FakeChild(3), FakeChild(0)] for _ in range(2)]
+    sup, launches, _ = _supervisor(worlds, attempts=2)
+    orig = common._CRASHLOOP_WINDOW_S
+    common._CRASHLOOP_WINDOW_S = -1.0
+    try:
+        with pytest.raises(SystemExit) as exc:
+            sup.run()
+    finally:
+        common._CRASHLOOP_WINDOW_S = orig
+    assert exc.value.code == 3
+    assert len(launches) == 2
+
+
+def test_supervisor_crash_loop_detaches_early():
+    """Consecutive instant failures detach after _CRASHLOOP_LIMIT worlds
+    even with attempts left — a deterministic failure must not burn the
+    budget at backoff cadence."""
+    import perceiver_io_tpu.cli.common as common
+
+    worlds = [[FakeChild(7), FakeChild(0)] for _ in range(10)]
+    sup, launches, _ = _supervisor(worlds, attempts=10)
+    with pytest.raises(SystemExit) as exc:
+        sup.run()
+    assert exc.value.code == 7
+    assert len(launches) == common._CRASHLOOP_LIMIT
+
+
+def test_supervisor_child_exit_fault_site_restarts():
+    """PIT_FAULTS drill: an injected raise at spawn.child_exit is treated as
+    an observed child death — the world restarts without any real kill."""
+    import perceiver_io_tpu.cli.common as common
+
+    faults.install(faults.parse_spec("spawn.child_exit:transient@1"))
+    first_world = [FakeChild(0, after_polls=None),
+                   FakeChild(0, after_polls=None)]
+    worlds = [first_world, [FakeChild(0), FakeChild(0)]]
+    sup, launches, _ = _supervisor(worlds, attempts=3)
+    orig = common._CRASHLOOP_WINDOW_S
+    common._CRASHLOOP_WINDOW_S = -1.0
+    try:
+        sup.run()
+    finally:
+        common._CRASHLOOP_WINDOW_S = orig
+    assert len(launches) == 2
+    assert all(c.terminated for c in first_world)
+
+
+def test_newest_resumable_run_scans_committed_checkpoints(tmp_path):
+    from perceiver_io_tpu.cli.common import _newest_resumable_run
+
+    assert _newest_resumable_run(str(tmp_path), "exp") is None
+    base = tmp_path / "exp"
+    # version_0: committed step; version_2: hparams but no committed step;
+    # version_1: last/-slot commit only
+    v0 = base / "version_0" / "checkpoints"
+    (v0 / "4").mkdir(parents=True)
+    (v0 / "hparams.json").write_text("{}")
+    (v0 / "4" / "_CHECKPOINT_METADATA").write_text("{}")
+    assert _newest_resumable_run(str(tmp_path), "exp") == str(base / "version_0")
+    v1 = base / "version_1" / "checkpoints"
+    (v1 / "last" / "7").mkdir(parents=True)
+    (v1 / "hparams.json").write_text("{}")
+    (v1 / "last" / "7" / "_CHECKPOINT_METADATA").write_text("{}")
+    assert _newest_resumable_run(str(tmp_path), "exp") == str(base / "version_1")
+    v2 = base / "version_2" / "checkpoints"
+    v2.mkdir(parents=True)
+    (v2 / "hparams.json").write_text("{}")
+    # newest dir is not resumable — fall back to the newest one that is
+    assert _newest_resumable_run(str(tmp_path), "exp") == str(base / "version_1")

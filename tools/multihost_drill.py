@@ -1,0 +1,178 @@
+"""Measure the restart-the-world recovery wall on the 2-process CPU sim.
+
+The r19 chaos drill, instrumented: launch a supervised ``--spawn_hosts 2``
+MLM run, SIGKILL one rank after the first committed checkpoint, and time
+every phase of the recovery the supervisor performs — detection (child
+death observed), teardown (surviving world reaped), relaunch, and
+back-to-training (first post-restart metrics row). One JSON line on
+stdout; progress on stderr (PIT-CONTRACT).
+
+The numbers feed PERF.md §Multi-host recovery. They are CPU-sim walls —
+dominated by the jit re-compile of the restarted world (a real pod with a
+persistent compilation cache pays the restore + data fast-forward only) —
+but the PHASE STRUCTURE is the product being measured: how long a child
+death leaves the fleet idle before training resumes, with no human in the
+loop.
+
+Usage::
+
+    python tools/multihost_drill.py [--steps 10] [--delay 0.4]
+        [--workdir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from perceiver_io_tpu.utils.jsonline import emit_json_line  # noqa: E402
+
+
+def _pid_of_rank(rank: int, marker: str = "train_mlm"):
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                argv = f.read().decode(errors="replace").split("\0")
+        except OSError:
+            continue
+        if (marker in " ".join(argv) and "--process_id" in argv
+                and argv[argv.index("--process_id") + 1] == str(rank)):
+            return int(pid)
+    return None
+
+
+def _losses(logdir: str):
+    """Per-step train_loss across every version dir, last write wins (a
+    resumed run appends into the same metrics.jsonl)."""
+    import glob
+
+    rows = {}
+    for path in sorted(glob.glob(
+            os.path.join(logdir, "mlm", "version_*", "metrics.jsonl"))):
+        with open(path) as f:
+            for line in f:
+                row = json.loads(line)
+                if "train_loss" in row:
+                    rows[row["step"]] = row["train_loss"]
+    return rows
+
+
+def wait_for(predicate, timeout_s, poll_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(poll_s)
+    return None
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--steps", type=int, default=10)
+    parser.add_argument("--delay", type=float, default=0.4,
+                        help="injected per-step throttle (widens the kill "
+                             "window; subtracted from nothing — the recovery "
+                             "phases measured are step-rate independent)")
+    parser.add_argument("--workdir", default=None)
+    parser.add_argument("--step_timeout_s", type=float, default=8.0)
+    args = parser.parse_args(argv)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="multihost_drill_")
+    logdir = os.path.join(workdir, "logs")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PIT_FAULTS"] = (
+        f"trainer.collective:slow@every:1@delay:{args.delay}")
+    cmd = [
+        sys.executable, os.path.join(REPO, "train", "train_mlm.py"),
+        "--spawn_hosts", "2", "--spawn_attempts", "3",
+        "--synthetic", "--synthetic_size", "64", "--batch_size", "16",
+        "--max_seq_len", "32", "--vocab_size", "90", "--num_latents", "8",
+        "--num_latent_channels", "16", "--num_encoder_layers", "2",
+        "--num_self_attention_layers_per_block", "1",
+        "--num_cross_attention_heads", "2",
+        "--num_self_attention_heads", "2", "--dtype", "float32",
+        "--log_every_n_steps", "1", "--max_steps", str(args.steps),
+        "--eval_every_n_steps", "2", "--max_to_keep", "3",
+        "--step_timeout_s", str(args.step_timeout_s),
+        "--logdir", logdir, "--root", os.path.join(workdir, "cache"),
+    ]
+    from perceiver_io_tpu.cli.common import _newest_resumable_run
+
+    err_path = os.path.join(workdir, "launcher.err")
+    t0 = time.monotonic()
+    proc = subprocess.Popen(
+        cmd, env=env, stdout=subprocess.DEVNULL, stderr=open(err_path, "w"))
+
+    record = {"ok": False, "steps": args.steps, "delay_s": args.delay}
+    try:
+        resumable = wait_for(
+            lambda: _newest_resumable_run(logdir, "mlm"), timeout_s=240)
+        if not resumable:
+            record["error"] = "no committed checkpoint before kill window"
+            emit_json_line(record)
+            proc.kill()
+            return 1
+        victim = wait_for(lambda: _pid_of_rank(1), timeout_s=30)
+        if victim is None:
+            record["error"] = "rank-1 process not found to kill"
+            emit_json_line(record)
+            proc.kill()
+            return 1
+        pre_kill_steps = len(_losses(logdir))
+        t_kill = time.monotonic()
+        os.kill(victim, signal.SIGKILL)
+        print(f"[drill] killed rank 1 (pid {victim}) at "
+              f"t+{t_kill - t0:.1f}s", file=sys.stderr)
+
+        def stderr_has(marker):
+            with open(err_path) as f:
+                return marker in f.read()
+
+        restarted = wait_for(
+            lambda: stderr_has("restarting all 2 hosts"), timeout_s=120)
+        t_restart_decision = time.monotonic()
+        relaunched = wait_for(
+            lambda: open(err_path).read().count("launched 2 processes") >= 2,
+            timeout_s=120)
+        t_relaunch = time.monotonic()
+        training_again = wait_for(
+            lambda: len(_losses(logdir)) > pre_kill_steps, timeout_s=240)
+        t_training = time.monotonic()
+        proc.wait(timeout=480)
+        t_done = time.monotonic()
+        losses = _losses(logdir)
+        record.update(
+            ok=(proc.returncode == 0 and bool(restarted) and bool(relaunched)
+                and bool(training_again)
+                and len(losses) >= args.steps),
+            rc=proc.returncode,
+            kill_to_restart_decision_s=round(t_restart_decision - t_kill, 3),
+            kill_to_relaunch_s=round(t_relaunch - t_kill, 3),
+            kill_to_training_again_s=round(t_training - t_kill, 3),
+            total_wall_s=round(t_done - t0, 3),
+            resumed_from=str(resumable),
+            final_step=max(losses) if losses else 0,
+        )
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    emit_json_line(record)
+    return 0 if record.get("ok") else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
