@@ -25,6 +25,7 @@ through the obs registry.
 from perceiver_io_tpu.aot.cache import (
     ExecutableCache,
     callable_sources,
+    compile_via_cache,
     enable_persistent_compilation_cache,
     environment_fingerprint,
     fingerprint,
@@ -35,6 +36,7 @@ from perceiver_io_tpu.aot.cache import (
 __all__ = [
     "ExecutableCache",
     "callable_sources",
+    "compile_via_cache",
     "enable_persistent_compilation_cache",
     "environment_fingerprint",
     "fingerprint",

@@ -45,6 +45,8 @@ import threading
 import warnings
 from typing import Any, Dict, Iterable, List, Optional, Union
 
+import numpy as np
+
 import perceiver_io_tpu.obs as obs
 
 _ENTRY_SUFFIX = ".pitx"
@@ -347,6 +349,40 @@ class ExecutableCache:
             n[: -len(_ENTRY_SUFFIX)] for n in names
             if n.endswith(_ENTRY_SUFFIX) and not n.startswith(".")
         )
+
+
+def compile_via_cache(
+    jitted: Any,
+    example_args: Any,
+    cache: Optional["ExecutableCache"],
+    base: Dict[str, Any],
+    extra: Iterable[str] = (),
+):
+    """Compile ``jitted`` at ``example_args``' abstract shapes, round-
+    tripping the executable through ``cache`` when one is given.
+
+    The shared lower-once path for engines that manage their OWN program
+    tables (the continuous-batching arena, ad-hoc tools): avals are derived
+    from the example arguments (shape/dtype/sharding — never values, so
+    passing live donated buffers is safe: nothing executes here), the
+    fingerprint folds ``base`` + avals + ``extra``, and a hit skips
+    trace/lower/compile entirely. ``cache=None`` degrades to a plain
+    ``lower().compile()`` so callers need no branching."""
+    import jax
+
+    avals = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(
+            np.shape(x), np.asarray(x).dtype if np.isscalar(x)
+            else x.dtype, sharding=getattr(x, "sharding", None)),
+        tuple(example_args))
+    if cache is None:
+        return jitted.lower(*avals).compile()
+    fp = fingerprint(base, avals=avals, extra=extra)
+    compiled = cache.load(fp)
+    if compiled is None:
+        compiled = jitted.lower(*avals).compile()
+        cache.store(fp, compiled)
+    return compiled
 
 
 def resolve_cache(

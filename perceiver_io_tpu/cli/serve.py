@@ -191,6 +191,15 @@ def build_parser() -> argparse.ArgumentParser:
     gen.add_argument("--generate_chunk", type=int, default=8,
                      help="decode steps per chunked dispatch (= streaming "
                           "granularity)")
+    gen.add_argument("--decode_batching", action="store_true",
+                     help="continuous batching: pool session caches into a "
+                          "slotted arena, ONE batched step dispatch for all "
+                          "active streams (identical token streams; pays "
+                          "off at concurrency — prompts here run "
+                          "sequentially, so this mostly exercises the path)")
+    gen.add_argument("--decode_slots", type=int, default=8,
+                     help="decode batching: initial arena slots per prefill "
+                          "width (power-of-two-bucketed)")
     g.add_argument("--checkpoint", required=True,
                    help="checkpoint directory of a train_mlm run "
                         "(the version_N/checkpoints dir; hparams embedded)")
@@ -808,10 +817,21 @@ def _serve_generate(args, load_tokenizer, drain_state=None):
         args.checkpoint, tokenizer, step=args.step,
         dtype="bfloat16" if args.dtype == "bfloat16" else None,
     )
-    gen = ARGenerator(
-        model, params, max_seq_len=max_seq_len, chunk=args.generate_chunk,
-        compute_dtype="bfloat16" if args.dtype == "bfloat16" else None,
-    )
+    if args.decode_batching:
+        from perceiver_io_tpu.inference.batching import ContinuousBatcher
+
+        gen = ContinuousBatcher(
+            model, params, max_seq_len=max_seq_len,
+            chunk=args.generate_chunk, slots=args.decode_slots,
+            compute_dtype="bfloat16" if args.dtype == "bfloat16" else None,
+            compile_cache=args.compile_cache,
+        )
+    else:
+        gen = ARGenerator(
+            model, params, max_seq_len=max_seq_len,
+            chunk=args.generate_chunk,
+            compute_dtype="bfloat16" if args.dtype == "bfloat16" else None,
+        )
     sampling = SamplingConfig(temperature=args.temperature,
                               top_k=args.top_k, seed=args.gen_seed)
     if not args.no_warmup:

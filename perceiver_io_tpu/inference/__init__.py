@@ -22,6 +22,11 @@ from perceiver_io_tpu.inference.generate import (
     GenSession,
     SamplingConfig,
 )
+from perceiver_io_tpu.inference.batching import (
+    ArenaSession,
+    ContinuousBatcher,
+    sample_logits_rows,
+)
 from perceiver_io_tpu.resilience import (
     BreakerOpen,
     DeadlineExceeded,
@@ -30,8 +35,11 @@ from perceiver_io_tpu.resilience import (
 
 __all__ = [
     "ARGenerator",
+    "ArenaSession",
+    "ContinuousBatcher",
     "GenSession",
     "GenerateSessionStore",
+    "sample_logits_rows",
     "Predictor",
     "SamplingConfig",
     "bucket_size",

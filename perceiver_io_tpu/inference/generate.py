@@ -434,17 +434,43 @@ class GenerateSessionStore:
     # pitlint PIT-LOCK: the table is shared between RPC handler threads
     _guarded_by = {"_sessions": "_lock"}
 
+    #: every way a resident session leaves the store — the ``reason`` label
+    #: the chaos drills assert on (metrics, not log-scraping)
+    RETIRE_REASONS = ("finished", "evicted", "killed")
+
     def __init__(self, max_sessions: int = 256,
                  registry: Optional[obs.MetricsRegistry] = None,
-                 name: str = "replica"):
+                 name: str = "replica",
+                 on_evict: Optional[Callable[[Any, str], None]] = None):
         self._lock = threading.Lock()
         self._sessions: "OrderedDict[str, GenSession]" = OrderedDict()
         self.max_sessions = max_sessions
+        self._on_evict = on_evict
         reg = registry if registry is not None else obs.get_registry()
         self._m_resident = reg.gauge(
             "generate_sessions_resident",
             "generation sessions resident on this replica",
             {"replica": name, "task": "generate"})
+        self._m_retired = {
+            r: reg.counter(
+                "generate_sessions_retired_total",
+                "resident generation sessions leaving the store, by reason "
+                "(finished = absolute budget exhausted, evicted = FIFO/"
+                "overwrite pressure, killed = replica death wiped the table)",
+                {"replica": name, "task": "generate", "reason": r})
+            for r in self.RETIRE_REASONS}
+
+    def _dropped(self, dropped: List[Any], reason: str) -> None:
+        """Account (and fan out) sessions that left the table — called
+        OUTSIDE the lock: the eviction callback may take the generation
+        engine's own lock (the arena frees the slot behind the session)."""
+        for ses in dropped:
+            self._m_retired[reason].inc()
+            if self._on_evict is not None:
+                try:
+                    self._on_evict(ses, reason)
+                except Exception:
+                    pass  # a resource-release hook must never break serving
 
     def match(self, session_id: Optional[str],
               seq: Sequence[int]) -> Optional[GenSession]:
@@ -460,16 +486,38 @@ class GenerateSessionStore:
             session: Optional[GenSession]) -> None:
         if session_id is None or session is None:
             return  # anonymous stream, or a zero-step call that never ran
+        dropped = []
         with self._lock:
+            old = self._sessions.get(session_id)
+            if old is not None and old is not session:
+                dropped.append(old)  # overwritten: release its resources
             self._sessions[session_id] = session
             while len(self._sessions) > self.max_sessions:
-                self._sessions.popitem(last=False)
+                dropped.append(self._sessions.popitem(last=False)[1])
             self._m_resident.set(len(self._sessions))
+        self._dropped(dropped, "evicted")
 
-    def clear(self) -> None:
+    def remove(self, session_id: Optional[str],
+               reason: str = "finished") -> bool:
+        """Retire one resident session (its continuation hit the absolute
+        budget, or the caller is done with it); returns whether it was
+        resident."""
+        if session_id is None:
+            return False
         with self._lock:
+            ses = self._sessions.pop(session_id, None)
+            self._m_resident.set(len(self._sessions))
+        if ses is None:
+            return False
+        self._dropped([ses], reason)
+        return True
+
+    def clear(self, reason: str = "killed") -> None:
+        with self._lock:
+            dropped = list(self._sessions.values())
             self._sessions.clear()
             self._m_resident.set(0)
+        self._dropped(dropped, reason)
 
     def __len__(self) -> int:
         with self._lock:

@@ -91,6 +91,11 @@ SITES = (
     # drills target a replica's step path without code changes
     "generation.prefill",
     "generation.step",
+    # the continuous-batching arena (perceiver_io_tpu.inference.batching):
+    # ONE batched decode dispatch covers every active stream, so a fault
+    # here is the blast-radius drill — all in-flight streams on the replica
+    # observe the same failure and must reroute content-losslessly
+    "generation.batch_dispatch",
     # multi-host training fault tolerance (r19): the collective train-step
     # edge (fire hook over the HOST-LOCAL batch before dispatch — nan =
     # one host's shard corrupted, whose NaN then rides the global loss

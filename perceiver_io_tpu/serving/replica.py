@@ -236,8 +236,12 @@ class ReplicaApp:
                 GenerateSessionStore,
             )
 
+            # a continuous-batching generator owns arena slots behind its
+            # resident sessions — store evictions must free them (epoch-
+            # checked in the engine, so a stale handle is a no-op)
             self._gen_store = GenerateSessionStore(
-                registry=reg, name=name)
+                registry=reg, name=name,
+                on_evict=getattr(generator, "release_session", None))
             self._m_gen_requests = reg.counter(
                 "replica_generate_requests_total",
                 "streamed generate RPCs served",
@@ -396,7 +400,13 @@ class ReplicaApp:
                 self._gen_active -= 1
                 self._gen_requests += 1
                 self._m_gen_active.set(self._gen_active)
-        self._gen_store.put(session, ses)
+        if ses is not None and len(ses.seq) < self.generator.max_seq_len:
+            self._gen_store.put(session, ses)
+        else:
+            # the continuation exhausted the absolute position budget (or
+            # the engine kept no resident state): retire the pin for real —
+            # reason-labeled, so drills assert on metrics, not logs
+            self._gen_store.remove(session, "finished")
         self._m_gen_requests.inc()
         summary = {
             "done": True,
@@ -539,12 +549,20 @@ class ReplicaApp:
             "generate_sessions": (len(self._gen_store)
                                   if self._gen_store is not None else 0),
             "generate_active": gen_active,
+            # continuous-batching engines expose their dispatch aggregates
+            # (slot occupancy, steps/dispatch) — absent for per-session ones
+            "decode_batching": (self.generator.stats()
+                                if hasattr(self.generator, "stats")
+                                else None),
             "engines": engines,
         }
 
     def close(self) -> None:
         for engine in self.engines.values():
             engine.close()
+        closer = getattr(self.generator, "close", None)
+        if closer is not None:
+            closer()
 
 
 def _scale_tree(tree, factor: float):
@@ -1074,6 +1092,15 @@ def build_parser() -> argparse.ArgumentParser:
     src.add_argument("--generate_chunk", type=int, default=8,
                      help="generate task: decode steps per chunked "
                           "dispatch (= streaming granularity)")
+    src.add_argument("--decode_batching", action="store_true",
+                     help="generate task: continuous batching — pool "
+                          "session caches into a slotted arena and pack "
+                          "every active stream's steps into ONE batched "
+                          "dispatch (token streams identical either way)")
+    src.add_argument("--decode_slots", type=int, default=8,
+                     help="decode batching: initial arena slots per "
+                          "prefill width (power-of-two-bucketed; doubles "
+                          "under pressure up to 8x)")
     eng = parser.add_argument_group("engine (mirrors cli/serve.py)")
     eng.add_argument("--max_batch", type=int, default=8)
     eng.add_argument("--max_delay_ms", type=float, default=0.0)
@@ -1263,10 +1290,22 @@ def _build_generate_app(args):
                 raise ValueError(f"preset replica got spec {spec!r}")
             return init_params(int(spec.get("seed", 0)))
 
-    generator = ARGenerator(
-        model, params, max_seq_len=max_seq_len, chunk=args.generate_chunk,
-        compute_dtype=compute_dtype, name=f"{args.name}-gen",
-    )
+    if getattr(args, "decode_batching", False):
+        from perceiver_io_tpu.inference.batching import ContinuousBatcher
+
+        generator = ContinuousBatcher(
+            model, params, max_seq_len=max_seq_len,
+            chunk=args.generate_chunk, slots=args.decode_slots,
+            max_slots=args.decode_slots * 8,
+            compute_dtype=compute_dtype, name=f"{args.name}-gen",
+            compile_cache=args.compile_cache,
+        )
+    else:
+        generator = ARGenerator(
+            model, params, max_seq_len=max_seq_len,
+            chunk=args.generate_chunk,
+            compute_dtype=compute_dtype, name=f"{args.name}-gen",
+        )
 
     def infer_apply(p, token_ids, pad_mask):
         return model.apply({"params": p}, token_ids, pad_mask)

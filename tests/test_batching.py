@@ -1,0 +1,421 @@
+"""Continuous batching for Perceiver-AR decode: the slotted cache arena +
+one-batched-dispatch scheduler (`inference/batching.py`).
+
+The correctness spine is STREAM IDENTITY: every continuation served out of
+the shared arena — greedy or sampled, crossing episode boundaries, admitted
+and retired mid-sweep, resumed off a resident slot — must be bit-identical
+to the r18 per-session engine serving the same request alone. The
+position-folded sampling keys make that a hard equality, not a
+distribution-level claim. Around it: incremental parity through the arena
+install path (2e-5 vs a dense forward), the admission-wave program family
+(closed and AOT-warmable), retire-reason accounting on the session store,
+and the serving drill: router generate through a batched replica with a
+mid-stream kill — content-lossless, lost_accepted=0.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from perceiver_io_tpu.inference.batching import ArenaSession, ContinuousBatcher
+from perceiver_io_tpu.inference.generate import (
+    ARGenerator,
+    GenerateSessionStore,
+    SamplingConfig,
+)
+from perceiver_io_tpu.models.presets import tiny_ar
+import perceiver_io_tpu.obs as obs
+
+VOCAB = 503
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = tiny_ar()
+    ids = np.zeros((1, 64), np.int32)
+    params = model.init({"params": jax.random.key(0)}, ids, ids == 0)[
+        "params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def oracle(tiny):
+    model, params = tiny
+    return ARGenerator(model, params, max_seq_len=64, chunk=4, name="b-orc")
+
+
+@pytest.fixture(scope="module")
+def batcher(tiny):
+    model, params = tiny
+    # capacity pinned: growth (and its extra per-(width, slots) compile
+    # family) is pinned by test_continuous_admit_retire_mid_sweep
+    bat = ContinuousBatcher(model, params, max_seq_len=64, chunk=4,
+                            slots=4, max_slots=4, name="b-arena")
+    yield bat
+    bat.close()
+
+
+def _fan_out(bat, cases):
+    """Run every (prefix, max_new, sampling) case concurrently through the
+    batcher; returns tokens per case in order."""
+    got = [None] * len(cases)
+    errs = []
+
+    def one(i):
+        prefix, max_new, sampling = cases[i]
+        try:
+            got[i], _ = bat.generate(list(prefix), max_new, sampling)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=one, args=(i,), daemon=True)
+               for i in range(len(cases))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs[0]
+    return got
+
+
+# -- stream identity: the correctness spine -----------------------------------
+
+
+def test_batched_streams_match_per_session_oracle(tiny, oracle, batcher,
+                                                  rng):
+    """8 concurrent mixed streams (greedy + sampled, episode-crossing
+    budgets, more streams than slots so admission churns) are each
+    bit-identical to the per-session engine serving them alone. The band
+    stays inside widths 16/31 — two full episode families compile here,
+    which is where the wall of this test goes; width 46 adds nothing but a
+    third compile family."""
+    cases = []
+    for i in range(8):
+        plen = int(rng.integers(2, 10))
+        prefix = [int(t) for t in rng.integers(3, VOCAB, plen)]
+        max_new = int(rng.integers(1, 22))  # crosses the 16->31 boundary
+        temp = float(rng.choice([0.0, 0.8]))
+        cases.append((prefix, max_new,
+                      SamplingConfig(temperature=temp, top_k=16, seed=i)))
+    want = [oracle.generate(list(p), mn, s)[0] for p, mn, s in cases]
+    got = _fan_out(batcher, cases)
+    for i, (g, w) in enumerate(zip(got, want)):
+        assert g == w, f"stream {i} diverged: {g} vs {w}"
+    # the sweep exercised continuous admission: slots are scarcer than
+    # streams, so placements churned rather than running a fixed cohort
+    stats = batcher.stats()
+    assert stats["admitted"] >= 8
+    assert stats["dispatches"] > 0
+
+
+def test_arena_session_adoption_skips_prefill(tiny, oracle, batcher, rng):
+    """A follow-up on the returned ArenaSession adopts the resident slot
+    (ZERO further prefix encodes) and continues the identical stream the
+    per-session engine produces across the same split."""
+    prefix = [int(t) for t in rng.integers(3, VOCAB, 7)]
+    sampling = SamplingConfig(temperature=0.8, top_k=16, seed=41)
+    # 4+4 stays inside the width-16 episode: adoption must not re-encode
+    a, ses = batcher.generate(prefix, 4, sampling)
+    assert isinstance(ses, ArenaSession) and ses.seq == prefix + a
+    o1, os1 = oracle.generate(list(prefix), 4, sampling)
+    assert a == o1
+    prefills_before = batcher._m_prefills.value
+    b, _ = batcher.generate(prefix + a, 4, sampling, session=ses)
+    assert batcher._m_prefills.value == prefills_before  # adopted, no encode
+    o2, _ = oracle.generate(prefix + o1, 4, sampling, session=os1)
+    assert b == o2
+    # a diverged prefix must NOT be trusted: fresh encode instead
+    other = [int(t) for t in rng.integers(3, VOCAB, 7)]
+    c, _ = batcher.generate(other, 3, sampling, session=ses)
+    assert batcher._m_prefills.value > prefills_before
+    assert c == oracle.generate(list(other), 3, sampling)[0]
+
+
+def test_arena_parity_peek_logits_vs_dense(tiny, batcher, rng):
+    """Incremental parity THROUGH the arena path: the resident slot's
+    next-token logits after a generate equal a dense full-prefix forward
+    within 2e-5 (f32) — the install + batched-step pipeline preserves the
+    per-session cache algebra exactly."""
+    import jax.numpy as jnp
+
+    model, params = tiny
+    prefix = [int(t) for t in rng.integers(3, VOCAB, 6)]
+    toks, ses = batcher.generate(prefix, 5, SamplingConfig())  # greedy
+    assert ses is not None
+    peek = batcher.peek_logits(ses)
+    assert peek is not None
+    seq = prefix + toks
+    w = ses.width
+    cap = model.num_latents
+    ids = np.zeros((1, w), np.int32)
+    ids[0, :len(seq)] = seq
+    pad = np.zeros((1, w), bool)
+    pad[0, len(seq):] = True
+    dense = np.asarray(model.apply(
+        {"params": params}, jnp.asarray(ids), jnp.asarray(pad)),
+        np.float32)
+    row = (len(seq) - 1) - (w - min(cap, w))
+    err = float(np.max(np.abs(peek - dense[0, row])))
+    assert err < 2e-5, f"arena parity error {err}"
+
+
+def test_streamed_chunks_still_flow(tiny, batcher, rng):
+    """An on_chunk consumer still receives the per-chunk frames (pos /
+    steps / chunk_ms / batched) and their concatenation equals the final
+    return — the no-consumer fast path must not leak into streaming."""
+    prefix = [int(t) for t in rng.integers(3, VOCAB, 5)]
+    frames = []
+    toks, _ = batcher.generate(
+        prefix, 6, SamplingConfig(temperature=0.8, top_k=16, seed=9),
+        on_chunk=lambda t, info: frames.append((t, info)))
+    assert [t for ts, _ in frames for t in ts] == toks and len(toks) == 6
+    for _, info in frames:
+        assert {"pos", "steps", "chunk_ms", "batched"} <= set(info)
+
+
+# -- the scheduler: continuous admission, growth, lifecycle -------------------
+
+
+def test_continuous_admit_retire_mid_sweep(tiny, rng):
+    """A sweep with 4x more streams than slots completes with every stream
+    placed (admissions wait at chunk boundaries, never starve) and the
+    arena sized within its power-of-two cap."""
+    model, params = tiny
+    bat = ContinuousBatcher(model, params, max_seq_len=64, chunk=4,
+                            slots=2, max_slots=4, name="b-churn")
+    try:
+        cases = []
+        for i in range(16):
+            prefix = [int(t) for t in rng.integers(3, VOCAB, 4)]
+            cases.append((prefix, 6,
+                          SamplingConfig(temperature=0.8, top_k=16,
+                                         seed=100 + i)))
+        got = _fan_out(bat, cases)
+        assert all(len(g) == 6 for g in got)
+        stats = bat.stats()
+        assert stats["admitted"] >= 16
+        assert stats["retired"] >= 16
+        assert 0 < stats["slot_occupancy_mean"] <= 1
+        # demand outran 2 slots: the width-16 arena doubled to the cap
+        assert stats["slots"] <= 4
+        # lifecycle rides the same compiled batcher: close() rejects new
+        # work instead of hanging callers on a dead dispatcher
+        bat.close()
+        with pytest.raises(RuntimeError):
+            bat.generate([3, 7], 2, SamplingConfig())
+    finally:
+        bat.close()
+
+
+def test_warmup_closes_the_program_family(tiny):
+    """warmup() compiles the ENTIRE (width x wave-bucket) admission family
+    plus the batched decode program — afterwards a mixed burst triggers
+    zero new compiles (the finite-program-family contract)."""
+    model, params = tiny
+    bat = ContinuousBatcher(model, params, max_seq_len=64, chunk=4,
+                            slots=2, max_slots=2, name="b-warm")
+    try:
+        n = bat.warmup(widths=[16])
+        keys = set(bat._programs)
+        assert ("decode", 16, 2) in keys
+        for k_n in (1, 2, 4, 8):
+            assert ("prefill", 16, k_n) in keys
+            assert (f"install_rows{k_n}", 16, 2) in keys
+        assert n == 9  # 4 buckets x (prefill + install) + 1 decode
+        # serve a burst against the warmed width: no program beyond the
+        # warmed family may appear
+        cases = [([3 + i, 7], 4, SamplingConfig(seed=i)) for i in range(5)]
+        _fan_out(bat, cases)
+        assert set(bat._programs) == keys
+    finally:
+        bat.close()
+
+
+@pytest.mark.slow  # coverage retained: test_warmup_closes_the_program_family
+# pins the family the cache persists tier-1, and tests/test_aot_cache.py
+# pins the ExecutableCache round-trip mechanics; this drill only composes
+# the two (a second compile family's wall for a composition check)
+def test_warmup_aot_cache_round_trip(tiny, tmp_path):
+    """With compile_cache set, a second batcher warms the same family from
+    disk (fingerprint hits, no recompiles) — zero-recompile restarts."""
+    model, params = tiny
+    reg1 = obs.MetricsRegistry()
+    bat1 = ContinuousBatcher(model, params, max_seq_len=64, chunk=4,
+                             slots=2, max_slots=2, name="b-aot1",
+                             registry=reg1, compile_cache=str(tmp_path))
+    try:
+        n1 = bat1.warmup(widths=[16])
+    finally:
+        bat1.close()
+    stored = list(tmp_path.rglob("*"))
+    assert stored, "warmup persisted nothing to the executable cache"
+    reg2 = obs.MetricsRegistry()
+    bat2 = ContinuousBatcher(model, params, max_seq_len=64, chunk=4,
+                             slots=2, max_slots=2, name="b-aot2",
+                             registry=reg2, compile_cache=str(tmp_path))
+    try:
+        assert bat2.warmup(widths=[16]) == n1
+        hits = [m.value for m in reg2.instruments_by_key().values()
+                if m.name == "aot_cache_hits_total"]
+        assert hits and sum(hits) >= n1 - 1  # prefills re-execute, all load
+    finally:
+        bat2.close()
+
+
+# -- the session store: retire-reason accounting ------------------------------
+
+
+def test_store_retire_reason_counters_and_release_hook():
+    """Every exit path is labeled: overwrite/overflow -> evicted, explicit
+    remove -> finished, clear (replica death) -> killed — and the on_evict
+    hook sees each dropped session exactly once."""
+    reg = obs.MetricsRegistry()
+    released = []
+    store = GenerateSessionStore(max_sessions=2, registry=reg, name="t",
+                                 on_evict=lambda s, r: released.append(
+                                     (s.seq[0], r)))
+
+    class FakeSession:
+        def __init__(self, seq):
+            self.seq = seq
+
+    def count(reason):
+        return sum(m.value for m in reg.instruments_by_key().values()
+                   if m.name == "generate_sessions_retired_total"
+                   and m.label_dict.get("reason") == reason)
+
+    a, b, c = FakeSession([1]), FakeSession([2]), FakeSession([3])
+    store.put("a", a)
+    store.put("b", b)
+    store.put("a", FakeSession([10]))          # overwrite -> evicted
+    store.put("c", c)                          # FIFO overflow pops "a"
+    assert count("evicted") == 2
+    assert store.remove("b", "finished") is True
+    assert store.remove("b") is False          # already gone: no double count
+    assert count("finished") == 1
+    store.clear()                              # replica death wipe
+    assert count("killed") == 1
+    assert sorted(released) == [(1, "evicted"), (2, "finished"),
+                                (3, "killed"), (10, "evicted")]
+
+
+# -- serving integration: the batched replica under chaos ---------------------
+
+
+def test_batched_replica_router_chaos_drill(tiny, oracle, rng):
+    """The r19 kill drill THROUGH the arena: router generate against
+    replicas whose engine is the ContinuousBatcher; the pinned replica is
+    killed mid-stream; the stream reroutes, re-encodes from the accepted
+    prefix on the survivor's arena, and the assembled continuation equals
+    the uninterrupted per-session oracle exactly — lost_accepted=0 by
+    content through the batched path."""
+    from perceiver_io_tpu.inference.engine import ServingEngine
+    from perceiver_io_tpu.serving.replica import LocalReplica, ReplicaApp
+    from perceiver_io_tpu.serving.router import Router
+
+    model, params = tiny
+    shared = ContinuousBatcher(model, params, max_seq_len=64, chunk=4,
+                               slots=4, name="b-fleet")
+
+    def apply_fn(p, token_ids, pad_mask):
+        return model.apply({"params": p}, token_ids, pad_mask)
+
+    reps = []
+    for name in ("b0", "b1"):
+        eng = ServingEngine(apply_fn, params, name=f"{name}-inf",
+                            max_batch=2)
+        reps.append(LocalReplica(ReplicaApp(
+            {"infer": eng}, params, name=name, assume_ready=True,
+            generator=shared)))
+    by_name = {r.name: r for r in reps}
+    router = Router(reps, name="b-chaos", scrape_interval_s=0.05)
+    time.sleep(0.12)
+    try:
+        prefix = [int(t) for t in rng.integers(3, VOCAB, 9)]
+        want, _ = oracle.generate(list(prefix), 7, SamplingConfig(
+            temperature=0.8, top_k=16, seed=11))
+
+        got = []
+        killed = {"name": None}
+
+        def on_tokens(toks, frame):
+            got.extend(toks)
+            if len(got) >= 4 and killed["name"] is None:
+                for name, r in by_name.items():
+                    if r.app._gen_active > 0:
+                        killed["name"] = name
+                        r.kill()
+
+        res = router.generate(prefix, session="bdrill", max_new=7,
+                              temperature=0.8, top_k=16, seed=11,
+                              on_tokens=on_tokens)
+        assert killed["name"] is not None, "the kill never landed"
+        assert res["tokens"] == want, "diverged across the kill"
+        assert got == want
+        assert res["reroutes"] >= 1
+        assert int(router._m_gen_failed.value) == 0  # lost_accepted=0
+        # the replica reports its arena aggregates for autoscale/debug
+        surv = by_name[res["replica"]]
+        status = surv.app.status()
+        assert status["decode_batching"]["dispatches"] > 0
+    finally:
+        router.close()
+        for r in reps:
+            r.app.close()
+        shared.close()
+
+
+# -- the perf contract (slow: the tier-1 signal is the bench's JSON line) -----
+
+
+@pytest.mark.slow  # coverage retained: test_batched_streams_match_per_session
+# _oracle pins stream identity tier-1 and tools/decode_batching_bench.py is
+# the measured A/B (2.1x median on the r20 CPU box, occupancy 0.90); this
+# drill re-runs a shortened sweep and asserts a conservative floor
+def test_decode_batching_ab_floor(tiny):
+    """Shortened same-process interleaved A/B: batched aggregate tokens/s
+    must beat per-session chains by a clear margin at concurrency (the
+    bench's own defaults demonstrate the 2x acceptance; this floor guards
+    against structural regressions, not scheduler noise)."""
+    import argparse
+
+    import tools.decode_batching_bench as ab
+
+    ns = argparse.Namespace(
+        dry=False, cpu=False, streams=96, concurrency=32, chunk=4,
+        slots=16, pairs=3, mean_new=24, max_new_cap=12,
+        prefix_lens="2,3,4", stagger_s=0.002, temperature=0.8, top_k=16,
+        seed=0)
+    sched = ab._schedule(ns, vocab=VOCAB, max_seq_len=64)
+    model, params = tiny
+    sampling = SamplingConfig(temperature=0.8, top_k=16, seed=0)
+    seq = ARGenerator(model, params, max_seq_len=64, chunk=4, name="ab-s")
+    bat = ContinuousBatcher(model, params, max_seq_len=64, chunk=4,
+                            slots=16, max_slots=16, name="ab-b")
+    try:
+        ab._run_arm(seq, sched, sampling, ns.concurrency)   # warm
+        ab._run_arm(bat, sched, sampling, ns.concurrency)
+        speedups = []
+        for p in range(ns.pairs):
+            order = ("bat", "seq") if p % 2 == 0 else ("seq", "bat")
+            rates = {}
+            toks = {}
+            for arm in order:
+                gen = bat if arm == "bat" else seq
+                wall, total, res = ab._run_arm(gen, sched, sampling,
+                                               ns.concurrency)
+                rates[arm] = total / wall
+                toks[arm] = res
+            assert toks["bat"] == toks["seq"]  # identity rides the A/B
+            speedups.append(rates["bat"] / rates["seq"])
+        median = sorted(speedups)[len(speedups) // 2]
+        # under the conftest's 8-virtual-device CPU partitioning the ratio
+        # compresses vs the standalone bench (2.1x there, ~1.4x here) — the
+        # floor guards batched-must-clearly-beat-sequential structurally,
+        # not the acceptance number (that is the bench's own JSON record)
+        assert median >= 1.15, f"batched speedup regressed: {speedups}"
+    finally:
+        bat.close()

@@ -408,6 +408,10 @@ def test_json_emitters_keep_one_line_stdout_contract(tmp_path):
     # in-kernel causal flag at guard boundaries + the q_len=1 step shape)
     assert "attn-causal-prefill-d128" in report["skipped"]
     assert "attn-q1-decode-32k" in report["skipped"]
+    # the continuous-batching arena shapes: batched q1 step + batched
+    # causal prefill (batch = arena slots) at VMEM-guard boundaries
+    assert "attn-arena8-q1-32k" in report["skipped"]
+    assert "attn-arena16-prefill-d64" in report["skipped"]
     with open(tmp_path / "ks.json") as f:
         assert json.loads(f.read()) == report
 

@@ -225,6 +225,17 @@ CASES = {
     # causal offset here; the engine uses a pad mask, same masking math)
     "attn-q1-decode-32k": lambda: _attention_case(
         1, 1, 32768, 4, 128, causal_offset=32767),
+    # -- continuous-batching arena geometries (batch = arena slots) --
+    # the arena's batched q_len=1 step at the d<=128 VMEM-guard KV tier:
+    # 8 slots × one decode row each over the long ring — the vmapped-step
+    # dispatch shape, which must ride the SAME block resolution as b=1
+    # (batch is grid-parallel; the per-block VMEM guard maths must not move)
+    "attn-arena8-q1-32k": lambda: _attention_case(
+        8, 1, 32768, 4, 128, causal_offset=32767),
+    # batched causal prefill across a 16-slot arena at the d<=64 wide-KV
+    # tier: admission re-encodes burst-compile this exact family
+    "attn-arena16-prefill-d64": lambda: _attention_case(
+        16, 256, 2048, 8, 64, causal_offset=1792),
 }
 
 

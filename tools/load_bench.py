@@ -153,7 +153,14 @@ GENERATE_KEYS = ("offered_streams", "completed", "failed", "shed",
                  "tokens_total", "steps_per_s", "stream_p50_ms",
                  "stream_p95_ms", "stream_p99_ms", "followups", "resumed",
                  "reroutes", "spills", "mean_new", "prefix_lens",
-                 "concurrency")
+                 "concurrency",
+                 # --decode_batching: the continuous-batching arena's
+                 # steady-state aggregates summed over replicas (null
+                 # per-key when the per-session engine served the class) —
+                 # ar_decode_slot_occupancy is the mean decode batch fill
+                 # the weight stream amortized over
+                 "decode_batched", "ar_decode_slot_occupancy",
+                 "steps_per_dispatch", "dispatches", "arena_slots")
 # the generate class's sampling shape — ONE definition shared by the load
 # generator and the per-replica warmup (greedy vs top-k are distinct decode
 # programs; a mismatch would re-introduce mid-stream compile stalls)
@@ -935,6 +942,15 @@ def main() -> None:
                      help="prefix lengths sampled uniformly per stream")
     gen.add_argument("--generate_chunk", type=int, default=4,
                      help="decode steps per chunked dispatch")
+    gen.add_argument("--decode_batching", action="store_true",
+                     help="serve the generate class through the continuous-"
+                          "batching arena (ONE batched step dispatch per "
+                          "chunk across all active streams) instead of "
+                          "per-session chains; the generate record gains "
+                          "slot-occupancy/steps-per-dispatch aggregates")
+    gen.add_argument("--decode_slots", type=int, default=8,
+                     help="decode batching: initial arena slots per "
+                          "prefill width")
     args = parser.parse_args()
 
     if (args.autoscale or args.noisy_neighbor) and args.replicas < 1:
@@ -1100,10 +1116,21 @@ def main() -> None:
                         SamplingConfig,
                     )
 
-                    generator = ARGenerator(
-                        ar_model, ar_params, max_seq_len=64,
-                        chunk=args.generate_chunk, name=f"lb_r{i}-gen",
-                        registry=registry)
+                    if args.decode_batching:
+                        from perceiver_io_tpu.inference.batching import (
+                            ContinuousBatcher,
+                        )
+
+                        generator = ContinuousBatcher(
+                            ar_model, ar_params, max_seq_len=64,
+                            chunk=args.generate_chunk,
+                            slots=args.decode_slots,
+                            name=f"lb_r{i}-gen", registry=registry)
+                    else:
+                        generator = ARGenerator(
+                            ar_model, ar_params, max_seq_len=64,
+                            chunk=args.generate_chunk, name=f"lb_r{i}-gen",
+                            registry=registry)
                     warm_sampling = SamplingConfig(
                         temperature=GENERATE_TEMPERATURE,
                         top_k=GENERATE_TOP_K)
@@ -1474,6 +1501,25 @@ def main() -> None:
         # stopped AFTER the sweep (and the autoscale drill riding it): the
         # stateful class overlapped every segment
         generate_record = gen_load.stop_and_record(args.drain_timeout_s)
+        # the arena's dispatch aggregates, summed over the fleet (occupancy
+        # and steps/dispatch weighted by each replica's dispatch count) —
+        # null-valued when the per-session engine served the class, so the
+        # key set is identical either way (one-JSON-line contract)
+        batched = [r.app.generator.stats() for r in local_replicas
+                   if hasattr(getattr(r.app, "generator", None), "stats")]
+        dispatches = sum(s["dispatches"] for s in batched)
+        def _wmean(key):
+            num = sum(s[key] * s["dispatches"] for s in batched
+                      if s[key] is not None)
+            return round(num / dispatches, 4) if dispatches else None
+        generate_record.update({
+            "decode_batched": bool(batched),
+            "ar_decode_slot_occupancy": _wmean("slot_occupancy_mean"),
+            "steps_per_dispatch": _wmean("steps_per_dispatch_mean"),
+            "dispatches": dispatches if batched else None,
+            "arena_slots": (sum(s["slots"] for s in batched)
+                            if batched else None),
+        })
         _log(f"generate: {json.dumps(generate_record)}")
 
     admission_record = None
