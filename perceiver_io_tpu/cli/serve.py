@@ -463,6 +463,16 @@ def build_parser() -> argparse.ArgumentParser:
     o.add_argument("--slo_availability", type=float, default=0.999,
                    help="fraction of requests that must meet the SLO "
                         "(error budget = 1 - this)")
+    o.add_argument("--slo_ttft_ms", type=float, default=None,
+                   help="generate task, --replicas mode: per-stream time-"
+                        "to-first-token target forwarded to every replica "
+                        "— streams over it burn the stream SLO "
+                        "(stream_burn on /statz; the router degrades and "
+                        "the autoscaler scales on it)")
+    o.add_argument("--slo_itl_ms", type=float, default=None,
+                   help="generate task, --replicas mode: per-stream mean "
+                        "inter-token-latency target (same wire as "
+                        "--slo_ttft_ms)")
     o.add_argument("--slo_burn_alert", type=float, default=2.0,
                    help="/healthz degrades when the windowed error-budget "
                         "burn rate exceeds this (1.0 = spending the budget "
@@ -825,6 +835,7 @@ def _serve_generate(args, load_tokenizer, drain_state=None):
             chunk=args.generate_chunk, slots=args.decode_slots,
             compute_dtype="bfloat16" if args.dtype == "bfloat16" else None,
             compile_cache=args.compile_cache,
+            heartbeat_deadline_s=args.heartbeat_deadline_s,
         )
     else:
         gen = ARGenerator(
@@ -950,6 +961,10 @@ def _serve_fleet(args, drain_state):
     if args.slo_p99_ms is not None:
         extra += ["--slo_p99_ms", str(args.slo_p99_ms),
                   "--slo_availability", str(args.slo_availability)]
+    if args.slo_ttft_ms is not None:
+        extra += ["--slo_ttft_ms", str(args.slo_ttft_ms)]
+    if args.slo_itl_ms is not None:
+        extra += ["--slo_itl_ms", str(args.slo_itl_ms)]
 
     def prepare(text):
         row = masked_token_ids(tokenizer, text)[:max_seq_len]

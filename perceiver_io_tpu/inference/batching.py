@@ -151,11 +151,12 @@ class _Stream:
 
     __slots__ = ("prefix", "max_new", "sampling", "adopt", "q", "tokens",
                  "width", "slot", "placed", "cancelled", "session_out",
-                 "t_start", "wants_chunks")
+                 "t_start", "wants_chunks", "t_queued", "t_bind",
+                 "t_install", "t_first", "t_prev", "ctx")
 
     def __init__(self, prefix: List[int], max_new: int,
                  sampling: SamplingConfig, adopt: Optional[ArenaSession],
-                 wants_chunks: bool = True):
+                 wants_chunks: bool = True, ctx=None):
         self.prefix = prefix
         self.max_new = max_new
         self.sampling = sampling
@@ -168,6 +169,16 @@ class _Stream:
         self.cancelled = False
         self.session_out: Optional[ArenaSession] = None
         self.t_start = time.monotonic()
+        # lifecycle stamps (all monotonic): enqueue -> slot bind ->
+        # prefill install -> first token -> per-chunk. t_queued resets at
+        # every re-placement (episode boundary), so queue-wait observations
+        # measure each wait, not the stream's whole life.
+        self.t_queued = self.t_start
+        self.t_bind = 0.0
+        self.t_install = 0.0
+        self.t_first: Optional[float] = None
+        self.t_prev = self.t_start
+        self.ctx = ctx              # per-stream TraceContext (or None)
         # no on_chunk consumer -> skip per-chunk queue events entirely; the
         # done event carries the full token list. On a shared-core host the
         # per-round caller wakeups are pure context-switch overhead.
@@ -188,6 +199,180 @@ def _round_pow2(n: int) -> int:
     while p < n:
         p *= 2
     return p
+
+
+#: every reason an arena slot can sit idle for a scheduler round — the
+#: closed cause vocabulary the flight recorder attributes with (the
+#: acceptance bar: >=95% of idle slot-rounds carry one of these).
+FLIGHT_CAUSES = ("no_pending", "width_mismatch", "arena_full", "draining")
+
+
+def parse_flight_row(row: str) -> Dict[str, Any]:
+    """Decode one packed flight-recorder row (the single definition of the
+    row grammar — ``tools/decode_flight.py`` and the drill tests parse
+    through here). Row kinds:
+
+    - ``R|seq|t_ms|pending|admits|retires|W:slots:active:resident:c=n+c=n,…``
+      — one scheduler round: queue depth after admission, admit/retire
+      deltas, and per-arena occupancy with idle-slot cause attribution;
+    - ``E|t_ms|reason|width|slot|steps`` — a resident eviction / stream
+      kill freeing a slot (the kill-drill needle);
+    - ``G|t_ms|width|slots`` — arena growth (doubling commit).
+    """
+    parts = row.split("|")
+    kind = parts[0]
+    if kind == "R":
+        arenas = []
+        if len(parts) > 6 and parts[6]:
+            for blob in parts[6].split(","):
+                w, n, act, res, causes_s = blob.split(":")
+                causes = {}
+                if causes_s:
+                    for kv in causes_s.split("+"):
+                        c, cnt = kv.split("=")
+                        causes[c] = int(cnt)
+                arenas.append({"width": int(w), "slots": int(n),
+                               "active": int(act), "resident": int(res),
+                               "causes": causes})
+        return {"kind": "round", "seq": int(parts[1]),
+                "t_ms": float(parts[2]), "pending": int(parts[3]),
+                "admits": int(parts[4]), "retires": int(parts[5]),
+                "arenas": arenas}
+    if kind == "E":
+        return {"kind": "evict", "t_ms": float(parts[1]),
+                "reason": parts[2], "width": int(parts[3]),
+                "slot": int(parts[4]), "steps": int(parts[5])}
+    if kind == "G":
+        return {"kind": "grow", "t_ms": float(parts[1]),
+                "width": int(parts[2]), "slots": int(parts[3])}
+    raise ValueError(f"unknown flight row kind {kind!r}")
+
+
+class DecodeFlightRecorder:
+    """Bounded ring of per-round scheduler decisions — the decode
+    scheduler's black box. Each round the dispatcher records queue depth,
+    admit/retire deltas, and per-arena occupancy with every idle slot
+    attributed to a cause from :data:`FLIGHT_CAUSES`; evictions and arena
+    growth land as their own rows. Rows are packed strings (one grammar,
+    :func:`parse_flight_row`) so the ring costs bytes, not dicts.
+
+    Spooling rides the ``request_phases_batch`` precedent: every
+    ``spool_every`` rows one ``decode_flight_batch`` event carries the
+    batch to the async event log (serialization amortized; nothing blocks
+    the dispatcher). ``dump(reason)`` emits the ring tail as ONE
+    ``decode_flight_dump`` event — the watchdog-stall / SIGTERM hook.
+    """
+
+    # pitlint PIT-LOCK: the ring is appended by the dispatcher but evict
+    # rows arrive from RPC caller threads (session-store callbacks) and
+    # stats/statz pollers read the aggregates — only under _lock.
+    _guarded_by = {"_ring": "_lock", "_agg": "_lock", "_unspooled": "_lock"}
+
+    def __init__(self, engine: str, capacity: int = 512,
+                 spool_every: int = 64):
+        self.engine = engine
+        self.spool_every = spool_every
+        self._lock = threading.Lock()
+        self._ring: "deque[str]" = deque(maxlen=capacity)
+        self._unspooled: List[str] = []
+        self._seq = 0
+        self._last = {"admits": 0, "retires": 0}
+        self._agg = {
+            "rounds": 0, "slot_rounds": 0, "idle_slot_rounds": 0,
+            "attributed": 0, "causes": {c: 0 for c in FLIGHT_CAUSES},
+            "evicts": {}, "grows": 0, "pending_max": 0,
+        }
+
+    def _push_locked(self, row: str) -> Optional[List[str]]:
+        self._ring.append(row)
+        self._unspooled.append(row)
+        if len(self._unspooled) >= self.spool_every:
+            batch, self._unspooled = self._unspooled, []
+            return batch
+        return None
+
+    def _emit(self, batch: Optional[List[str]]) -> None:
+        if batch:
+            obs.event("decode_flight_batch", engine=self.engine,
+                      n=len(batch), parts=";".join(batch))
+
+    def record_round(self, pending: int, admitted: int, retired: int,
+                     arenas: List[Tuple[int, int, int, int,
+                                        Dict[str, int]]]) -> None:
+        """One scheduler round, post-admission. ``arenas`` rows are
+        ``(width, slots, active, resident, causes)`` with ``causes``
+        attributing that arena's idle slots."""
+        blobs = []
+        for w, n, act, res, causes in arenas:
+            causes_s = "+".join(f"{c}={k}" for c, k in sorted(causes.items()))
+            blobs.append(f"{w}:{n}:{act}:{res}:{causes_s}")
+        with self._lock:
+            admits = admitted - self._last["admits"]
+            retires = retired - self._last["retires"]
+            self._last = {"admits": admitted, "retires": retired}
+            self._seq += 1
+            row = (f"R|{self._seq}|{time.monotonic() * 1e3:.1f}|{pending}"
+                   f"|{admits}|{retires}|{','.join(blobs)}")
+            agg = self._agg
+            agg["rounds"] += 1
+            agg["pending_max"] = max(agg["pending_max"], pending)
+            for w, n, act, res, causes in arenas:
+                agg["slot_rounds"] += n
+                idle = n - act
+                agg["idle_slot_rounds"] += idle
+                for c, k in causes.items():
+                    agg["causes"][c] = agg["causes"].get(c, 0) + k
+                    agg["attributed"] += k
+            batch = self._push_locked(row)
+        self._emit(batch)
+
+    def record_evict(self, reason: str, width: int, slot: int,
+                     steps: int) -> None:
+        with self._lock:
+            self._agg["evicts"][reason] = (
+                self._agg["evicts"].get(reason, 0) + 1)
+            batch = self._push_locked(
+                f"E|{time.monotonic() * 1e3:.1f}|{reason}|{width}|{slot}"
+                f"|{steps}")
+        self._emit(batch)
+
+    def record_grow(self, width: int, slots: int) -> None:
+        with self._lock:
+            self._agg["grows"] += 1
+            batch = self._push_locked(
+                f"G|{time.monotonic() * 1e3:.1f}|{width}|{slots}")
+        self._emit(batch)
+
+    def tail(self, n: int = 64) -> List[str]:
+        with self._lock:
+            rows = list(self._ring)
+        return rows[-n:]
+
+    def summary(self) -> Dict[str, Any]:
+        """Cumulative attribution aggregates (rides ``stats()`` /statz)."""
+        with self._lock:
+            agg = {**self._agg, "causes": dict(self._agg["causes"]),
+                   "evicts": dict(self._agg["evicts"])}
+        idle = agg["idle_slot_rounds"]
+        agg["attribution_frac"] = (
+            round(agg["attributed"] / idle, 4) if idle else 1.0)
+        return agg
+
+    def flush(self) -> None:
+        """Spool any unbatched rows now (close/test determinism)."""
+        with self._lock:
+            batch, self._unspooled = self._unspooled, []
+        self._emit(batch)
+
+    def dump(self, reason: str, n: int = 128) -> Dict[str, Any]:
+        """Emit the ring tail + aggregates as one ``decode_flight_dump``
+        event (watchdog stall, SIGTERM) and return the same payload."""
+        rows = self.tail(n)
+        payload = {"engine": self.engine, "reason": reason,
+                   "summary": self.summary(), "rows": rows}
+        obs.event("decode_flight_dump", engine=self.engine, reason=reason,
+                  n=len(rows), parts=";".join(rows))
+        return payload
 
 
 class ContinuousBatcher(ARGenerator):
@@ -227,6 +412,7 @@ class ContinuousBatcher(ARGenerator):
         name: str = "generate",
         registry: Optional[obs.MetricsRegistry] = None,
         compile_cache: Optional[str] = None,
+        heartbeat_deadline_s: Optional[float] = None,
     ):
         import jax
         import jax.numpy as jnp
@@ -244,6 +430,15 @@ class ContinuousBatcher(ARGenerator):
         self._stats = {"dispatches": 0, "steps": 0, "fill_sum": 0.0,
                        "admitted": 0, "retired": 0}
         self._closed = threading.Event()
+        self.flight = DecodeFlightRecorder(name)
+        # the dispatcher's watchdog: a wedged round (device hang, tunnel
+        # stall) dumps the flight-recorder tail with the thread stacks —
+        # the "why was my stream stuck" evidence. None = no monitor.
+        self._hb = obs.Heartbeat(
+            f"{name}-arena-dispatch", deadline_s=heartbeat_deadline_s,
+            diagnostics=lambda: {"flight": self.flight.summary(),
+                                 "flight_tail": self.flight.tail(16)},
+            on_stall=lambda: self.flight.dump("watchdog_stall"))
 
         reg = registry if registry is not None else obs.get_registry()
         labels = {"engine": name, "task": "generate"}
@@ -500,6 +695,7 @@ class ContinuousBatcher(ARGenerator):
                 sum(a.n_slots for a in self._arenas.values()))
         obs.event("arena_grow", engine=self.name, width=arena.width,
                   slots=new_n)
+        self.flight.record_grow(arena.width, new_n)
         return True
 
     # -- slot lifecycle (all under self._cv — see _guarded_by) ---------------
@@ -529,6 +725,10 @@ class ContinuousBatcher(ARGenerator):
         s.epoch += 1           # stale out any stored handle to this slot
         s.stream = st
         s.last = time.monotonic()
+        st.t_bind = s.last
+        self._m_queue_wait_s.observe(
+            s.last - st.t_queued,
+            exemplar=st.ctx.trace_id if st.ctx is not None else None)
         arena.temp[slot] = st.sampling.temperature
         arena.top_k[slot] = st.sampling.top_k
         arena.seeds[slot] = st.sampling.seed
@@ -558,9 +758,17 @@ class ContinuousBatcher(ARGenerator):
             if arena is None or session.slot >= arena.n_slots:
                 return
             s = arena.slots[session.slot]
-            if s.state == _RESIDENT and s.epoch == session.epoch:
+            freed = s.state == _RESIDENT and s.epoch == session.epoch
+            if freed:
                 s.state = _FREE
                 s.epoch += 1
+        if freed and reason != "finished":
+            # the resident rings behind a would-be follow-up are gone: the
+            # decode work they encode is wasted (an overlapping goodput
+            # dimension — the tokens themselves WERE delivered)
+            self._m_tokens["wasted_evicted"].inc(int(session.steps))
+            self.flight.record_evict(reason, session.width, session.slot,
+                                     int(session.steps))
 
     # -- warmup / AOT --------------------------------------------------------
 
@@ -617,12 +825,15 @@ class ContinuousBatcher(ARGenerator):
         sampling: Optional[SamplingConfig] = None,
         on_chunk: Optional[Callable[[List[int], Dict[str, Any]], None]] = None,
         session=None,
+        trace: Optional[obs.TraceContext] = None,
     ) -> Tuple[List[int], Optional[ArenaSession]]:
         """Same contract as :meth:`ARGenerator.generate` — tokens stream
         through ``on_chunk`` on THIS thread, episodes re-prefill on the
         fixed grid, a valid resident ``session`` resumes without a prefix
-        encode — but the steps run inside the shared batched dispatch. The
-        returned session is an :class:`ArenaSession` slot claim."""
+        encode — but the steps run inside the shared batched dispatch.
+        ``trace`` attaches a ``decode_stream`` span (chunk children are
+        recorded dispatcher-side at dispatch completion). The returned
+        session is an :class:`ArenaSession` slot claim."""
         if self._closed.is_set():
             raise RuntimeError(f"batcher {self.name!r} is closed")
         sampling = (sampling or SamplingConfig()).normalized()
@@ -637,32 +848,48 @@ class ContinuousBatcher(ARGenerator):
             self._m_sessions.inc()
         if max_new <= 0:
             return [], adopt
+        ctx = trace.child() if trace is not None else None
         st = _Stream(prefix, max_new, sampling, adopt,
-                     wants_chunks=on_chunk is not None)
+                     wants_chunks=on_chunk is not None, ctx=ctx)
         with self._cv:
             self._pending.append(st)
             self._m_queue.set(len(self._pending))
             self._cv.notify_all()
         produced: List[int] = []
-        while True:
-            kind, payload = st.q.get()
-            if kind == "tokens":
-                tokens, info = payload
-                produced.extend(tokens)
-                if on_chunk is not None:
-                    try:
-                        on_chunk(tokens, info)
-                    except BaseException:
-                        # consumer died (a killed replica's gated frame
-                        # callback): cancel OUR stream; the batch sails on
-                        self.cancel(st)
-                        raise
-            elif kind == "done":
-                # the done payload is the dispatcher-authoritative token
-                # list — for no-on_chunk streams no per-chunk events flowed
-                return payload, st.session_out
-            else:  # "error"
-                raise payload
+        ok = False
+        try:
+            while True:
+                kind, payload = st.q.get()
+                if kind == "tokens":
+                    tokens, info = payload
+                    produced.extend(tokens)
+                    if on_chunk is not None:
+                        try:
+                            on_chunk(tokens, info)
+                        except BaseException:
+                            # consumer died (a killed replica's gated frame
+                            # callback): cancel OUR stream; the batch sails
+                            # on
+                            self.cancel(st)
+                            raise
+                elif kind == "done":
+                    # the done payload is the dispatcher-authoritative
+                    # token list — for no-on_chunk streams no per-chunk
+                    # events flowed
+                    ok = True
+                    return payload, st.session_out
+                else:  # "error"
+                    raise payload
+        finally:
+            if ctx is not None:
+                obs.record_span(
+                    "decode_stream", ctx, st.t_start,
+                    time.monotonic() - st.t_start, engine=self.name,
+                    tokens=len(st.tokens), ok=ok,
+                    queue_wait_s=(round(st.t_bind - st.t_start, 6)
+                                  if st.t_bind else None),
+                    ttft_s=(round(st.t_first - st.t_start, 6)
+                            if st.t_first is not None else None))
 
     def cancel(self, st: _Stream) -> None:
         with self._cv:
@@ -674,9 +901,13 @@ class ContinuousBatcher(ARGenerator):
         with self._cv:
             self._cv.notify_all()
         self._thread.join(timeout=timeout_s)
+        self.flight.flush()
+        self._hb.close()
 
     def stats(self) -> Dict[str, Any]:
-        """Cumulative dispatch aggregates (load_bench's record block)."""
+        """Cumulative dispatch aggregates (load_bench's record block) plus
+        the goodput counters and the flight recorder's attribution summary
+        (the /statz queryable view)."""
         with self._cv:
             d = dict(self._stats)
             d["slots"] = sum(a.n_slots for a in self._arenas.values())
@@ -686,6 +917,8 @@ class ContinuousBatcher(ARGenerator):
         d["steps_per_dispatch_mean"] = (
             round(d["steps"] / d["dispatches"], 3)
             if d["dispatches"] else None)
+        d.update(self.token_stats())
+        d["flight"] = self.flight.summary()
         return d
 
     def peek_logits(self, session: ArenaSession) -> Optional[np.ndarray]:
@@ -716,9 +949,11 @@ class ContinuousBatcher(ARGenerator):
                    for a in self._arenas.values() for s in a.slots)
 
     def _loop(self) -> None:
+        self._hb.arm()
         while True:
             with self._cv:
                 while not self._closed.is_set() and not self._has_work():
+                    self._hb.disarm()
                     self._cv.wait(timeout=0.5)
                 if self._closed.is_set():
                     pending = list(self._pending)
@@ -727,14 +962,63 @@ class ContinuousBatcher(ARGenerator):
                                for s in a.slots
                                if s.state == _ACTIVE and s.stream is not None]
                     break
+            self._hb.arm()
             try:
                 self._admit()
+                self._flight_round()
                 self._dispatch_round()
             except BaseException as e:  # defensive: fail streams, not the loop
                 self._fail_all(e)
+            self._hb.beat()
+        self._hb.disarm()
         err = RuntimeError(f"batcher {self.name!r} closed")
+        killed = 0
         for st in pending + actives:
+            killed += len(st.tokens)
+            self.flight.record_evict("draining", st.width, st.slot,
+                                     len(st.tokens))
             st.q.put(("error", err))
+        if killed:
+            self._m_tokens["wasted_killed"].inc(killed)
+
+    def _flight_round(self) -> None:
+        """Record this scheduler round: post-admission queue depth plus
+        per-arena occupancy, with every idle slot attributed to a cause
+        (the decision tree is exhaustive over :data:`FLIGHT_CAUSES`, which
+        is what makes the >=95% attribution bar structural, not lucky)."""
+        with self._cv:
+            draining = self._closed.is_set()
+            pending_widths = set()
+            for st in self._pending:
+                try:
+                    pending_widths.add(self.plan_width(st.cur_len()))
+                except ValueError:
+                    pass  # finishes at the next admit pass
+            rows = []
+            for w in sorted(self._arenas):
+                a = self._arenas[w]
+                active = sum(1 for s in a.slots if s.state == _ACTIVE)
+                resident = sum(1 for s in a.slots if s.state == _RESIDENT)
+                idle = a.n_slots - active
+                causes: Dict[str, int] = {}
+                if idle:
+                    if draining:
+                        causes["draining"] = idle
+                    elif not pending_widths:
+                        causes["no_pending"] = idle
+                    elif w not in pending_widths:
+                        causes["width_mismatch"] = idle
+                    else:
+                        # pending wants THIS width yet slots sit idle —
+                        # the transient between a blocked claim and the
+                        # next admit pass; the steady state is full-ACTIVE
+                        causes["arena_full"] = idle
+                rows.append((w, a.n_slots, active, resident, causes))
+            pending_n = len(self._pending)
+            admitted = self._stats["admitted"]
+            retired = self._stats["retired"]
+        if rows:
+            self.flight.record_round(pending_n, admitted, retired, rows)
 
     def _fail_all(self, e: BaseException) -> None:
         with self._cv:
@@ -748,8 +1032,14 @@ class ContinuousBatcher(ARGenerator):
             streams += list(self._pending)
             self._pending.clear()
             self._m_queue.set(0)
+        killed = 0
         for st in streams:
+            killed += len(st.tokens)
+            self.flight.record_evict("killed", st.width, st.slot,
+                                     len(st.tokens))
             st.q.put(("error", e))
+        if killed:
+            self._m_tokens["wasted_killed"].inc(killed)
 
     def _admit(self) -> None:
         """Place every pending stream it can: adopt a valid resident slot,
@@ -772,6 +1062,7 @@ class ContinuousBatcher(ARGenerator):
             fresh: Dict[int, List[Tuple[_Stream, List[int]]]] = {}
             for st in batch:
                 if st.cancelled:
+                    self._m_tokens["wasted_cancelled"].inc(len(st.tokens))
                     st.q.put(("error", RuntimeError("stream cancelled")))
                     continue
                 if st.adopt is not None and self._try_adopt(st):
@@ -868,12 +1159,18 @@ class ContinuousBatcher(ARGenerator):
                 for _, _, slot in rows:
                     arena.slots[slot].state = _FREE
                     arena.slots[slot].epoch += 1
+            killed = 0
             for st, _, _ in rows:
+                killed += len(st.tokens)
                 st.q.put(("error", e))
+            if killed:
+                self._m_tokens["wasted_killed"].inc(killed)
             return
+        t_install = time.monotonic()
         with self._cv:
             for st, _, slot in rows:
                 self._bind_slot(arena, slot, st)
+                st.t_install = t_install
         self._m_prefills.inc(g)
         self._m_admitted.inc(g)
 
@@ -897,6 +1194,7 @@ class ContinuousBatcher(ARGenerator):
         st.session_out = ses
         if st.placed:
             self._m_retired.inc()
+        self._m_tokens["delivered"].inc(len(st.tokens))
         st.q.put(("done", list(st.tokens)))
 
     def _dispatch_round(self) -> None:
@@ -925,6 +1223,7 @@ class ContinuousBatcher(ARGenerator):
                 st = s.stream
                 if st.cancelled:
                     self._retire_slot(arena, i, resident=False)
+                    self._m_tokens["wasted_cancelled"].inc(len(st.tokens))
                     st.q.put(("error", RuntimeError("stream cancelled")))
                     continue
                 budget = st.max_new - len(st.tokens)
@@ -962,6 +1261,7 @@ class ContinuousBatcher(ARGenerator):
             wall = time.monotonic() - t0
             self._m_chunk_s.observe(wall)
             self._m_steps.inc(total_steps)
+            self._m_tokens["generated"].inc(total_steps)
             self._m_steps_per_dispatch.observe(total_steps)
             self._m_occupancy.set(active_n)
             with self._cv:
@@ -969,14 +1269,32 @@ class ContinuousBatcher(ARGenerator):
                 self._stats["steps"] += total_steps
                 self._stats["fill_sum"] += active_n / max(n, 1)
         wall_ms = round(wall * 1e3, 3)
+        now = time.monotonic()
         events: List[Tuple[_Stream, List[int], Dict[str, Any]]] = []
         requeue: List[_Stream] = []
+        spans: List[Tuple[_Stream, int]] = []
         with self._cv:
             width = arena.width
             for i, st in by_slot.items():
                 n_i = int(steps_left[i])
                 toks = [int(t) for t in out_np[i, :n_i]]
                 st.tokens.extend(toks)
+                if toks:
+                    # token-production stamps, taken HERE (dispatcher side)
+                    # so wants_chunks=False streams measure identically —
+                    # one queue-hop ahead of the caller's on_chunk clock,
+                    # which is what the 5% reconciliation pin allows for
+                    if st.t_first is None:
+                        st.t_first = now
+                        self._m_ttft_s.observe(
+                            now - st.t_start,
+                            exemplar=(st.ctx.trace_id
+                                      if st.ctx is not None else None))
+                    else:
+                        self._m_itl_s.observe((now - st.t_prev) / len(toks))
+                    st.t_prev = now
+                    if st.ctx is not None:
+                        spans.append((st, n_i))
                 if toks and st.wants_chunks:
                     events.append((st, toks, {
                         "pos": st.cur_len(), "steps": n_i,
@@ -992,9 +1310,14 @@ class ContinuousBatcher(ARGenerator):
                     # next grid width (re-prefill from the extended prefix)
                     self._retire_slot(arena, i, resident=False)
                     st.placed = False
+                    st.t_queued = now  # the next queue wait starts here
                     requeue.append(st)
             self._pending.extend(requeue)
             self._m_queue.set(len(self._pending))
+        for st, n_i in spans:
+            obs.record_span("decode_chunk", st.ctx.child(), t0, wall,
+                            engine=self.name, steps=n_i,
+                            pos=st.cur_len(), batched=active_n)
         for st, toks, info in events:
             st.q.put(("tokens", (toks, info)))
         finished = [st for st in by_slot.values()

@@ -50,6 +50,17 @@ from perceiver_io_tpu.resilience import faults
 
 Array = Any
 
+#: every way a generated token leaves the engine — the ``outcome`` label on
+#: ``decode_tokens_total``. ``delivered`` = handed to the caller at a
+#: successful stream completion; ``generated`` = sampled by a decode
+#: dispatch (the denominator: goodput = delivered / generated); the
+#: ``wasted_*`` outcomes attribute the gap — tokens a cancelled/killed
+#: stream produced but never completed, plus resident decode state an
+#: eviction discarded (an overlapping dimension: evicted tokens WERE
+#: delivered, what is wasted is the cache work behind a follow-up).
+DECODE_TOKEN_OUTCOMES = ("generated", "delivered", "wasted_cancelled",
+                         "wasted_killed", "wasted_evicted")
+
 
 @dataclasses.dataclass(frozen=True)
 class SamplingConfig:
@@ -225,6 +236,39 @@ class ARGenerator:
         self._m_chunk_s = reg.histogram(
             "generate_chunk_seconds",
             "wall time of one chunked decode dispatch", labels)
+        # -- per-stream token-level instruments (r21): the TTFT/ITL/goodput
+        # surface of production LLM serving, shared by the continuous
+        # batcher (same registration, dispatcher-side stamps there)
+        self._m_ttft_s = reg.histogram(
+            "decode_ttft_seconds",
+            "time to first token: stream enqueue -> first token produced "
+            "(exemplar-linked to the stream's trace id)", labels)
+        self._m_itl_s = reg.histogram(
+            "decode_itl_seconds",
+            "inter-token latency: gap between consecutive chunks divided "
+            "by the tokens the later chunk carries", labels)
+        self._m_queue_wait_s = reg.histogram(
+            "decode_queue_wait_seconds",
+            "stream enqueue -> arena slot bind (admission queue wait; ~0 "
+            "on the per-session engine, which never queues)", labels)
+        self._m_tokens = {
+            o: reg.counter(
+                "decode_tokens_total",
+                "tokens by lifecycle outcome (goodput = delivered / "
+                "generated; wasted_* attributes the gap — see "
+                "DECODE_TOKEN_OUTCOMES)", {**labels, "outcome": o})
+            for o in DECODE_TOKEN_OUTCOMES}
+
+    def token_stats(self) -> Dict[str, Any]:
+        """Goodput accounting snapshot: cumulative ``decode_tokens_total``
+        by outcome plus ``goodput = delivered / generated`` (None before
+        any token was generated). Shared by both engines — the continuous
+        batcher inherits it, and ``stats()`` embeds the same counters."""
+        tokens = {o: int(c.value) for o, c in self._m_tokens.items()}
+        gen = tokens["generated"]
+        return {"tokens": tokens,
+                "goodput": (round(tokens["delivered"] / gen, 4)
+                            if gen else None)}
 
     # -- width / episode planning -------------------------------------------
 
@@ -335,6 +379,7 @@ class ARGenerator:
         tokens = [int(t) for t in np.asarray(out)[0]]
         self._m_chunk_s.observe(time.monotonic() - t0)
         self._m_steps.inc(n)
+        self._m_tokens["generated"].inc(n)
         session.cache = cache
         session.next_logits = logits
         session.seq = session.seq + tokens
@@ -348,14 +393,17 @@ class ARGenerator:
         sampling: Optional[SamplingConfig] = None,
         on_chunk: Optional[Callable[[List[int], Dict[str, Any]], None]] = None,
         session: Optional[GenSession] = None,
+        trace: Optional[obs.TraceContext] = None,
     ) -> Tuple[List[int], GenSession]:
         """Generate up to ``max_new`` tokens after ``prefix``, streaming
         each chunk through ``on_chunk(tokens, info)``. Episodes re-prefill
         from the extended prefix when the latent window fills — the same
         re-encode a spilled session performs, with the position-folded key
-        stream keeping the tokens identical either way. Returns
-        ``(new_tokens, session)``; pass the session back in (with the
-        extended prefix) to continue without a fresh encode."""
+        stream keeping the tokens identical either way. ``trace`` (the
+        caller's propagated context) attaches one ``decode_stream`` span
+        covering the stream's whole life plus a ``decode_chunk`` child per
+        dispatch. Returns ``(new_tokens, session)``; pass the session back
+        in (with the extended prefix) to continue without a fresh encode."""
         sampling = (sampling or SamplingConfig()).normalized()
         prefix = [int(t) for t in prefix]
         produced: List[int] = []
@@ -364,23 +412,64 @@ class ARGenerator:
             session = None  # resident state diverged: re-encode
         if session is None:
             self._m_sessions.inc()
-        while len(produced) < max_new:
-            cur = prefix + produced
-            if len(cur) >= self.max_seq_len:
-                break  # absolute position budget exhausted
-            if session is None or session.remaining() < 1:
-                session = self.start(cur, seed=sampling.seed)
-            n = min(self.chunk, max_new - len(produced), session.remaining())
-            t0 = time.monotonic()
-            tokens = self.decode_chunk(session, sampling, n_steps=n)
-            produced.extend(tokens)
-            if on_chunk is not None:
-                on_chunk(tokens, {
-                    "pos": len(session.seq),
-                    "steps": n,
-                    "chunk_ms": round((time.monotonic() - t0) * 1e3, 3),
-                })
-        return produced, session
+        t_enter = time.monotonic()
+        ctx = trace.child() if trace is not None else None
+        exemplar = ctx.trace_id if ctx is not None else None
+        t_first: Optional[float] = None
+        t_prev = t_enter
+        ok = False
+        try:
+            while len(produced) < max_new:
+                cur = prefix + produced
+                if len(cur) >= self.max_seq_len:
+                    break  # absolute position budget exhausted
+                if session is None or session.remaining() < 1:
+                    session = self.start(cur, seed=sampling.seed)
+                n = min(self.chunk, max_new - len(produced),
+                        session.remaining())
+                t0 = time.monotonic()
+                tokens = self.decode_chunk(session, sampling, n_steps=n)
+                now = time.monotonic()
+                produced.extend(tokens)
+                if tokens:
+                    if t_first is None:
+                        t_first = now
+                        # no admission queue on the per-session engine: the
+                        # wait is entry -> first dispatch start (~0), kept
+                        # so both engines export the same instrument set
+                        self._m_queue_wait_s.observe(t0 - t_enter,
+                                                     exemplar=exemplar)
+                        self._m_ttft_s.observe(now - t_enter,
+                                               exemplar=exemplar)
+                    else:
+                        self._m_itl_s.observe((now - t_prev) / len(tokens))
+                    t_prev = now
+                    if ctx is not None:
+                        obs.record_span(
+                            "decode_chunk", ctx.child(), t0, now - t0,
+                            engine=self.name, steps=n,
+                            pos=len(session.seq))
+                if on_chunk is not None:
+                    on_chunk(tokens, {
+                        "pos": len(session.seq),
+                        "steps": n,
+                        "chunk_ms": round((now - t0) * 1e3, 3),
+                    })
+            ok = True
+            self._m_tokens["delivered"].inc(len(produced))
+            return produced, session
+        finally:
+            if not ok:
+                # the stream died (engine error or a raising on_chunk
+                # consumer): its tokens never reached a completed stream
+                self._m_tokens["wasted_killed"].inc(len(produced))
+            if ctx is not None:
+                obs.record_span(
+                    "decode_stream", ctx, t_enter,
+                    time.monotonic() - t_enter, engine=self.name,
+                    tokens=len(produced), ok=ok,
+                    ttft_s=(None if t_first is None
+                            else round(t_first - t_enter, 6)))
 
 
 def load_ar_checkpoint(

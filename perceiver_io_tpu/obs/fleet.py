@@ -172,6 +172,10 @@ class ReplicaGauges:
                 "slo_burn": self._reg.gauge(
                     "fleet_replica_slo_burn",
                     "max scraped SLO error-budget burn rate", labels),
+                "stream_burn": self._reg.gauge(
+                    "fleet_replica_stream_burn",
+                    "max scraped per-stream token-latency (TTFT/ITL) "
+                    "burn rate", labels),
                 "requests_total": self._reg.gauge(
                     "fleet_replica_requests_total",
                     "scraped replica lifetime request count (gauge: the "

@@ -76,6 +76,8 @@ SPAN_NAMES = frozenset({
     "replica_serve",          # replica-side: RPC arrival → response built
     "replica_generate",       # replica-side: one streamed generate RPC
     "generate_step",          # one chunked decode dispatch within a stream
+    "decode_stream",          # one stream's whole decode life: enqueue→retire
+    "decode_chunk",           # one batched chunk's share of a stream's life
     "deploy_swap",            # install start → bake end (fleet context)
 })
 

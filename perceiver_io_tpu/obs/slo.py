@@ -51,6 +51,15 @@ class SLO:
     which the tracker reports unhealthy (None disables the health wire).
     ``min_samples``: the health wire stays quiet below this many recorded
     requests — one bad first request must not 503 a fresh process.
+
+    ``ttft_target_s`` / ``itl_target_s`` (optional) are the STREAM-shaped
+    objectives (r21): a decode stream is good against each set target when
+    its time-to-first-token / mean inter-token latency lands inside it.
+    Request latency is the wrong signal for a token stream — a stream can
+    meet a whole-request deadline while every token arrives in stalls —
+    so each stream signal gets its own window and burn rate
+    (``slo_stream_burn_rate{signal=}``), sharing this SLO's availability
+    target, burn alert, and min-samples guard.
     """
 
     latency_target_s: float
@@ -58,6 +67,8 @@ class SLO:
     name: str = "serving"
     burn_alert: Optional[float] = 2.0
     min_samples: int = 20
+    ttft_target_s: Optional[float] = None
+    itl_target_s: Optional[float] = None
 
     def __post_init__(self):
         if self.latency_target_s <= 0:
@@ -69,6 +80,21 @@ class SLO:
                 "availability_target must lie in (0, 1) — a 1.0 target has "
                 f"zero error budget, got {self.availability_target}"
             )
+        for field in ("ttft_target_s", "itl_target_s"):
+            v = getattr(self, field)
+            if v is not None and v <= 0:
+                raise ValueError(f"{field} must be positive, got {v}")
+
+    @property
+    def stream_signals(self) -> Dict[str, float]:
+        """The configured stream objectives: ``{signal: target_s}`` over
+        ``ttft``/``itl`` (empty when this SLO is request-only)."""
+        out = {}
+        if self.ttft_target_s is not None:
+            out["ttft"] = self.ttft_target_s
+        if self.itl_target_s is not None:
+            out["itl"] = self.itl_target_s
+        return out
 
     @property
     def error_budget(self) -> float:
@@ -120,6 +146,36 @@ class SLOTracker:
             "slo_error_budget_burn_rate",
             "bad fraction / error budget over the window (1.0 = spending "
             "the budget exactly as it accrues; >1 = burning it down)", base)
+        # -- the stream signals (r21): one window + burn gauge per
+        # configured target, same availability budget/alert as requests
+        self._stream_windows: Dict[str, deque] = {}
+        self._stream_good: Dict[str, int] = {}
+        self._m_stream_burn: Dict[str, Any] = {}
+        self._m_stream_breaches: Dict[str, Any] = {}
+        if slo.stream_signals:
+            self._m_ttft_target = reg.gauge(
+                "slo_ttft_target_seconds",
+                "TTFT bound under which a decode stream counts good", base)
+            self._m_itl_target = reg.gauge(
+                "slo_itl_target_seconds",
+                "mean inter-token-latency bound under which a decode "
+                "stream counts good", base)
+            if slo.ttft_target_s is not None:
+                self._m_ttft_target.set(slo.ttft_target_s)
+            if slo.itl_target_s is not None:
+                self._m_itl_target.set(slo.itl_target_s)
+        for signal in slo.stream_signals:
+            self._stream_windows[signal] = deque(maxlen=window)
+            self._stream_good[signal] = 0
+            sig_labels = {**base, "signal": signal}
+            self._m_stream_burn[signal] = reg.gauge(
+                "slo_stream_burn_rate",
+                "bad stream fraction / error budget over the window, per "
+                "token-latency signal (ttft|itl)", sig_labels)
+            self._m_stream_breaches[signal] = reg.counter(
+                "slo_stream_breaches_total",
+                "decode streams missing a token-latency target, by signal",
+                sig_labels)
         self._name = ":".join(["slo", slo.name]
                               + [v for _, v in sorted((labels or {}).items())])
         self._registered = slo.burn_alert is not None
@@ -145,6 +201,33 @@ class SLOTracker:
         self._m_good.set(frac)
         self._m_burn.set((1.0 - frac) / self.slo.error_budget)
 
+    def record_stream(self, ttft_s: Optional[float] = None,
+                      itl_s: Optional[float] = None,
+                      ok: bool = True) -> None:
+        """Classify one finished decode stream against the configured
+        stream signals: ``ttft_s`` (enqueue -> first token) and ``itl_s``
+        (mean inter-token latency) each against their own target. A stream
+        that died (``ok=False``) is bad on every configured signal — a
+        killed stream never met its token deadline. No-op on a
+        request-only SLO."""
+        for signal, target in self.slo.stream_signals.items():
+            v = ttft_s if signal == "ttft" else itl_s
+            if ok and v is None:
+                continue  # signal unmeasured this stream (e.g. 0 tokens)
+            good = bool(ok) and v is not None and v <= target
+            with self._lock:
+                w = self._stream_windows[signal]
+                if len(w) == w.maxlen and w[0]:
+                    self._stream_good[signal] -= 1
+                w.append(good)
+                if good:
+                    self._stream_good[signal] += 1
+                n, g = len(w), self._stream_good[signal]
+            if not good:
+                self._m_stream_breaches[signal].inc()
+            self._m_stream_burn[signal].set(
+                (1.0 - g / n) / self.slo.error_budget)
+
     def good_fraction(self) -> float:
         with self._lock:
             return (self._good_in_window / len(self._window)
@@ -152,6 +235,27 @@ class SLOTracker:
 
     def burn_rate(self) -> float:
         return (1.0 - self.good_fraction()) / self.slo.error_budget
+
+    def stream_burn_rate(self, signal: Optional[str] = None) -> float:
+        """The windowed stream burn rate — one signal, or the max across
+        the configured ones (the scrape's single per-replica number).
+        0.0 on a request-only SLO or an empty window."""
+        signals = ([signal] if signal is not None
+                   else list(self._stream_windows))
+        worst = 0.0
+        with self._lock:
+            for s in signals:
+                w = self._stream_windows.get(s)
+                if not w:
+                    continue
+                frac = self._stream_good[s] / len(w)
+                worst = max(worst, (1.0 - frac) / self.slo.error_budget)
+        return worst
+
+    def stream_sample_count(self, signal: str) -> int:
+        with self._lock:
+            w = self._stream_windows.get(signal)
+            return len(w) if w is not None else 0
 
     def sample_count(self) -> int:
         with self._lock:
@@ -164,12 +268,23 @@ class SLOTracker:
         n = self.sample_count()
         alert = self.slo.burn_alert
         ok = (alert is None or n < self.slo.min_samples or burn <= alert)
-        return self._name, ok, {
+        detail = {
             "burn_rate": round(burn, 4),
             "good_fraction": round(self.good_fraction(), 4),
             "samples": n,
             "burn_alert": alert,
         }
+        # a burning stream signal degrades like a burning request signal
+        # (same alert threshold, same per-signal min-samples guard)
+        for signal in self._stream_windows:
+            s_burn = self.stream_burn_rate(signal)
+            s_n = self.stream_sample_count(signal)
+            detail[f"stream_{signal}_burn_rate"] = round(s_burn, 4)
+            detail[f"stream_{signal}_samples"] = s_n
+            if (alert is not None and s_n >= self.slo.min_samples
+                    and s_burn > alert):
+                ok = False
+        return self._name, ok, detail
 
     def close(self) -> None:
         if self._registered:

@@ -158,6 +158,7 @@ class SeriesStore:
         ("inflight", "fleet_replica_inflight", "gauge"),
         ("breaker_open", "fleet_replica_breaker_open", "gauge"),
         ("slo_burn", "fleet_replica_slo_burn", "gauge"),
+        ("stream_burn", "fleet_replica_stream_burn", "gauge"),
         ("requests_total", "fleet_replica_requests_total", "counter"),
     )
 

@@ -92,6 +92,8 @@ class AutoscalePolicy:
     scale_down_utilization: float = 0.45
     up_burn: float = 1.0
     down_burn: float = 0.5
+    up_stream_burn: float = 1.0
+    down_stream_burn: float = 0.5
     queue_high: float = 8.0
     window_s: float = 5.0
     hold_up_s: float = 1.0
@@ -127,6 +129,11 @@ class AutoscalePolicy:
                 f"down_burn ({self.down_burn}) must not exceed up_burn "
                 f"({self.up_burn}) — hysteresis opens against the firing "
                 f"direction")
+        if self.down_stream_burn > self.up_stream_burn:
+            raise ValueError(
+                f"down_stream_burn ({self.down_stream_burn}) must not "
+                f"exceed up_stream_burn ({self.up_stream_burn}) — "
+                f"hysteresis opens against the firing direction")
         if (self.hold_up_s < 0 or self.hold_down_s < 0
                 or self.cooldown_up_s < 0 or self.cooldown_down_s < 0):
             raise ValueError("hold/cooldown durations must be >= 0")
@@ -268,6 +275,11 @@ class Autoscaler:
             b = store.window_agg(key, w, "max", now=now)
             if b is not None:
                 burn = max(burn, b)
+        stream_burn = 0.0
+        for key in store.match("fleet_replica_stream_burn"):
+            b = store.window_agg(key, w, "max", now=now)
+            if b is not None:
+                stream_burn = max(stream_burn, b)
         queue_sum = 0.0
         n_queues = 0
         for key in store.match("fleet_replica_queue_depth"):
@@ -279,6 +291,7 @@ class Autoscaler:
         return {
             "demand_rps": demand if saw_rate else None,
             "burn": burn,
+            "stream_burn": stream_burn,
             "queue_per_replica": queue_sum / max(n_queues, 1),
             "replicas": replicas,
         }
@@ -308,6 +321,7 @@ class Autoscaler:
         self._m_backoff.set(max(0.0, self._spawn_retry_at - now))
 
         pressure = (sig["burn"] > p.up_burn
+                    or sig["stream_burn"] > p.up_stream_burn
                     or sig["queue_per_replica"] > p.queue_high)
         up_cond = n < p.max_replicas and (desired > n or pressure)
         # hysteresis: with one fewer replica, utilization must still sit
@@ -317,6 +331,7 @@ class Autoscaler:
             not up_cond
             and n > p.min_replicas
             and sig["burn"] < p.down_burn
+            and sig["stream_burn"] < p.down_stream_burn
             and demand / (max(n - 1, 1) * p.rps_per_replica)
             < p.scale_down_utilization
         )
@@ -363,6 +378,7 @@ class Autoscaler:
             "demand_rps": (None if sig["demand_rps"] is None
                            else round(sig["demand_rps"], 3)),
             "burn": round(sig["burn"], 4),
+            "stream_burn": round(sig["stream_burn"], 4),
             "queue_per_replica": round(sig["queue_per_replica"], 3),
             **fields,
         }

@@ -207,6 +207,7 @@ class ReplicaApp:
         assume_ready: bool = False,
         drain_timeout_s: float = 60.0,
         generator=None,
+        stream_slo: Optional[obs.SLO] = None,
     ):
         if not engines:
             raise ValueError("ReplicaApp needs at least one engine")
@@ -227,6 +228,13 @@ class ReplicaApp:
         # pinned by the router exactly like the latent-cache sessions
         self.generator = generator
         self._gen_store = None
+        # stream-shaped SLO (TTFT/ITL targets): classified from the
+        # caller-visible frame clock in generate(), scraped as stream_burn
+        self.stream_slo_tracker = None
+        if (generator is not None and stream_slo is not None
+                and stream_slo.stream_signals):
+            self.stream_slo_tracker = obs.SLOTracker(
+                stream_slo, registry=reg, labels={"replica": name})
         self._gen_lock = threading.Lock()
         self._gen_active = 0        # streams in flight (under _gen_lock)
         self._gen_requests = 0      # streams served (under _gen_lock)
@@ -368,9 +376,22 @@ class ReplicaApp:
         serve_ctx = trace.child() if trace is not None else None
         resident = self._gen_store.match(session, prefix)
         chunks = 0
+        # the caller-visible frame clock: TTFT/ITL as this stream's consumer
+        # experienced them (the ground truth the engine histograms reconcile
+        # against, and the sample the stream SLO classifies)
+        t_first: Optional[float] = None
+        t_prev = t0
+        itl_sum, itl_n = 0.0, 0
 
         def chunk_cb(tokens: List[int], info: Dict[str, Any]) -> None:
-            nonlocal chunks
+            nonlocal chunks, t_first, t_prev, itl_sum, itl_n
+            now = time.monotonic()
+            if t_first is None:
+                t_first = now
+            elif tokens:
+                itl_sum += now - t_prev
+                itl_n += len(tokens)
+            t_prev = now
             chunks += 1
             self._m_gen_tokens.inc(len(tokens))
             if serve_ctx is not None:
@@ -387,8 +408,13 @@ class ReplicaApp:
         try:
             tokens, ses = self.generator.generate(
                 prefix, max_new, sampling, on_chunk=chunk_cb,
-                session=resident)
+                session=resident, trace=serve_ctx)
         except BaseException as e:
+            if self.stream_slo_tracker is not None:
+                # a died stream is bad on every configured stream signal
+                self.stream_slo_tracker.record_stream(
+                    ttft_s=(None if t_first is None else t_first - t0),
+                    itl_s=(itl_sum / itl_n if itl_n else None), ok=False)
             if serve_ctx is not None:
                 obs.record_span(
                     "replica_generate", serve_ctx, t0,
@@ -408,6 +434,10 @@ class ReplicaApp:
             # reason-labeled, so drills assert on metrics, not logs
             self._gen_store.remove(session, "finished")
         self._m_gen_requests.inc()
+        if self.stream_slo_tracker is not None:
+            self.stream_slo_tracker.record_stream(
+                ttft_s=(None if t_first is None else t_first - t0),
+                itl_s=(itl_sum / itl_n if itl_n else None), ok=True)
         summary = {
             "done": True,
             "tokens_total": len(tokens),
@@ -526,6 +556,15 @@ class ReplicaApp:
                 "backlog": backlog, "breaker_open": b_open,
                 "slo_burn": round(burn, 4),
             }
+        stream_burn = 0.0
+        tr = self.stream_slo_tracker
+        if tr is not None:
+            for signal in tr.slo.stream_signals:
+                # same min_samples quiet period as the request burn: one
+                # slow first stream must not degrade a fresh replica
+                if tr.stream_sample_count(signal) >= tr.slo.min_samples:
+                    stream_burn = max(stream_burn,
+                                      tr.stream_burn_rate(signal))
         with self._sessions_lock:
             sessions = len(self._sessions)
         with self._gen_lock:
@@ -544,6 +583,7 @@ class ReplicaApp:
             "inflight": inflight + gen_active,
             "breaker_open": breaker_open,
             "slo_burn": round(slo_burn, 4),
+            "stream_burn": round(stream_burn, 4),
             "params_version": int(self._m_version.value),
             "sessions": sessions,
             "generate_sessions": (len(self._gen_store)
@@ -563,6 +603,8 @@ class ReplicaApp:
         closer = getattr(self.generator, "close", None)
         if closer is not None:
             closer()
+        if self.stream_slo_tracker is not None:
+            self.stream_slo_tracker.close()
 
 
 def _scale_tree(tree, factor: float):
@@ -1117,6 +1159,13 @@ def build_parser() -> argparse.ArgumentParser:
     eng.add_argument("--heartbeat_deadline_s", type=float, default=None)
     eng.add_argument("--slo_p99_ms", type=float, default=None)
     eng.add_argument("--slo_availability", type=float, default=0.999)
+    eng.add_argument("--slo_ttft_ms", type=float, default=None,
+                     help="generate task: time-to-first-token target — "
+                          "streams over it burn the stream SLO "
+                          "(stream_burn in the scrape)")
+    eng.add_argument("--slo_itl_ms", type=float, default=None,
+                     help="generate task: mean inter-token-latency target "
+                          "per stream (same burn wire as --slo_ttft_ms)")
     eng.add_argument("--trace_sample", type=float, default=0.0,
                      help="head-sampling rate for engine-MINTED traces, "
                           "i.e. requests arriving without a propagated "
@@ -1299,6 +1348,7 @@ def _build_generate_app(args):
             max_slots=args.decode_slots * 8,
             compute_dtype=compute_dtype, name=f"{args.name}-gen",
             compile_cache=args.compile_cache,
+            heartbeat_deadline_s=args.heartbeat_deadline_s,
         )
     else:
         generator = ARGenerator(
@@ -1315,6 +1365,17 @@ def _build_generate_app(args):
         slo = obs.SLO(latency_target_s=args.slo_p99_ms / 1e3,
                       availability_target=args.slo_availability,
                       name=args.name, burn_alert=None)
+    stream_slo = None
+    if args.slo_ttft_ms is not None or args.slo_itl_ms is not None:
+        stream_slo = obs.SLO(
+            latency_target_s=(args.slo_p99_ms / 1e3
+                              if args.slo_p99_ms is not None else 1.0),
+            availability_target=args.slo_availability,
+            name=f"{args.name}-stream", burn_alert=None,
+            ttft_target_s=(args.slo_ttft_ms / 1e3
+                           if args.slo_ttft_ms is not None else None),
+            itl_target_s=(args.slo_itl_ms / 1e3
+                          if args.slo_itl_ms is not None else None))
     engines = {
         "infer": ServingEngine(
             infer_apply, params, name=f"{args.name}-infer",
@@ -1334,7 +1395,7 @@ def _build_generate_app(args):
     app = ReplicaApp(
         engines, params, params_factory=params_factory, name=args.name,
         assume_ready=args.no_warmup, drain_timeout_s=args.drain_timeout_s,
-        generator=generator,
+        generator=generator, stream_slo=stream_slo,
     )
     return app, max_seq_len
 
@@ -1403,6 +1464,12 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         # not drop the queue)
         print(f"replica {args.name!r}: signal {signum} — draining",
               file=sys.stderr, flush=True)
+        flight = getattr(app.generator, "flight", None)
+        if flight is not None:
+            # last words: the scheduler's recent decision ring goes to the
+            # event log BEFORE the drain, so a post-mortem on a killed
+            # replica sees why its final rounds idled
+            flight.dump(f"signal_{signum}")
         app.quit_event.set()
 
     for sig in (signal.SIGTERM, signal.SIGINT):

@@ -326,6 +326,7 @@ class Router:
                 inflight=float(slot.inflight),
                 breaker_open=1.0 if s.get("breaker_open") else 0.0,
                 slo_burn=float(s.get("slo_burn", 0.0) or 0.0),
+                stream_burn=float(s.get("stream_burn", 0.0) or 0.0),
                 requests_total=(None if s.get("requests_total") is None
                                 else float(s["requests_total"])),
             )
@@ -366,6 +367,13 @@ class Router:
         if (self.burn_degrade is not None
                 and float(s.get("slo_burn", 0.0) or 0.0) > self.burn_degrade):
             return _fleet.DEGRADED
+        if (self.burn_degrade is not None
+                and float(s.get("stream_burn", 0.0) or 0.0)
+                > self.burn_degrade):
+            # token-latency burn degrades placement exactly like request
+            # burn: a replica streaming stalled tokens is a bad pick even
+            # when its whole-request latencies still clear the target
+            return _fleet.DEGRADED
         return _fleet.SERVING
 
     def statuses(self) -> Dict[str, Dict[str, Any]]:
@@ -380,6 +388,7 @@ class Router:
                 "router_inflight": slot.inflight,
                 "queue_depth": s.get("queue_depth", 0),
                 "slo_burn": s.get("slo_burn", 0.0),
+                "stream_burn": s.get("stream_burn", 0.0),
                 "breaker_open": bool(s.get("breaker_open")),
                 "params_version": s.get("params_version", 0),
                 "scrape_age_s": round(slot.scrape_age(), 3),

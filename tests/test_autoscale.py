@@ -602,6 +602,10 @@ _STUB_REPLICA = textwrap.dedent("""\
 """)
 
 
+@pytest.mark.slow  # tier-1 budget (r21): drain-then-retire scale-down
+# semantics (lost_accepted == 0) stay tier-1 in the in-process
+# CallbackPool autoscale tests; the real-process SIGTERM/port drill runs
+# in the full tier
 def test_supervisor_retire_drains_sigterms_and_releases_port(tmp_path):
     """The retire path (satellite 3): graceful drain RPC → SIGTERM exit 0
     → port released; the babysitter never restarts a retirement; and

@@ -84,6 +84,11 @@ def _fan_out(bat, cases):
 # -- stream identity: the correctness spine -----------------------------------
 
 
+@pytest.mark.slow  # tier-1 budget (r21): admission-churn + slot lifecycle
+# stay tier-1 in test_continuous_admit_retire_mid_sweep and the stream-
+# observability reconciliation tests (tests/test_stream_obs.py, which run
+# the same batcher end to end); the 8-stream token-identity oracle sweep
+# runs in the full tier
 def test_batched_streams_match_per_session_oracle(tiny, oracle, batcher,
                                                   rng):
     """8 concurrent mixed streams (greedy + sampled, episode-crossing

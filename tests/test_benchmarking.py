@@ -46,6 +46,10 @@ def test_time_train_step_returns_positive_and_advances_state():
     assert int(jax.device_get(final_state.step)) >= 7
 
 
+@pytest.mark.slow  # tier-1 budget (r21): the timing-harness contract
+# (scan-chained on-device iteration, per-step normalization) stays tier-1
+# in test_scanned_step_cost_analysis_is_per_step; this is the prebuilt-
+# jit entry-point variant
 def test_time_train_step_accepts_prebuilt_jit():
     train_step, state, batch = _tiny_setup()
     jitted = jax.jit(train_step, donate_argnums=(0,))

@@ -293,6 +293,9 @@ def test_prefer_latest_falls_back_past_corrupt_newest_step(tmp_path,
             restore_train_state(directory, like, prefer_latest=True)
 
 
+@pytest.mark.slow  # tier-1 budget (r21): single-process checkpoint round-
+# trip stays tier-1 in test_save_restore_round_trip; zero3 sharding-rule
+# correctness stays in tests/test_sharding.py::test_zero3_param_sharding
 def test_zero3_sharded_state_round_trip(tmp_path, state_and_batch):
     """A ZeRO-3-sharded TrainState (params AND opt-state over the data axis)
     checkpoints and restores: saved values equal the sharded originals, and

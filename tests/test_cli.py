@@ -133,6 +133,10 @@ def test_train_mlm_then_transfer(tmp_path):
     assert max(r["step"] for r in rows) == 5
 
 
+@pytest.mark.slow  # tier-1 budget (r21): the serve CLI pipeline stays
+# tier-1 via test_serve_metrics_sidecar_end_to_end (same train+serve path
+# plus the sidecar); engine fused==cached parity stays in
+# tests/test_engine.py::test_mlm_server_latent_cache_decode_many
 def test_serve_cli_end_to_end(tmp_path):
     """Train a tiny MLM, then serve it through the micro-batching engine CLI:
     fused, latent-cache, and bf16 paths all answer, fused == cached, and the
@@ -219,6 +223,10 @@ def test_serve_cli_end_to_end(tmp_path):
         serve.main(base)
 
 
+@pytest.mark.slow  # tier-1 budget (r21): the one-JSON-line bench-CLI
+# contract stays tier-1 via test_coldstart_bench_cpu_emits_one_json_line
+# and the load_bench --dry/--cpu contract tests; the engine A/B itself is
+# a tools-only path with no serving-side coverage gap
 def test_inference_bench_engine_cpu_emits_one_json_line(tmp_path):
     """tools/inference_bench.py --engine --cpu runs the full serving-engine
     A/B offline and emits EXACTLY one JSON line on stdout (the driver's
@@ -560,8 +568,17 @@ def test_load_bench_dry_emits_schema_json_line():
     assert record["generate"] is None
     for key in ("offered_streams", "completed", "failed", "tokens_total",
                 "steps_per_s", "stream_p99_ms", "followups", "resumed",
-                "reroutes", "spills"):
+                "reroutes", "spills", "stream"):
         assert key in record["generate_keys"], record
+    # the token-level streaming sub-block (r21) declares its keys: caller-
+    # clock TTFT/ITL, engine-side goodput, flight-recorder idle attribution
+    for key in ("ttft_p50_ms", "ttft_p95_ms", "itl_p50_ms", "itl_p95_ms",
+                "streams_timed", "tokens_generated", "tokens_delivered",
+                "tokens_wasted", "goodput", "idle_slot_rounds",
+                "idle_attributed", "idle_attribution_frac", "idle_causes"):
+        assert key in record["stream_keys"], record
+    # the generate-class trace A/B rides the trace block
+    assert "generate_ab" in record["trace_keys"], record
 
 
 def test_load_bench_cpu_sweep_shows_saturation_signature(tmp_path):
@@ -622,6 +639,87 @@ def test_load_bench_cpu_sweep_shows_saturation_signature(tmp_path):
     assert 0.9 <= record["phase_sum_ratio"] <= 1.1, record["phase_sum_ratio"]
 
 
+@pytest.mark.slow  # tier-1 budget (r21): the TTFT/ITL/goodput/attribution
+# semantics this run exercises stay tier-1 at the engine level in
+# tests/test_stream_obs.py (reconciliation + flight kill drill) and the
+# schema contract stays tier-1 in test_load_bench_dry_emits_schema_json_line;
+# this is the full-stack subprocess run (router -> batched replica ->
+# flight recorder -> record assembly), ~65 s of warmup-dominated wall
+def test_load_bench_cpu_generate_stream_block_populates_finite():
+    """A --generate_rps --decode_batching --trace_ab run populates every
+    stream key with a FINITE value: caller-clock TTFT/ITL percentiles,
+    engine-side goodput accounting, the flight recorder's idle attribution
+    (>= 0.95 — the acceptance bar), and the generate-class traced-vs-
+    untraced A/B block."""
+    import math
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "load_bench.py"),
+         "--cpu", "--duration_s", "1.5", "--calibration_waves", "1",
+         "--calibration_wave_size", "8", "--rate_factors", "0.8",
+         "--replicas", "1", "--generate_rps", "8", "--decode_batching",
+         "--trace_ab", "--trace_ab_waves", "2"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    record = json.loads(lines[0])
+    stream = record["generate"]["stream"]
+    for key in ("ttft_p50_ms", "ttft_p95_ms", "itl_p50_ms", "itl_p95_ms"):
+        assert isinstance(stream[key], float) and stream[key] > 0, stream
+        assert math.isfinite(stream[key]), stream
+    assert stream["ttft_p95_ms"] >= stream["ttft_p50_ms"]
+    assert stream["streams_timed"] > 0
+    # goodput ledger: generated >= delivered, wasted accounts the gap
+    assert stream["tokens_generated"] >= stream["tokens_delivered"] > 0
+    assert stream["tokens_wasted"] == (stream["tokens_generated"]
+                                       - stream["tokens_delivered"])
+    assert 0.0 < stream["goodput"] <= 1.0
+    # the flight recorder attributed the idleness (acceptance: >= 95%)
+    assert stream["idle_slot_rounds"] >= 0
+    assert stream["idle_attribution_frac"] >= 0.95, stream
+    assert set(stream["idle_causes"]) == {
+        "no_pending", "width_mismatch", "arena_full", "draining"}
+    assert (sum(stream["idle_causes"].values())
+            == stream["idle_attributed"])
+    # the generate-class A/B populated alongside the request-class one
+    gen_ab = record["trace"]["generate_ab"]
+    assert gen_ab["untraced_tokens_per_s"] > 0
+    assert gen_ab["traced_tokens_per_s"] > 0
+    assert gen_ab["decode_events_recorded"] > 0
+    assert math.isfinite(gen_ab["overhead_pct"])
+    # the built-in null control: same paired waves, log hooked in NEITHER
+    # arm — readers judge overhead_pct against this floor, not against 0
+    assert math.isfinite(gen_ab["null_overhead_pct"])
+
+
+def test_decode_flight_dry_emits_schema_json_line():
+    """tools/decode_flight.py --dry emits EXACTLY one JSON line declaring
+    the attribution-record keys without touching any backend."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "decode_flight.py"),
+         "--dry"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    record = json.loads(lines[0])
+    assert record["metric"] == "decode_flight" and record["dry"] is True
+    for key in ("rounds", "slot_rounds", "idle_slot_rounds", "attributed",
+                "attribution_frac", "causes", "evicts", "grows",
+                "pending_max", "dumps", "dump_reasons", "drill"):
+        assert key in record["record_keys"], record
+
+
 def test_deploy_bench_dry_emits_schema_json_line():
     """tools/deploy_bench.py --dry emits EXACTLY one JSON line declaring the
     record + per-swap keys without touching any backend."""
@@ -647,6 +745,10 @@ def test_deploy_bench_dry_emits_schema_json_line():
         "step", "action", "gate_ms", "swap_ms", "p99_ms", "n_window"]
 
 
+@pytest.mark.slow  # tier-1 budget (r21): gated-rollout + zero-lost-
+# accepted semantics stay tier-1 in tests/test_deploy.py::
+# test_fleet_deploy_chaos_e2e (real fleet, chaos injection); this is the
+# bench-CLI wrapper over the same loop
 def test_deploy_bench_cpu_gated_swaps_zero_loss(tmp_path):
     """The deployment-loop acceptance contract: tools/deploy_bench.py --cpu
     pushes N publications through gate + hot-swap under open-loop traffic

@@ -593,7 +593,7 @@ def test_load_bench_dry_fleet_schema():
     assert record["trace"] is None
     assert record["trace_keys"] == [
         "ab_waves", "untraced_rps", "traced_rps", "overhead_pct",
-        "spans_recorded"]
+        "spans_recorded", "generate_ab"]
 
 
 # -- distributed request tracing (r15) ----------------------------------------

@@ -61,7 +61,13 @@ PRESETS = {
 }
 
 
-@pytest.mark.parametrize("name", sorted(PRESETS))
+@pytest.mark.parametrize("name", [
+    "tiny_ar",
+    # tier-1 budget (r21): the incremental==dense parity property stays
+    # tier-1 on tiny_ar (same per-position algebra); the structural
+    # flagship config runs in the full tier
+    pytest.param("flagship_ar", marks=pytest.mark.slow),
+])
 def test_incremental_matches_dense_forward(name, rng):
     """Token-t logits from the cached step == dense full-prefix forward at
     2e-5 (f32) — for every step of a short generation, including across

@@ -229,6 +229,10 @@ def test_loss_weights():
     assert float(metrics["acc"]) == 1.0
 
 
+@pytest.mark.slow  # tier-1 budget (r21): multimodal loss plumbing stays
+# tier-1 via the autoencoder tests here and tests/test_sharding.py::
+# test_multimodal_autoencoder_sharded; the patch==pixel equivalence sweep
+# runs in the full tier
 def test_video_patch_loss_matches_pixel_loss():
     """video_patch_loss=True computes the SAME reconstruction loss (to fp
     reassociation) without the un-patchify transpose pair: the adapter keeps

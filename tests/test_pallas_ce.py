@@ -122,7 +122,9 @@ class TestPallasLinearCE:
 
 class TestMLMFusedHeadPallas:
     @pytest.mark.slow  # near-duplicate of tests/test_train_steps.py::
-    # test_mlm_step_fused_head_matches_unfused, which stays tier-1
+    # test_mlm_step_fused_head_matches_unfused (full tier); op-level
+    # fused-head value+grad parity stays tier-1 in
+    # test_train_steps.py::test_fused_head_matches_unfused
     def test_train_step_matches_unfused(self, rng):
         """fused_head='pallas' must reproduce the unfused loss trajectory
         (gradient equivalence through Adam updates)."""

@@ -284,6 +284,10 @@ def test_fused_head_matches_unfused(rng):
         np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 budget (r21): fused-vs-unfused value+grad parity
+# stays tier-1 at the op level (test_fused_head_matches_unfused,
+# test_fused_head_with_padded_vocab); the CLI flag e2e stays in
+# tests/test_cli.py::test_train_mlm_fused_head_flag
 def test_mlm_step_fused_head_matches_unfused(rng):
     """Full MLM train step: fused_head=True tracks the unfused loss/grads."""
     import jax

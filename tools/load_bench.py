@@ -101,7 +101,13 @@ DEPLOY_KEYS = ("publish_every_s", "publishes", "swaps", "rejects",
 # interleaved traced-vs-untraced closed-loop waves; overhead_pct is the
 # throughput cost of full tracing (PERF.md §Tracing bar: <= 2% on CPU)
 TRACE_KEYS = ("ab_waves", "untraced_rps", "traced_rps", "overhead_pct",
-              "spans_recorded")
+              "spans_recorded",
+              # nested generate-class A/B (--generate_rps runs only, null
+              # otherwise): the token-level streaming instrumentation's
+              # own overhead bar — traced-vs-untraced tokens/s by the same
+              # paired-interleave discipline, counting decode_* spans and
+              # flight-recorder events in the traced arm
+              "generate_ab")
 # the alerts block of a --series_jsonl run (null otherwise): the
 # timeseries+alerting ride-along — registry sampled on a cadence during the
 # sweep, context-default alert rules evaluated over the windowed series
@@ -160,7 +166,20 @@ GENERATE_KEYS = ("offered_streams", "completed", "failed", "shed",
                  # ar_decode_slot_occupancy is the mean decode batch fill
                  # the weight stream amortized over
                  "decode_batched", "ar_decode_slot_occupancy",
-                 "steps_per_dispatch", "dispatches", "arena_slots")
+                 "steps_per_dispatch", "dispatches", "arena_slots",
+                 # nested token-level streaming block (STREAM_KEYS)
+                 "stream")
+# the stream sub-block of the generate record: caller-clock TTFT/ITL
+# percentiles (stamped from the on_tokens frames the load generator
+# receives — the ground truth the engine-side decode_ttft/itl histograms
+# must reconcile against), engine-side goodput accounting
+# (decode_tokens_total by outcome; goodput = delivered/generated), and the
+# flight recorder's idle-slot-round attribution (batched engines only —
+# null per-key when the per-session engine served the class)
+STREAM_KEYS = ("ttft_p50_ms", "ttft_p95_ms", "itl_p50_ms", "itl_p95_ms",
+               "streams_timed", "tokens_generated", "tokens_delivered",
+               "tokens_wasted", "goodput", "idle_slot_rounds",
+               "idle_attributed", "idle_attribution_frac", "idle_causes")
 # the generate class's sampling shape — ONE definition shared by the load
 # generator and the per-replica warmup (greedy vs top-k are distinct decode
 # programs; a mismatch would re-introduce mid-stream compile stalls)
@@ -335,6 +354,84 @@ def _trace_ab(submit, reqs, waves: int, wave_size: int,
         "traced_rps": round(traced_rps, 3),
         "overhead_pct": round(100.0 * paired, 3),
         "spans_recorded": spans,
+    }
+
+
+def _generate_trace_ab(router, waves: int, wave_size: int, seed: int,
+                       vocab: int = 503, max_new: int = 8) -> Dict:
+    """Traced-vs-untraced A/B on the GENERATE class — the overhead bar for
+    the token-level streaming instrumentation (per-stream spans, TTFT/ITL
+    stamps, goodput counters, flight-recorder spooling). Same paired-
+    interleave wave engine as ``_trace_ab``, but each wave is
+    ``wave_size`` SEQUENTIAL streams (generation runs on the caller's
+    thread) and the rate is tokens/s, the unit the per-token stamps tax.
+    The traced arm's event file is scanned for decode_* spans and flight
+    events — zero recorded means the arm never actually armed.
+
+    A NULL pass runs first: the same paired wave structure with the event
+    log hooked in NEITHER arm, so ``null_overhead_pct`` measures the
+    pairing noise floor of this run in this process. An ``overhead_pct``
+    inside the null envelope is indistinguishable from zero — on the
+    single-core CPU box the null floor is several points wide (thread
+    scheduling, not instrument cost; PERF.md §Streaming observability),
+    which is why the record carries its own control."""
+    import tempfile
+
+    import perceiver_io_tpu.obs as obs
+
+    tmp = tempfile.NamedTemporaryFile(prefix="load_bench_genab_",
+                                      suffix=".jsonl", delete=False)
+    tmp.close()
+    rng = np.random.default_rng(seed + 13)
+    decode_events = 0
+
+    def run_pass(tag: str,
+                 arm_log_path: Optional[str]) -> Dict[bool, List[float]]:
+        rates: Dict[bool, List[float]] = {False: [], True: []}
+        for w in range(2 * waves):
+            traced = bool(w % 2) ^ bool((w // 2) % 2)
+            obs.configure_event_log(arm_log_path if traced else None)
+            t0 = time.monotonic()
+            toks = 0
+            for i in range(wave_size):
+                prefix = [int(t) for t in rng.integers(3, vocab, 8)]
+                res = router.generate(
+                    prefix, session=f"genab-{tag}-{w}-{i}",
+                    max_new=max_new, temperature=GENERATE_TEMPERATURE,
+                    top_k=GENERATE_TOP_K, seed=seed)
+                toks += len(res["tokens"])
+            rates[traced].append(toks / (time.monotonic() - t0))
+        return rates
+
+    try:
+        null_rates = run_pass("null", None)
+        rates = run_pass("real", tmp.name)
+        with open(tmp.name) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                ev = rec.get("event")
+                if ev == "span" and str(rec.get("name", "")
+                                        ).startswith("decode_"):
+                    decode_events += 1
+                elif ev in ("decode_flight_batch", "decode_flight_dump"):
+                    decode_events += 1
+    finally:
+        # unhook FIRST (same discipline as _trace_ab): a raised wave must
+        # not leave the global log writing into the unlinked inode
+        obs.configure_event_log(None)
+        os.unlink(tmp.name)
+    _, _, null_paired = _paired_overhead(null_rates)
+    untraced, traced_tps, paired = _paired_overhead(rates)
+    return {
+        "ab_waves": waves,
+        "untraced_tokens_per_s": round(untraced, 3),
+        "traced_tokens_per_s": round(traced_tps, 3),
+        "overhead_pct": round(100.0 * paired, 3),
+        "null_overhead_pct": round(100.0 * null_paired, 3),
+        "decode_events_recorded": decode_events,
     }
 
 
@@ -606,6 +703,8 @@ class _GenerateLoad:
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self._walls: List[float] = []
+        self._ttfts: List[float] = []
+        self._itls: List[float] = []
         self._threads: List[threading.Thread] = []
         self.offered = self.completed = self.failed = self.shed = 0
         self.tokens = self.steps_window_tokens = 0
@@ -626,10 +725,27 @@ class _GenerateLoad:
             prefix = [int(t) for t in
                       self.rng.integers(3, self.vocab, plen)]
             t0 = time.monotonic()
+            # caller-clock frame stamps: TTFT is first-frame arrival, ITL
+            # is the per-token inter-frame gap — the ground truth the
+            # engine-side decode_ttft/itl histograms reconcile against
+            frames = {"t_first": None, "t_prev": t0,
+                      "itl_sum": 0.0, "itl_n": 0}
+
+            def on_tokens(tokens, info, _f=frames):
+                now = time.monotonic()
+                if not tokens:
+                    return
+                if _f["t_first"] is None:
+                    _f["t_first"] = now
+                else:
+                    _f["itl_sum"] += now - _f["t_prev"]
+                    _f["itl_n"] += len(tokens)
+                _f["t_prev"] = now
+
             res = self.router.generate(
                 prefix, session=f"genload-{i}", max_new=max_new,
                 temperature=GENERATE_TEMPERATURE, top_k=GENERATE_TOP_K,
-                seed=self.seed, client=self.client)
+                seed=self.seed, on_tokens=on_tokens, client=self.client)
             toks = res["tokens"]
             res2 = None
             if followup and toks and len(prefix) + len(toks) + 4 < self.max_seq_len:
@@ -640,6 +756,10 @@ class _GenerateLoad:
                 toks = toks + res2["tokens"]
             wall = time.monotonic() - t0
             with self._lock:
+                if frames["t_first"] is not None:
+                    self._ttfts.append(frames["t_first"] - t0)
+                if frames["itl_n"]:
+                    self._itls.append(frames["itl_sum"] / frames["itl_n"])
                 self.completed += 1
                 self.tokens += len(toks)
                 self.reroutes += res["reroutes"]
@@ -712,6 +832,24 @@ class _GenerateLoad:
                 "mean_new": self.mean_new,
                 "prefix_lens": self.prefix_lens,
                 "concurrency": self.concurrency,
+                # caller-clock token-level latency; the engine-side
+                # goodput/flight fields are filled by the record assembly
+                # (key set fixed by STREAM_KEYS either way)
+                "stream": {
+                    "ttft_p50_ms": _ms(_pct(self._ttfts, 0.5)),
+                    "ttft_p95_ms": _ms(_pct(self._ttfts, 0.95)),
+                    "itl_p50_ms": _ms(_pct(self._itls, 0.5)),
+                    "itl_p95_ms": _ms(_pct(self._itls, 0.95)),
+                    "streams_timed": len(self._ttfts),
+                    "tokens_generated": None,
+                    "tokens_delivered": None,
+                    "tokens_wasted": None,
+                    "goodput": None,
+                    "idle_slot_rounds": None,
+                    "idle_attributed": None,
+                    "idle_attribution_frac": None,
+                    "idle_causes": None,
+                },
             }
 
 
@@ -974,6 +1112,7 @@ def main() -> None:
             "autoscale_keys": list(AUTOSCALE_KEYS),
             "admission_keys": list(ADMISSION_KEYS),
             "generate_keys": list(GENERATE_KEYS),
+            "stream_keys": list(STREAM_KEYS),
             "sweep": [], "capacity": None, "fleet": None, "deploy": None,
             "trace": None, "alerts": None, "series_ab": None,
             "autoscale": None, "admission": None, "generate": None,
@@ -1200,6 +1339,14 @@ def main() -> None:
         trace_record = _trace_ab(submit, reqs, args.trace_ab_waves,
                                  args.calibration_wave_size,
                                  args.drain_timeout_s)
+        # the generate-class arm runs BEFORE gen_load starts (and before
+        # the sweep): the paired waves own the router, so the tokens/s
+        # ratio measures instrumentation, not contention
+        trace_record["generate_ab"] = None
+        if args.generate_rps > 0:
+            trace_record["generate_ab"] = _generate_trace_ab(
+                router, args.trace_ab_waves,
+                max(4, args.calibration_wave_size // 4), args.seed)
         _log(f"trace A/B: {json.dumps(trace_record)}")
     series_ab_record = None
     if args.series_ab:
@@ -1520,6 +1667,38 @@ def main() -> None:
             "arena_slots": (sum(s["slots"] for s in batched)
                             if batched else None),
         })
+        # engine-side goodput accounting (token_stats is shared by both
+        # engine types) + the flight recorder's idle-slot-round attribution
+        # (batched engines only)
+        token_stats = [r.app.generator.token_stats() for r in local_replicas
+                       if hasattr(getattr(r.app, "generator", None),
+                                  "token_stats")]
+        stream = generate_record["stream"]
+        if token_stats:
+            tok = {o: sum(t["tokens"][o] for t in token_stats)
+                   for o in token_stats[0]["tokens"]}
+            gen_n = tok["generated"]
+            stream.update(
+                tokens_generated=gen_n,
+                tokens_delivered=tok["delivered"],
+                tokens_wasted=sum(v for o, v in tok.items()
+                                  if o.startswith("wasted_")),
+                goodput=(round(tok["delivered"] / gen_n, 4)
+                         if gen_n else None))
+        flights = [s["flight"] for s in batched if "flight" in s]
+        if flights:
+            idle = sum(f["idle_slot_rounds"] for f in flights)
+            attributed = sum(f["attributed"] for f in flights)
+            causes: Dict[str, int] = {}
+            for f in flights:
+                for c, n in f["causes"].items():
+                    causes[c] = causes.get(c, 0) + n
+            stream.update(
+                idle_slot_rounds=idle,
+                idle_attributed=attributed,
+                idle_attribution_frac=(round(attributed / idle, 4)
+                                       if idle else 1.0),
+                idle_causes=causes)
         _log(f"generate: {json.dumps(generate_record)}")
 
     admission_record = None
