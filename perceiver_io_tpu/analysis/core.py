@@ -209,6 +209,53 @@ def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
                         yield os.path.join(dirpath, name)
 
 
+def scan_orphan_bytecode(root: str,
+                         targets: Sequence[str] = DEFAULT_TARGETS,
+                         ) -> List[Finding]:
+    """PIT-BYTECODE: orphan bytecode that can shadow (or resurrect) a
+    DELETED module.
+
+    Python 3 imports sourceless ``mod.pyc`` files sitting where ``mod.py``
+    would be — so a legacy-layout pyc left behind after its source is
+    deleted keeps the dead module importable (stale code runs, renames
+    half-apply). ``__pycache__`` pycs never load without their source, but
+    an orphan there is residue from a deleted module all the same — the
+    repo-hygiene check flags both so a deleted module is GONE."""
+    findings: List[Finding] = []
+
+    def finding(pyc_path: str, message: str) -> Finding:
+        rel = os.path.relpath(pyc_path, root).replace(os.sep, "/")
+        return Finding(rule="PIT-BYTECODE", path=rel, line=1, scope="",
+                       message=message)
+
+    for target in targets:
+        top = os.path.join(root, target)
+        if os.path.isfile(top) or not os.path.isdir(top):
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = sorted(d for d in dirnames if not d.startswith("."))
+            in_cache = os.path.basename(dirpath) == "__pycache__"
+            src_dir = os.path.dirname(dirpath) if in_cache else dirpath
+            for name in sorted(filenames):
+                if not name.endswith((".pyc", ".pyo")):
+                    continue
+                stem = name.split(".", 1)[0]
+                has_src = os.path.exists(
+                    os.path.join(src_dir, stem + ".py"))
+                pyc = os.path.join(dirpath, name)
+                if not in_cache:
+                    findings.append(finding(
+                        pyc, f"legacy-layout bytecode {name!r} is "
+                             f"importable {'alongside' if has_src else 'in place of deleted'} "
+                             f"'{stem}.py' — delete it (sourceless pycs "
+                             f"shadow the package layout)"))
+                elif not has_src:
+                    findings.append(finding(
+                        pyc, f"orphan bytecode for deleted module "
+                             f"'{stem}.py' — delete the residue"))
+    return findings
+
+
 def scan_paths(paths: Sequence[str], rules: Optional[Sequence[Rule]] = None,
                root: Optional[str] = None) -> List[Finding]:
     """Run the static rules over every ``.py`` under ``paths``.

@@ -268,6 +268,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "health-aware dispatch, transparent failover when a "
                         "replica dies, latent-cache affinity under --cached. "
                         "0 (default) = the single-process engine")
+    f.add_argument("--transport", choices=("http", "uds", "shmem"),
+                   default="http",
+                   help="with --replicas: the router→replica data plane for "
+                        "array RPCs — 'http' (portable default), 'uds' "
+                        "(pipelined unix-socket frames), 'shmem' (shared-"
+                        "memory slot slab + uds control channel). Admin "
+                        "verbs and streamed generate always ride HTTP")
     f.add_argument("--drain_timeout_s", type=float, default=60.0,
                    help="graceful-drain bound: on SIGTERM/SIGINT (and fleet "
                         "shutdown) stop admission and wait up to this long "
@@ -1006,7 +1013,8 @@ def _serve_fleet(args, drain_state):
                 name, port,
                 extra=[*extra, "--events_jsonl",
                        f"{args.events_jsonl}.{name}",
-                       "--events_max_mb", str(args.events_max_mb)])
+                       "--events_max_mb", str(args.events_max_mb)],
+                transport=args.transport)
 
         sup_kw["argv_builder"] = _replica_argv
     admission = None
@@ -1041,7 +1049,8 @@ def _serve_fleet(args, drain_state):
               + (f", per-client quota {quota[0]:g} req/s burst {quota[1]:g}"
                  if quota else ""), file=sys.stderr, flush=True)
     with ReplicaSupervisor(count=args.replicas, extra_args=extra,
-                           cpu=args.cpu, **sup_kw) as sup:
+                           cpu=args.cpu, transport=args.transport,
+                           **sup_kw) as sup:
         clients = sup.start()
         print(f"serve: spawned {args.replicas} replicas; waiting for warm "
               "pools (engine_ready)", file=sys.stderr, flush=True)

@@ -35,7 +35,11 @@ Instrumented sites (grep for ``faults.inject`` / ``faults.corrupt``):
 - ``multihost.heartbeat`` — the peer-liveness publisher
   (``resilience/multihost.py``);
 - ``spawn.child_exit`` — the restart-the-world supervisor's child watch
-  loop (``cli/common.py``).
+  loop (``cli/common.py``);
+- ``transport.send`` / ``transport.recv`` — the replica RPC data plane
+  (``serving/transport.py`` and the HTTP client): before a frame is
+  written / after one is accepted, so transport chaos drills (mid-call
+  connection death, torn exchanges) run without killing real processes.
 
 The registered sites live in :data:`SITES`; :func:`parse_spec` validates
 every clause against them (and the kind set), so a typo'd drill fails
@@ -109,6 +113,14 @@ SITES = (
     "trainer.collective",
     "multihost.heartbeat",
     "spawn.child_exit",
+    # the replica transport data plane (serving/transport.py + the HTTP
+    # client): "send" fires just before a request/response frame hits the
+    # wire (client request writes AND replica response writes share the
+    # site), "recv" just after a frame is accepted — the chaos drills for
+    # mid-RPC connection death and torn-exchange failover without killing
+    # real processes
+    "transport.send",
+    "transport.recv",
 )
 _SUFFIXED = ("engine.dispatch", "engine.complete")
 

@@ -17,6 +17,9 @@ graceful drain — into redundancy:
   transparent failover (zero lost accepted requests when a replica dies),
   latent-cache affinity with spill-on-death, graceful drain, and rolling
   rollout with fleet-wide auto-rollback.
+- :mod:`transport` — pluggable router→replica data planes for the array
+  RPC: the portable HTTP twin, pipelined unix-socket frames, and the
+  zero-copy shared-memory slot ring (``make_client`` / ``--transport``).
 - :mod:`admission` — the router's front-door policy: priority classes,
   per-client token-bucket quotas, and weighted-fair queueing, so one
   bursting client degrades its own SLO class instead of the fleet's.
@@ -53,6 +56,15 @@ from perceiver_io_tpu.serving.supervisor import (
     ReplicaSupervisor,
     default_replica_argv,
 )
+from perceiver_io_tpu.serving.transport import (
+    TRANSPORTS,
+    ShmemReplicaClient,
+    SlotRing,
+    UdsReplicaClient,
+    UdsReplicaServer,
+    make_client,
+    serve_transport,
+)
 
 __all__ = [
     "AdmissionController",
@@ -69,8 +81,14 @@ __all__ = [
     "Router",
     "RouterClosed",
     "RouterFuture",
+    "ShmemReplicaClient",
+    "SlotRing",
     "SupervisorPool",
+    "TRANSPORTS",
     "TokenBucket",
+    "UdsReplicaClient",
+    "UdsReplicaServer",
     "default_replica_argv",
+    "make_client",
     "parse_priority_classes",
 ]

@@ -50,6 +50,7 @@ from __future__ import annotations
 import argparse
 import io
 import json
+import socket
 import sys
 import threading
 import time
@@ -66,6 +67,7 @@ from perceiver_io_tpu.resilience import (
     DeadlineExceeded,
     RejectedError,
     classify_error,
+    faults,
 )
 
 _MAX_SESSIONS = 1024  # FIFO-evicted; a session is one encode's latents
@@ -621,6 +623,52 @@ def _scale_tree(tree, factor: float):
 # -- the HTTP surface --------------------------------------------------------
 
 
+class _TrackedHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that can SEVER live keep-alive connections.
+
+    ``server_close`` only closes the listener; with pooled persistent
+    router connections (r22), handler threads keep serving on their open
+    sockets after shutdown — a "closed" replica would keep answering. The
+    dead-replica contract (ConnectionError, the failover taxonomy's
+    reroute class) requires close to cut every live connection, matching
+    the uds server's close semantics."""
+
+    daemon_threads = True
+
+    # pitlint PIT-LOCK: accepted sockets are added by the accept loop and
+    # discarded by handler threads — touched only under _live_lock
+    _guarded_by = {"_live": "_live_lock"}
+
+    def __init__(self, *args, **kwargs):
+        self._live: set = set()
+        self._live_lock = threading.Lock()
+        super().__init__(*args, **kwargs)
+
+    def get_request(self):
+        sock, addr = super().get_request()
+        with self._live_lock:
+            self._live.add(sock)
+        return sock, addr
+
+    def shutdown_request(self, request):
+        with self._live_lock:
+            self._live.discard(request)
+        super().shutdown_request(request)
+
+    def close_all_connections(self) -> None:
+        with self._live_lock:
+            live, self._live = list(self._live), set()
+        for sock in live:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
 class ReplicaServer:
     """Loopback HTTP server over one :class:`ReplicaApp` (the replica-side
     half of the RPC shim; ``HttpReplicaClient`` is the router-side half)."""
@@ -649,9 +697,11 @@ class ReplicaServer:
         app, registry = self.app, self._registry
 
         class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"  # keep-alive: the router re-uses
-            # nothing (urllib opens per call) but 1.1 gives Content-Length
-            # framed bodies on both sides
+            protocol_version = "HTTP/1.1"  # keep-alive: the client pools
+            # persistent connections, and 1.1 gives Content-Length framed
+            # bodies on both sides
+            disable_nagle_algorithm = True  # small response frames must not
+            # sit behind the peer's delayed ACK (the ~40 ms stall mode)
 
             def log_message(self, *args) -> None:
                 pass  # RPC traffic must not spam the replica's stderr
@@ -800,8 +850,7 @@ class ReplicaServer:
                 except BaseException as e:  # mirrored, never a stack trace
                     self._reply(503, _wire_error(e))
 
-        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
-        self._httpd.daemon_threads = True
+        self._httpd = _TrackedHTTPServer((self._host, self._port), Handler)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
             name=f"{self.app.name}-rpc", daemon=True,
@@ -813,6 +862,9 @@ class ReplicaServer:
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
+            # sever live keep-alive connections too: pooled router clients
+            # must see the dead-replica ConnectionError, not stale service
+            self._httpd.close_all_connections()
             self._httpd = None
             if self._thread is not None:
                 self._thread.join(timeout=5)
@@ -825,46 +877,106 @@ class ReplicaServer:
 class HttpReplicaClient:
     """Router-side handle to one replica process. Transport failures (dead
     replica, mid-request ``kill -9``) surface as ``ConnectionError`` with the
-    taxonomy's transient markers — the failover policy re-routes them."""
+    taxonomy's transient markers — the failover policy re-routes them.
 
-    def __init__(self, name: str, base_url: str, timeout_s: float = 120.0):
+    Requests ride POOLED persistent HTTP/1.1 connections with TCP_NODELAY
+    set on both sides: the previous one-urllib-connection-per-call pattern
+    wrote headers and body as separate segments, and Nagle holding the
+    second segment behind the peer's delayed ACK put a ~40 ms mode on
+    small-frame round-trips (the documented trap from the abandoned
+    transport prototype — ROADMAP item 1). A request that fails on a pooled
+    connection is NOT transparently resent (the replica may have executed
+    it); it surfaces as ConnectionError and the failover policy decides."""
+
+    # pitlint PIT-LOCK: idle pooled connections are checked out/in by every
+    # router worker thread concurrently — touched only under _pool_lock
+    _guarded_by = {"_pool": "_pool_lock"}
+
+    def __init__(self, name: str, base_url: str, timeout_s: float = 120.0,
+                 pool_size: int = 4):
         self.name = name
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        hostport = self.base_url.split("://", 1)[-1]
+        host, _, port = hostport.partition(":")
+        self._host, self._port = host, int(port or 80)
+        self._pool_size = max(1, int(pool_size))
+        self._pool_lock = threading.Lock()
+        self._pool: List[Any] = []  # idle http.client.HTTPConnection
+
+    def _checkout(self, timeout_s: float):
+        import http.client
+
+        with self._pool_lock:
+            conn = self._pool.pop() if self._pool else None
+        if conn is None:
+            conn = http.client.HTTPConnection(
+                self._host, self._port, timeout=timeout_s)
+        else:
+            conn.timeout = timeout_s
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout_s)
+        return conn
+
+    def _checkin(self, conn) -> None:
+        with self._pool_lock:
+            if len(self._pool) < self._pool_size:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    def close(self) -> None:
+        with self._pool_lock:
+            pool, self._pool = list(self._pool), []
+        for conn in pool:
+            conn.close()
 
     def _request(self, method: str, path: str, body: Optional[bytes] = None,
                  timeout_s: Optional[float] = None,
                  headers: Optional[Dict[str, str]] = None,
                  meta: Optional[Dict[str, Any]] = None) -> bytes:
-        import urllib.error
-        import urllib.request
+        import http.client
 
-        req = urllib.request.Request(
-            self.base_url + path, data=body, method=method,
-            headers={"Content-Type": "application/octet-stream",
-                     **(headers or {})},
-        )
+        conn = self._checkout(
+            timeout_s if timeout_s is not None else self.timeout_s)
         try:
-            with urllib.request.urlopen(
-                req, timeout=timeout_s if timeout_s is not None
-                else self.timeout_s
-            ) as resp:
-                if meta is not None:
-                    phases = resp.headers.get("X-Phases")
-                    if phases:
-                        try:
-                            meta["phases"] = json.loads(phases)
-                        except ValueError:
-                            pass  # a torn header degrades attribution only
-                return resp.read()
-        except urllib.error.HTTPError as e:
-            raise_wire_error(e.read(), self.name)
-        except (urllib.error.URLError, ConnectionError, OSError) as e:
-            reason = getattr(e, "reason", e)
+            if conn.sock is None:
+                conn.connect()
+                # no-delay on the client side too: the request's header and
+                # body writes must not wait out the replica's delayed ACK
+                conn.sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            faults.inject("transport.send")
+            conn.request(method, path, body=body,
+                         headers={"Content-Type": "application/octet-stream",
+                                  **(headers or {})})
+            resp = conn.getresponse()
+            data = resp.read()
+            faults.inject("transport.recv")
+            status = resp.status
+            if meta is not None and status < 400:
+                phases = resp.getheader("X-Phases")
+                if phases:
+                    try:
+                        meta["phases"] = json.loads(phases)
+                    except ValueError:
+                        pass  # a torn header degrades attribution only
+            reusable = not resp.will_close
+        except (http.client.HTTPException, ConnectionError, OSError) as e:
+            conn.close()
             raise ConnectionError(
                 f"replica {self.name!r}: connection closed / failed to "
-                f"connect ({type(reason).__name__}: {reason})"
+                f"connect ({type(e).__name__}: {e})"
             ) from e
+        if reusable:
+            self._checkin(conn)
+        else:
+            conn.close()
+        if status >= 400:
+            # taxonomy bodies ride error statuses (the body was fully read,
+            # so the connection above stayed reusable)
+            raise_wire_error(data, self.name)
+        return data
 
     def call(self, kind: str, arrays: Sequence[np.ndarray],
              session: Optional[str] = None,
@@ -1113,6 +1225,18 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--name", default="replica")
     parser.add_argument("--cpu", action="store_true",
                         help="pin the CPU backend before jax initializes")
+    parser.add_argument("--transport", choices=("http", "uds", "shmem"),
+                        default="http",
+                        help="data plane for the call() RPC: 'uds' adds a "
+                             "pipelined unix-socket frame server, 'shmem' "
+                             "adds the shared-memory slot slab on top; the "
+                             "HTTP surface stays up either way (admin verbs "
+                             "+ the streamed generate RPC ride it)")
+    parser.add_argument("--shm_slots", type=int, default=16,
+                        help="shmem transport: slots in the replica's slab")
+    parser.add_argument("--shm_slot_mb", type=float, default=4.0,
+                        help="shmem transport: slot size; payloads past it "
+                             "fall back to inline uds frames")
     src = parser.add_argument_group("model source")
     src.add_argument("--task", choices=("mlm", "generate"), default="mlm",
                      help="workload class: 'mlm' = the fill-mask engines "
@@ -1451,8 +1575,17 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     app, max_seq_len = _build_app(args)
     server = ReplicaServer(app, port=args.port)
     url = server.start()
-    print(f"replica {args.name!r}: listening on {url}", file=sys.stderr,
-          flush=True)
+    extra_server = None
+    if args.transport != "http":
+        from perceiver_io_tpu.serving.transport import serve_transport
+
+        extra_server = serve_transport(
+            app, args.transport, server.port, slots=args.shm_slots,
+            slot_bytes=int(args.shm_slot_mb * 1024 * 1024))
+    print(f"replica {args.name!r}: listening on {url}"
+          + (f" (+{args.transport} {extra_server.path})"
+             if extra_server is not None else ""),
+          file=sys.stderr, flush=True)
     if not args.no_warmup:
         _warm(app, args, max_seq_len)
 
@@ -1482,6 +1615,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         app.quit_event.wait()
     finally:
         app.drain(args.drain_timeout_s)
+        if extra_server is not None:
+            extra_server.close()
         server.close()
         app.close()
         obs.configure_event_log(None)

@@ -519,11 +519,17 @@ class Router:
                 finally:
                     self._note_inflight(slot, -1)
                 if attempt_ctx is not None:
+                    # server_s = replica-reported engine phase sum riding
+                    # the RPC meta: the span's dur minus it IS the transport
+                    # cost (serialize + wire + deserialize + conn wait) —
+                    # what load_bench's transport A/B compares per arm
                     obs.record_span(
                         "router_attempt", attempt_ctx, t_attempt,
                         time.monotonic() - t_attempt, router=self.name,
                         replica=slot.name, kind=kind, attempt=attempt,
-                        ok=True)
+                        ok=True, server_s=round(sum(
+                            sum(r.values())
+                            for r in meta.get("phases") or []), 6))
                 slot.failures = 0
                 if pin_on_success and session is not None:
                     with self._lock:

@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import perceiver_io_tpu.obs as obs
 from perceiver_io_tpu.resilience import RetryPolicy
 from perceiver_io_tpu.serving.replica import HttpReplicaClient
+from perceiver_io_tpu.serving.transport import make_client
 
 
 def _free_port() -> int:
@@ -51,11 +52,17 @@ def _free_port() -> int:
 
 
 def default_replica_argv(name: str, port: int,
-                         extra: Sequence[str] = ()) -> List[str]:
+                         extra: Sequence[str] = (),
+                         transport: str = "http") -> List[str]:
     """The standard child command: ``python -m
-    perceiver_io_tpu.serving.replica --port P --name NAME [extra...]``."""
-    return [sys.executable, "-m", "perceiver_io_tpu.serving.replica",
-            "--port", str(port), "--name", name, *extra]
+    perceiver_io_tpu.serving.replica --port P --name NAME [extra...]``.
+    A non-default ``transport`` rides along so the spawned replica serves
+    the matching data plane (its endpoints are keyed by the port)."""
+    argv = [sys.executable, "-m", "perceiver_io_tpu.serving.replica",
+            "--port", str(port), "--name", name]
+    if transport != "http":
+        argv += ["--transport", transport]
+    return argv + list(extra)
 
 
 class _Replica:
@@ -101,13 +108,15 @@ class ReplicaSupervisor:
         poll_s: float = 0.2,
         log_dir: Optional[str] = None,
         registry: Optional[obs.MetricsRegistry] = None,
+        transport: str = "http",
     ):
         if count < 1:
             raise ValueError(f"count must be >= 1, got {count}")
         self.count = count
+        self.transport = transport
         self._argv_builder = argv_builder or (
             lambda name, port: default_replica_argv(
-                name, port, extra=extra_args)
+                name, port, extra=extra_args, transport=transport)
         )
         self._cpu = cpu
         self._policy = restart_policy or RetryPolicy(
@@ -123,8 +132,7 @@ class ReplicaSupervisor:
             for i in range(count)
         }
         self._clients: Dict[str, HttpReplicaClient] = {
-            name: HttpReplicaClient(
-                name, f"http://127.0.0.1:{rep.port}")
+            name: make_client(transport, name, rep.port)
             for name, rep in self._replicas.items()
         }
         self._registry = (registry if registry is not None
@@ -204,7 +212,7 @@ class ReplicaSupervisor:
             if name in self._replicas:
                 raise ValueError(f"replica {name!r} already exists")
             rep = _Replica(name, _free_port())
-            client = HttpReplicaClient(name, f"http://127.0.0.1:{rep.port}")
+            client = make_client(self.transport, name, rep.port)
             self._replicas[name] = rep
             self._clients[name] = client
             self._m_restarts[name] = self._restart_counter(name)
@@ -261,6 +269,15 @@ class ReplicaSupervisor:
     def client(self, name: str) -> HttpReplicaClient:
         with self._lock:
             return self._clients[name]
+
+    def ports(self) -> Dict[str, int]:
+        """``{name: http_port}`` for the current fleet — the key every
+        transport endpoint derives from (``uds_path_for``/``shm_slab_name``),
+        so callers can build a SECOND client set over the same replicas
+        (load_bench's transport A/B runs http and uds/shmem arms against
+        one live fleet)."""
+        with self._lock:
+            return {name: rep.port for name, rep in self._replicas.items()}
 
     def wait_ready(self, timeout_s: float = 180.0,
                    names: Optional[Sequence[str]] = None) -> None:

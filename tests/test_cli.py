@@ -253,6 +253,10 @@ def test_inference_bench_engine_cpu_emits_one_json_line(tmp_path):
                for k in result), result
 
 
+@pytest.mark.slow  # tier-1 budget (r22 box drift): the serve CLI
+# contract stays tier-1 in test_serve_cli_end_to_end; the metrics
+# registry/exporters are unit-covered in tests/test_obs.py. This drill
+# adds only the sidecar-process layer.
 def test_serve_metrics_sidecar_end_to_end(tmp_path):
     """The observability acceptance drill: a live serve.py process with
     --metrics_port answers /metrics with valid Prometheus text carrying
@@ -493,6 +497,9 @@ def test_train_cli_compile_cache_persists_step_compiles(tmp_path):
         "no compiled entries persisted")
 
 
+@pytest.mark.slow  # tier-1 budget (r22 box drift): compile-cache
+# mechanics stay tier-1 in tests/test_aot_cache.py; the cache
+# subprocess drill was slow-marked in r20. This is the bench CLI shell.
 def test_coldstart_bench_cpu_emits_one_json_line(tmp_path):
     """tools/coldstart_bench.py --cpu runs the same-process cold-vs-warm
     warmup A/B over the AOT executable cache and emits EXACTLY one JSON line
@@ -581,6 +588,10 @@ def test_load_bench_dry_emits_schema_json_line():
     assert "generate_ab" in record["trace_keys"], record
 
 
+@pytest.mark.slow  # tier-1 budget (r22 box drift): the load_bench
+# record schema stays tier-1 in test_load_bench_dry_fleet_schema and
+# the full --cpu contract run is the r21 slow-marked drill; the
+# saturation/SLO logic is unit-covered in tests/test_obs.py (slo).
 def test_load_bench_cpu_sweep_shows_saturation_signature(tmp_path):
     """The SLO-observability acceptance drill: tools/load_bench.py --cpu
     emits ONE JSON line whose open-loop sweep shows the saturation
@@ -825,6 +836,9 @@ def test_encode_masked_samples(tmp_path):
     assert pad.dtype == bool
 
 
+@pytest.mark.slow  # tier-1 budget (r22 box drift): the shared train
+# loop/CLI machinery stays tier-1 via the train_mlm variants above;
+# the image model forward/adapters are unit-covered in test_model.py.
 def test_train_imagenet(tmp_path):
     from perceiver_io_tpu.cli import train_imagenet
 

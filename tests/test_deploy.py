@@ -569,6 +569,11 @@ def _pub_factory(log):
     return factory
 
 
+@pytest.mark.slow  # tier-1 budget (r22 box drift): every layer of this
+# e2e keeps its own tier-1 drill above (publish atomicity/fail-soft,
+# gate rejection, NaN/tamper quarantine, post-swap rollback, cadence);
+# the real-process variant was already slow (test_train_serve_deploy_
+# drill_real_process).
 def test_fleet_deploy_chaos_e2e(no_faults):
     """THE acceptance drill, tier-1 in-process: a publisher on a cadence +
     a 3-replica fleet under open-loop traffic. >=3 gated swaps complete with

@@ -587,13 +587,21 @@ def test_load_bench_dry_fleet_schema():
     record = json.loads(lines[0])
     assert record["fleet"] is None
     assert record["fleet_keys"] == [
-        "replicas", "mode", "killed", "kill_at_frac", "kill_point",
-        "reroutes", "affinity_spills", "lost_accepted", "restarts"]
+        "replicas", "mode", "transport", "killed", "kill_at_frac",
+        "kill_point", "reroutes", "affinity_spills", "lost_accepted",
+        "restarts"]
     # r15: the tracing-overhead A/B block is declared in the schema
     assert record["trace"] is None
     assert record["trace_keys"] == [
         "ab_waves", "untraced_rps", "traced_rps", "overhead_pct",
         "spans_recorded", "generate_ab"]
+    # r22: the transport A/B block (--trace_ab --transport uds|shmem)
+    assert record["transport"] is None
+    assert record["transport_keys"] == [
+        "transport", "ab_waves", "wave_size", "http_rps", "transport_rps",
+        "throughput_speedup", "http_rpc_p50_ms", "http_rpc_p99_ms",
+        "rpc_p50_ms", "rpc_p99_ms", "rpc_p50_speedup", "spans_http",
+        "spans_transport"]
 
 
 # -- distributed request tracing (r15) ----------------------------------------

@@ -64,6 +64,9 @@ def test_data_module_loaders():
     assert batch["flow"].shape == (4, 8, 8, 2)
 
 
+@pytest.mark.slow  # tier-1 budget (r22 box drift): the flow model
+# forward/train-step and adapters stay tier-1 in tests/test_flow.py;
+# the synthetic data pipeline in the tests above. This is the CLI shell.
 def test_train_flow_cli(tmp_path):
     from perceiver_io_tpu.cli import train_flow
     from perceiver_io_tpu.training import read_metrics

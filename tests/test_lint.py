@@ -570,3 +570,46 @@ def test_lock_order_recorder_body_error_wins_over_check():
             with b:
                 with a:
                     raise ValueError("body")
+
+
+# -- repo hygiene: orphan bytecode (PIT-BYTECODE, r22) ------------------------
+
+
+def test_orphan_bytecode_scan_flags_residue(tmp_path):
+    """Deleted modules must be GONE: a legacy-layout pyc is importable in
+    place of (or alongside) its source, and an orphan __pycache__ pyc is
+    residue from a deleted module. Live cache entries are not findings."""
+    from perceiver_io_tpu.analysis.core import scan_orphan_bytecode
+
+    pkg = tmp_path / "pkg"
+    (pkg / "__pycache__").mkdir(parents=True)
+    (pkg / "live.py").write_text("x = 1\n")
+    (pkg / "__pycache__" / "live.cpython-311.pyc").write_bytes(b"\x00")
+    (pkg / "__pycache__" / "deleted.cpython-311.pyc").write_bytes(b"\x00")
+    (pkg / "ghost.pyc").write_bytes(b"\x00")
+    (pkg / "live.pyc").write_bytes(b"\x00")
+
+    findings = scan_orphan_bytecode(str(tmp_path), targets=("pkg",))
+    assert all(f.rule == "PIT-BYTECODE" for f in findings)
+    by_path = {f.path: f.message for f in findings}
+    assert "in place of deleted" in by_path["pkg/ghost.pyc"]
+    assert "alongside" in by_path["pkg/live.pyc"]
+    assert "residue" in by_path["pkg/__pycache__/deleted.cpython-311.pyc"]
+    assert "pkg/__pycache__/live.cpython-311.pyc" not in by_path  # live
+
+
+def test_repo_has_no_orphan_bytecode():
+    """The r22 satellite pin: the stale serving/__pycache__/transport pycs
+    are deleted and nothing like them comes back (lint runs this scan on
+    every invocation — same scope as tools/lint.py)."""
+    from perceiver_io_tpu.analysis.core import (
+        DEFAULT_TARGETS,
+        TEST_FAULT_TARGETS,
+        scan_orphan_bytecode,
+    )
+
+    findings = scan_orphan_bytecode(
+        ROOT, targets=(*DEFAULT_TARGETS, *TEST_FAULT_TARGETS))
+    # legacy-layout pycs are always findings; __pycache__ pycs only when
+    # their source is gone — a live dev tree's caches stay clean either way
+    assert findings == [], "\n".join(f.render() for f in findings)

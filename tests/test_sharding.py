@@ -578,6 +578,11 @@ def build_mlm_sp():
     )
 
 
+@pytest.mark.slow  # tier-1 budget (r22 box drift): sp-kernel-on-mesh
+# parity retained tier-1 by test_pallas_sp_indivisible_batch_falls_back
+# (mesh dispatch), TestSpGradientCanary (sp backward gate), and
+# test_fused_attention_*_with_sharded_inputs (kernel numerics under
+# shardings); the driver runs dryrun_multichip(8) over the kernel paths.
 def test_pallas_sp_step_matches_xla_and_shards_kv(mlm_parts, monkeypatch):
     import perceiver_io_tpu.ops.pallas_attention as pa
 

@@ -98,6 +98,16 @@ FLOOR_CLASSES: List[Tuple[str, str, float, str, str]] = [
     (r"(^|\.)(batched|sequential)_tokens_per_s$|(^|\.)tokens_per_s$",
      "frac", HOST_FLOOR, "higher",
      "CLAUDE.md: CPU tokens/s is host-clock, cross-session (±2x swing)"),
+    # load_bench transport A/B (r22): the speedups are SAME-PROCESS paired
+    # (throughput: order-alternated wave pairs) or same-log derived (rpc
+    # span p50 ratio) — host drift cancels, so the floor is per-pair
+    # spread, the r20 paired-speedup treatment. The arm rates themselves
+    # are host-clock.
+    (r"(^|\.)(rpc_p50_speedup|throughput_speedup)$", "frac", 0.15, "higher",
+     "PERF.md §Transport r22: same-process http-vs-transport paired "
+     "ratio; per-pair spread floor (the r20 paired-speedup class)"),
+    (r"(^|\.)(http_rps|transport_rps)$", "frac", HOST_FLOOR, "higher",
+     "CLAUDE.md: CPU requests/s is host-clock, cross-session (±2x swing)"),
     (r"(^|\.)(slot_occupancy|steps_per_dispatch)(_mean)?$"
      r"|(^|\.)ar_decode_slot_occupancy$", "frac", 0.10, "higher",
      "PERF.md §Continuous batching r20: occupancy/steps-per-dispatch are "

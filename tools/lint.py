@@ -129,6 +129,11 @@ def main() -> int:
         findings.extend(core.scan_paths(
             fault_only_files, rules=[FaultSiteRule()], root=ROOT))
     findings.extend(scan_docs(doc_targets))
+    # repo hygiene, every invocation (one cheap walk): orphan bytecode must
+    # never keep a deleted module importable — it is a property of the TREE,
+    # not of any changed file, so --changed runs check it too
+    findings.extend(core.scan_orphan_bytecode(
+        ROOT, targets=(*DEFAULT_TARGETS, *TEST_FAULT_TARGETS)))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     if run_crosscheck:
         from perceiver_io_tpu.analysis.crosscheck import audit_sharding_rules

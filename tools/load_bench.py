@@ -43,6 +43,16 @@ returns the engine future's phases; router futures surface them), so fleet
 points carry BOTH router-measured end-to-end latency and the replica-side
 phase breakdowns.
 
+``--transport {http,uds,shmem}`` selects the router→replica data plane for
+process fleets (``serving.transport``; the replica keeps its HTTP admin
+surface either way, so scrape/drain/kill drills work identically). With
+``--trace_ab``, a non-http transport also runs the paired-interleave
+http-vs-transport A/B over the same live fleet: two routers, order-
+alternated closed-loop waves of batch-1 small frames, and the per-attempt
+RPC cost (``router_attempt`` span duration minus the replica-reported
+engine phase sum) compared per arm — the record's ``transport`` block
+carries ``rpc_p50_speedup`` (the r22 bar: >= 2 for uds/shmem).
+
 ``--trace_ab`` measures the r15 distributed-tracing overhead the honest way
 (PERF.md discipline: same-process, interleaved): closed-loop waves alternate
 traced (event log + span emission at every hop) and untraced in ONE process,
@@ -87,9 +97,11 @@ POINT_KEYS = (
 PHASE_KEYS = ("admission", "queue", "assembly", "dispatch", "device",
               "complete")
 # the fleet block of a --replicas run (null for single-engine sweeps);
-# lost_accepted is the chaos drill's verdict and must be 0
-FLEET_KEYS = ("replicas", "mode", "killed", "kill_at_frac", "kill_point",
-              "reroutes", "affinity_spills", "lost_accepted", "restarts")
+# lost_accepted is the chaos drill's verdict and must be 0 — on EVERY
+# transport (the r22 kill drill re-runs it with --transport uds/shmem)
+FLEET_KEYS = ("replicas", "mode", "transport", "killed", "kill_at_frac",
+              "kill_point", "reroutes", "affinity_spills", "lost_accepted",
+              "restarts")
 # the deploy block of a --publish_every_s run (null otherwise): the
 # train→serve ride-along — checkpoints published and gate-swapped DURING the
 # sweep, with p99 attributed to ±window swap windows vs steady state
@@ -108,6 +120,19 @@ TRACE_KEYS = ("ab_waves", "untraced_rps", "traced_rps", "overhead_pct",
               # paired-interleave discipline, counting decode_* spans and
               # flight-recorder events in the traced arm
               "generate_ab")
+# the transport block of a --trace_ab run over a process fleet spawned with
+# --transport uds|shmem (null otherwise): TWO routers over the SAME live
+# replicas — the portable HTTP arm and the --transport data plane — driven
+# by paired order-alternated closed-loop waves of batch-1 small frames.
+# rpc_* is the per-attempt TRANSPORT cost: the router_attempt span duration
+# minus the replica-reported engine phase sum (server_s rides the span), i.e.
+# serialize + wire + deserialize + connection wait. The r22 acceptance bar:
+# rpc_p50_speedup >= 2 (uds/shmem RPC span p50 at least 2x smaller than
+# HTTP's)
+TRANSPORT_KEYS = ("transport", "ab_waves", "wave_size", "http_rps",
+                  "transport_rps", "throughput_speedup", "http_rpc_p50_ms",
+                  "http_rpc_p99_ms", "rpc_p50_ms", "rpc_p99_ms",
+                  "rpc_p50_speedup", "spans_http", "spans_transport")
 # the alerts block of a --series_jsonl run (null otherwise): the
 # timeseries+alerting ride-along — registry sampled on a cadence during the
 # sweep, context-default alert rules evaluated over the windowed series
@@ -354,6 +379,95 @@ def _trace_ab(submit, reqs, waves: int, wave_size: int,
         "traced_rps": round(traced_rps, 3),
         "overhead_pct": round(100.0 * paired, 3),
         "spans_recorded": spans,
+    }
+
+
+def _transport_ab(transport: str, ports: Dict[str, int], waves: int,
+                  wave_size: int, drain_timeout_s: float, reqs,
+                  registry, request_timeout_s: float) -> Dict:
+    """Same-process INTERLEAVED transport A/B (the PERF.md discipline): TWO
+    routers over the SAME live replica processes — one on the portable HTTP
+    client, one on the ``--transport`` data plane (the replica serves both;
+    its endpoints are keyed by the HTTP port) — with the paired order-
+    alternated closed-loop waves choosing which router submits. The event
+    log runs for the WHOLE A/B so both arms pay identical span-emission
+    cost, and the RPC verdict reads the ``router_attempt`` spans: each ok
+    span carries ``server_s`` (the replica-reported engine phase sum), so
+    ``dur_s - server_s`` isolates serialize + wire + deserialize +
+    connection wait — the transport, not the shared engine compute."""
+    import tempfile
+
+    import perceiver_io_tpu.obs as obs
+    from perceiver_io_tpu.serving import Router
+    from perceiver_io_tpu.serving.transport import make_client
+
+    routers: Dict[str, object] = {}
+    arm_clients: Dict[str, list] = {}
+    for arm in ("http", transport):
+        cs = [make_client(arm, f"ab-{arm}-{name}", port)
+              for name, port in sorted(ports.items())]
+        arm_clients[arm] = cs
+        routers[arm] = Router(cs, name=f"lb_ab_{arm}", registry=registry,
+                              scrape_interval_s=0.1,
+                              request_timeout_s=request_timeout_s)
+        routers[arm].refresh()
+    state = {"arm": "http"}
+    submit = lambda req: routers[state["arm"]].submit(*req)
+    tmp = tempfile.NamedTemporaryFile(prefix="load_bench_transport_",
+                                      suffix=".jsonl", delete=False)
+    tmp.close()
+    rpc: Dict[str, List[float]] = {"http": [], transport: []}
+    by_router = {"lb_ab_http": "http", f"lb_ab_{transport}": transport}
+    try:
+        obs.configure_event_log(tmp.name)
+        rates = _ab_rates(
+            submit, reqs, waves, wave_size, drain_timeout_s,
+            lambda armed: state.__setitem__(
+                "arm", transport if armed else "http"))
+        obs.configure_event_log(None)
+        with open(tmp.name) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                arm = by_router.get(rec.get("router", ""))
+                if (arm is not None and rec.get("event") == "span"
+                        and rec.get("name") == "router_attempt"
+                        and rec.get("ok") is True):
+                    rpc[arm].append(max(
+                        0.0, rec["dur_s"] - rec.get("server_s", 0.0)))
+    finally:
+        # unhook FIRST (the _trace_ab discipline), then tear down the A/B
+        # routers + both client sets — the fleet itself stays up for the
+        # sweep that follows
+        obs.configure_event_log(None)
+        os.unlink(tmp.name)
+        for arm, r in routers.items():
+            r.close()
+            for c in arm_clients[arm]:
+                c.close()
+    http_rps, t_rps, paired = _paired_overhead(rates)
+    p50 = {a: _pct(v, 0.5) for a, v in rpc.items()}
+    p99 = {a: _pct(v, 0.99) for a, v in rpc.items()}
+    return {
+        "transport": transport,
+        "ab_waves": waves,
+        "wave_size": wave_size,
+        "http_rps": round(http_rps, 3),
+        "transport_rps": round(t_rps, 3),
+        # _paired_overhead's fraction is 1 - armed/disarmed per pair; the
+        # armed arm is the fast transport, so the paired speedup is 1 - it
+        "throughput_speedup": round(1.0 - paired, 3),
+        "http_rpc_p50_ms": _ms(p50["http"]),
+        "http_rpc_p99_ms": _ms(p99["http"]),
+        "rpc_p50_ms": _ms(p50[transport]),
+        "rpc_p99_ms": _ms(p99[transport]),
+        # the acceptance headline: HTTP RPC span p50 over the transport's
+        "rpc_p50_speedup": (round(p50["http"] / p50[transport], 3)
+                            if p50["http"] and p50[transport] else None),
+        "spans_http": len(rpc["http"]),
+        "spans_transport": len(rpc[transport]),
     }
 
 
@@ -928,6 +1042,17 @@ def main() -> None:
                        help="inprocess = N engines behind LocalReplica shims "
                             "(fast, tier-1); process = real supervised "
                             "replica processes (the acceptance-drill mode)")
+    fleet.add_argument("--transport", choices=["http", "uds", "shmem"],
+                       default="http",
+                       help="router→replica data plane for process fleets "
+                            "(serving.transport): http = the portable "
+                            "pooled-connection twin; uds = pipelined unix-"
+                            "socket frames; shmem = shared-memory slot ring "
+                            "with a uds control channel. With --trace_ab "
+                            "the record gains a 'transport' block: a "
+                            "paired-interleave http-vs-transport A/B over "
+                            "the same live fleet (rpc_p50_speedup must be "
+                            ">= 2 for uds/shmem at batch-1 small frames)")
     fleet.add_argument("--kill_replica_at", type=float, default=None,
                        metavar="FRAC",
                        help="chaos drill: at FRAC of --kill_point's offered "
@@ -1094,6 +1219,11 @@ def main() -> None:
     if (args.autoscale or args.noisy_neighbor) and args.replicas < 1:
         parser.error("--autoscale/--noisy_neighbor need --replicas >= 1 "
                      "(the control loop lives at the router tier)")
+    if args.transport != "http" and not args.dry and (
+            args.replicas < 1 or args.replica_mode != "process"):
+        parser.error("--transport uds/shmem needs --replicas >= 1 with "
+                     "--replica_mode process (in-process LocalReplica shims "
+                     "have no wire to put a transport on)")
     if args.generate_rps > 0 and (args.replicas < 1
                                   or args.replica_mode != "inprocess"):
         parser.error("--generate_rps needs --replicas >= 1 with "
@@ -1107,15 +1237,18 @@ def main() -> None:
             "duration_s": args.duration_s, "schedule": args.schedule,
             "point_keys": list(POINT_KEYS), "phase_keys": list(PHASE_KEYS),
             "fleet_keys": list(FLEET_KEYS), "deploy_keys": list(DEPLOY_KEYS),
-            "trace_keys": list(TRACE_KEYS), "alert_keys": list(ALERT_KEYS),
+            "trace_keys": list(TRACE_KEYS),
+            "transport_keys": list(TRANSPORT_KEYS),
+            "alert_keys": list(ALERT_KEYS),
             "series_ab_keys": list(SERIES_AB_KEYS),
             "autoscale_keys": list(AUTOSCALE_KEYS),
             "admission_keys": list(ADMISSION_KEYS),
             "generate_keys": list(GENERATE_KEYS),
             "stream_keys": list(STREAM_KEYS),
             "sweep": [], "capacity": None, "fleet": None, "deploy": None,
-            "trace": None, "alerts": None, "series_ab": None,
-            "autoscale": None, "admission": None, "generate": None,
+            "trace": None, "transport": None, "alerts": None,
+            "series_ab": None, "autoscale": None, "admission": None,
+            "generate": None,
         }
         emit_json_line(record)
         return
@@ -1201,7 +1334,8 @@ def main() -> None:
             if args.deadline_s is not None:
                 extra += ["--request_deadline_s", str(args.deadline_s)]
             sup = ReplicaSupervisor(count=args.replicas, extra_args=extra,
-                                    cpu=args.cpu, registry=registry)
+                                    cpu=args.cpu, registry=registry,
+                                    transport=args.transport)
             clients = sup.start()
             _log(f"spawned {args.replicas} replica processes; waiting for "
                  "warm pools (engine_ready)")
@@ -1348,6 +1482,16 @@ def main() -> None:
                 router, args.trace_ab_waves,
                 max(4, args.calibration_wave_size // 4), args.seed)
         _log(f"trace A/B: {json.dumps(trace_record)}")
+    transport_record = None
+    if args.trace_ab and args.transport != "http":
+        # the fleet serves BOTH data planes (the replica always keeps its
+        # HTTP surface); the A/B owns the event log and its own two routers,
+        # so it runs before the sweep touches the main router
+        transport_record = _transport_ab(
+            args.transport, sup.ports(), args.trace_ab_waves,
+            args.calibration_wave_size, args.drain_timeout_s, reqs,
+            registry, args.drain_timeout_s)
+        _log(f"transport A/B: {json.dumps(transport_record)}")
     series_ab_record = None
     if args.series_ab:
         series_ab_record = _series_ab(
@@ -1755,6 +1899,7 @@ def main() -> None:
             restarts = 1 if killed["name"] is not None else 0
         fleet_record = {
             "replicas": args.replicas, "mode": args.replica_mode,
+            "transport": args.transport,
             "killed": killed["name"],
             "kill_at_frac": args.kill_replica_at,
             "kill_point": (args.kill_point
@@ -1809,6 +1954,7 @@ def main() -> None:
         "fleet": fleet_record,
         "deploy": deploy_record,
         "trace": trace_record,
+        "transport": transport_record,
         "alerts": alerts_record,
         "series_ab": series_ab_record,
         "autoscale": autoscale_record,
