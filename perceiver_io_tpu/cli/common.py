@@ -202,6 +202,19 @@ def add_mesh_args(parser: argparse.ArgumentParser) -> None:
                         "world launches (capped backoff between restarts; a "
                         "crash loop of consecutive fast failures detaches "
                         "early). 1 = today's fail-fast behavior")
+    g.add_argument("--elastic", action="store_true",
+                   help="elastic supervision for --spawn_hosts (r23): a "
+                        "child death no longer restarts the world — the "
+                        "supervisor waits for the survivors to resize "
+                        "in-process (resilience.elastic) and resume, only "
+                        "falling back to restart-the-world when the live "
+                        "count drops below --elastic_quorum or the elastic "
+                        "progress file stops advancing. Worlds that made "
+                        "step progress reset the --spawn_attempts budget")
+    g.add_argument("--elastic_quorum", type=int, default=1, metavar="Q",
+                   help="minimum live hosts for in-process resize under "
+                        "--elastic; below it the supervisor restarts the "
+                        "world (r19 behavior)")
     g.add_argument("--multihost", action="store_true",
                    help="call jax.distributed.initialize() before touching "
                         "devices (TPU pods auto-detect the coordinator); "
@@ -611,6 +624,13 @@ def maybe_spawn_hosts(args, argv=None) -> bool:
             os.path.abspath(perceiver_io_tpu.__file__)))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
 
+    progress_probe = None
+    if getattr(args, "elastic", False):
+        from perceiver_io_tpu.resilience.elastic import (
+            progress_path, read_progress)
+
+        proot = getattr(args, "logdir", None) or "."
+        progress_probe = lambda: read_progress(progress_path(proot))  # noqa: E731
     supervisor = WorldSupervisor(
         launch=lambda resume_dir: _launch_world(
             target, child_argv, env, n, resume_dir),
@@ -618,6 +638,9 @@ def maybe_spawn_hosts(args, argv=None) -> bool:
         attempts=getattr(args, "spawn_attempts", 1) or 1,
         find_resume=lambda: _newest_resumable_run(
             getattr(args, "logdir", None), getattr(args, "experiment", None)),
+        elastic=getattr(args, "elastic", False),
+        quorum=getattr(args, "elastic_quorum", 1) or 1,
+        progress_probe=progress_probe,
     )
     supervisor.run()
     return True
@@ -744,7 +767,9 @@ class WorldSupervisor:
     """
 
     def __init__(self, launch, n, attempts=1, find_resume=None,
-                 poll_s=0.2, backoff=None, sleep=None, reap_wait_s=10.0):
+                 poll_s=0.2, backoff=None, sleep=None, reap_wait_s=10.0,
+                 elastic=False, quorum=1, progress_probe=None,
+                 elastic_grace_s=30.0):
         import time as _time
 
         import perceiver_io_tpu.obs as obs
@@ -759,10 +784,22 @@ class WorldSupervisor:
             max_retries=self.attempts, base_s=1.0, multiplier=2.0, max_s=30.0)
         self._sleep = sleep or _time.sleep
         self._reap_wait_s = reap_wait_s
+        # r23 elastic supervision: a child death is first offered to the
+        # in-process resize path (resilience.elastic) — the supervisor only
+        # restarts the world below the quorum floor or when the elastic
+        # progress file stops advancing within the grace window.
+        self.elastic = bool(elastic)
+        self.quorum = max(1, int(quorum))
+        self._progress_probe = progress_probe or (lambda: None)
+        self._elastic_grace_s = elastic_grace_s
         self._m_restarts = obs.get_registry().counter(
             "spawn_world_restarts_total",
             "whole-world relaunches after a child death under "
             "--spawn_attempts supervision")
+        self._m_absorbed = obs.get_registry().counter(
+            "spawn_elastic_absorbed_total",
+            "child deaths absorbed by an in-process elastic resize "
+            "instead of a world restart (--elastic)")
         self.procs = []  # the CURRENT world, for the signal handlers
 
     # -- plumbing ------------------------------------------------------------
@@ -809,10 +846,62 @@ class WorldSupervisor:
                 if rc is not None:
                     live.remove(r)
                     if rc != 0:
-                        return r, rc
+                        if not self.elastic:
+                            return r, rc
+                        if len(live) < self.quorum:
+                            import sys
+
+                            print(f"--spawn_hosts: rank {r} died (rc={rc}) "
+                                  f"and {len(live)} live < quorum "
+                                  f"{self.quorum} — restarting the world",
+                                  file=sys.stderr)
+                            return r, rc
+                        if not self._await_elastic_resume(r, rc):
+                            return r, rc
             if live:
                 _time.sleep(self._poll_s)
         return None
+
+    # -- elastic absorption (r23) --------------------------------------------
+
+    @staticmethod
+    def _progress_key(progress):
+        """Orderable identity of an elastic progress record (None = none)."""
+        if not progress:
+            return None
+        return (progress.get("generation", -1), progress.get("step", -1),
+                progress.get("wall", 0.0))
+
+    def _await_elastic_resume(self, rank, rc) -> bool:
+        """Give the survivors the grace window to resize in-process and
+        advance the elastic progress file past its pre-death value. True =
+        the death was absorbed (keep watching); False = restart the world."""
+        import sys
+        import time as _time
+
+        import perceiver_io_tpu.obs as obs
+
+        before = self._progress_key(self._progress_probe())
+        print(f"--spawn_hosts --elastic: rank {rank} died (rc={rc}); "
+              f"waiting up to {self._elastic_grace_s:.0f}s for the "
+              "survivors to resize in-process", file=sys.stderr)
+        deadline = _time.monotonic() + self._elastic_grace_s
+        while _time.monotonic() < deadline:
+            now = self._progress_key(self._progress_probe())
+            if now is not None and now != before and (
+                    before is None or now > before):
+                self._m_absorbed.inc()
+                obs.event("spawn_elastic_absorbed", rank=rank, rc=rc,
+                          generation=now[0], step=now[1])
+                print(f"--spawn_hosts --elastic: survivors resumed at "
+                      f"generation {now[0]} step {now[1]} — death absorbed, "
+                      "no world restart", file=sys.stderr)
+                return True
+            self._sleep(self._poll_s)
+        print("--spawn_hosts --elastic: no elastic progress within the "
+              "grace window — falling back to restart-the-world",
+              file=sys.stderr)
+        return False
 
     def _replay_log(self, logs, rank, label="") -> bool:
         """Dump a failed rank's captured output tail to stderr; returns
@@ -877,6 +966,7 @@ class WorldSupervisor:
         while True:
             self.procs, logs = self._launch(resume_dir)
             started = _time.monotonic()
+            progress_at_launch = self._progress_key(self._progress_probe())
             failed = self._watch()
             if failed is None:
                 self._close_logs(logs)
@@ -884,6 +974,22 @@ class WorldSupervisor:
             rank, rc = failed
             self._reap()
             elapsed = _time.monotonic() - started
+            # A world that demonstrably made step progress (elastic rejoins
+            # reaching a clean boundary, or plain long productive training)
+            # earns back the FULL attempt budget: this failure is
+            # independent of the ones that consumed earlier attempts.
+            progress_now = self._progress_key(self._progress_probe())
+            if (progress_now is not None
+                    and progress_now != progress_at_launch
+                    and (progress_at_launch is None
+                         or progress_now > progress_at_launch)
+                    and (launches or fast_failures)):
+                print(f"--spawn_hosts: world made step progress "
+                      f"(generation {progress_now[0]} step {progress_now[1]})"
+                      " — resetting the supervision attempt budget",
+                      file=sys.stderr)
+                launches = 0
+                fast_failures = 0
             # Port-race retry ONLY with evidence of a coordinator bring-up
             # problem in some child's log — a deterministic fast failure
             # (bad flag, import error) must surface immediately, not be
@@ -1067,7 +1173,7 @@ def parse_with_resume(parser: argparse.ArgumentParser, argv):
     env_flags = {"resume", "multihost", "coordinator_address", "num_processes",
                  "process_id", "dp", "tp", "sp", "shard_seq", "zero_opt",
                  # launcher topology/supervision describe THIS invocation
-                 "spawn_hosts", "spawn_attempts",
+                 "spawn_hosts", "spawn_attempts", "elastic", "elastic_quorum",
                  # local paths: never inherit across hosts/invocations
                  "compile_cache", "publish_dir", "publish_every_n_steps"}
     defaults = {

@@ -216,6 +216,35 @@ class DataLoader:
         longest = int(self.sort_key[batch_idx].max(initial=1))
         return resolve_bucket_width(longest, self.group_widths)
 
+    def reshard(self, shard_id: int, num_shards: int) -> None:
+        """Re-point this loader at a new world slice (elastic resize).
+
+        The GLOBAL batch order is a pure function of (seed, epoch, dataset),
+        independent of the shard layout — ``_epoch_indices`` never reads
+        ``shard_id``/``num_shards``; only the per-host contiguous slice of
+        each global batch does. So after an elastic shrink/grow every
+        survivor calls this with its new dense rank and the new world size,
+        and the NEXT iteration (or a mid-epoch restart positioned with
+        ``epoch`` + :meth:`skip_next`) re-slices the SAME global batches at
+        the new width — the dead host's examples land back in the
+        survivors' slices deterministically, with no coordination beyond
+        agreeing on the world. Same validation as construction: the global
+        batch size must divide by every world size the run can resize
+        through (pick e.g. a multiple of lcm(4, 3) for a 4→3→4 drill).
+        """
+        if not (0 <= shard_id < num_shards):
+            raise ValueError(
+                f"shard_id {shard_id} out of range for {num_shards} shards")
+        if self.batch_size % num_shards != 0:
+            raise ValueError(
+                f"global batch_size {self.batch_size} not divisible by "
+                f"num_shards {num_shards}")
+        if num_shards > 1 and not self.drop_last:
+            raise ValueError(
+                "drop_last=False is only supported with num_shards=1")
+        self.shard_id = shard_id
+        self.num_shards = num_shards
+
     def skip_next(self, num_batches: int) -> None:
         """Skip the first ``num_batches`` of the NEXT iteration — deterministic
         mid-epoch resume: the skipped examples are never loaded, and the

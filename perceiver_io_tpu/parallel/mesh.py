@@ -27,7 +27,7 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import dataclasses
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -118,6 +118,112 @@ def initialize_distributed(
         num_processes=num_processes,
         process_id=process_id,
     )
+
+
+@dataclasses.dataclass(frozen=True)
+class WorldDescriptor:
+    """A re-initializable view of the multi-host world (elastic training).
+
+    ``ranks`` are the COORDINATION node ids of the member processes — the id
+    each process registered with on the coordinator, fixed for the process's
+    lifetime even as the world shrinks and grows around it. What jax sees is
+    the DENSE per-generation view: ``process_id = ranks.index(node_id)`` and
+    ``num_processes = len(ranks)``. Keeping the two spaces separate is what
+    lets a generation-2 world of survivors ``(0, 1, 3)`` present itself to
+    jax as a clean 3-process job while KV-store rendezvous keys, heartbeat
+    namespaces and buddy assignments keep using the stable node ids.
+
+    ``generation`` increments on every resize (shrink, grow, or a retried
+    resize after a mid-resize death) and namespaces all rendezvous state, so
+    a straggler from generation N can never consume generation N+1's keys.
+    """
+
+    generation: int
+    ranks: Tuple[int, ...]
+    node_id: int
+
+    def __post_init__(self):
+        object.__setattr__(self, "ranks", tuple(sorted(set(self.ranks))))
+        if self.node_id not in self.ranks:
+            raise ValueError(
+                f"node_id {self.node_id} not a member of ranks {self.ranks}")
+
+    @property
+    def process_id(self) -> int:
+        return self.ranks.index(self.node_id)
+
+    @property
+    def num_processes(self) -> int:
+        return len(self.ranks)
+
+    @property
+    def leader(self) -> int:
+        """The node id that performs leader-only rendezvous work (PJRT key
+        cleanup, invite/state publication): the lowest surviving id."""
+        return self.ranks[0]
+
+    def buddy_of(self, node_id: int) -> int:
+        """The ring buddy that mirrors ``node_id``'s state shard: the next
+        member id (wrapping), so every member has exactly one buddy and one
+        protégé and a single death never takes a shard AND its mirror."""
+        i = self.ranks.index(node_id)
+        return self.ranks[(i + 1) % len(self.ranks)]
+
+    def shrink(self, dead) -> "WorldDescriptor":
+        """The next generation without ``dead`` (an id or iterable of ids)."""
+        gone = {dead} if isinstance(dead, int) else set(dead)
+        survivors = tuple(r for r in self.ranks if r not in gone)
+        return WorldDescriptor(self.generation + 1, survivors, self.node_id)
+
+    def grow(self, new_ids) -> "WorldDescriptor":
+        """The next generation with ``new_ids`` joined (spare/hot join)."""
+        joined = {new_ids} if isinstance(new_ids, int) else set(new_ids)
+        return WorldDescriptor(
+            self.generation + 1, self.ranks + tuple(joined), self.node_id)
+
+    def make_mesh(self, tp: int = 1, sp: int = 1, dcn_dp: int = 1) -> Mesh:
+        """The generation's mesh over the CURRENT global device set (call
+        after :func:`adopt_world` + backend bring-up)."""
+        return make_mesh(tp=tp, sp=sp, dcn_dp=dcn_dp)
+
+
+def reset_backend() -> None:
+    """Demolish the live jax backend so a NEW world can be built in-process.
+
+    The elastic-resize primitive: drops the backend registry, every jit
+    cache, and the global mesh cache, so the next ``jax.devices()`` call
+    re-runs distributed CPU bring-up against whatever
+    ``jax._src.distributed.global_state`` then says (see
+    :func:`adopt_world`). The old PJRT client itself is NOT freed — live
+    jitted functions and arrays keep it referenced indefinitely — which is
+    why the elastic runtime pairs this with socket fencing
+    (``resilience/elastic.py``) instead of waiting for a destructor that
+    never runs.
+    """
+    import gc
+
+    from jax._src import mesh as mesh_lib
+    from jax._src import xla_bridge
+
+    xla_bridge._clear_backends()
+    jax.clear_caches()
+    mesh_lib._mesh_object_dict.clear()
+    gc.collect()
+
+
+def adopt_world(descriptor: WorldDescriptor) -> None:
+    """Point jax's distributed global state at the descriptor's dense view.
+
+    The next backend bring-up (first ``jax.devices()`` after
+    :func:`reset_backend`) then constructs an ``N = num_processes`` world:
+    CPU topology exchange and gloo ring re-run over the coordinator KV store
+    exactly as at process start, just with fewer (or more) participants.
+    """
+    from jax._src import distributed
+
+    state = distributed.global_state
+    state.process_id = descriptor.process_id
+    state.num_processes = descriptor.num_processes
 
 
 def _inner_device_grid(

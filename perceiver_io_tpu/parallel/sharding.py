@@ -276,6 +276,39 @@ def shard_train_state(state, mesh: Mesh, rules=PARAM_RULES, zero_opt=False):
     return _place_tree(state, shardings), shardings
 
 
+def reresolve_shardings(tree: Any, old_mesh: Mesh, new_mesh: Mesh,
+                        rules=PARAM_RULES):
+    """Re-resolve the path-regex rules against a NEW mesh (elastic resize).
+
+    An elastic shrink/grow rebuilds the mesh with a different device count;
+    the RULES are mesh-independent, so the plan for the new world is just
+    :func:`sharding_for_tree` over the new mesh — but a spec that fit the
+    old axis sizes can silently degrade to replication on the new ones
+    (``_spec_fits``: e.g. a ``model``-sharded 6-wide head dim on tp=3 after
+    a tp=2 generation). Degradation is LEGAL — the state stays correct,
+    just bigger per chip — but an operator resizing a memory-tight job must
+    hear about it, so this returns ``(shardings, degraded)`` where
+    ``degraded`` lists the "/"-joined paths whose rule spec applied on
+    ``old_mesh`` but falls back to replicated on ``new_mesh``.
+    """
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+    degraded = []
+
+    def check(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        name = _simple_keystr(path)
+        for pat, spec in compiled:
+            if pat.search(name):
+                if (_spec_fits(spec, shape, old_mesh)
+                        and not _spec_fits(spec, shape, new_mesh)):
+                    degraded.append(name)
+                return
+        return
+
+    jax.tree_util.tree_map_with_path(check, tree)
+    return sharding_for_tree(tree, new_mesh, rules), sorted(degraded)
+
+
 def sp_gradient_canary(mesh: Mesh, axis: str = AXIS_SEQ) -> None:
     """One tiny known-gradient probe through the sequence-parallel kernel.
 

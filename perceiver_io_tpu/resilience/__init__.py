@@ -20,6 +20,11 @@ chaos substrate that proves it works without real hardware failures:
   training: the KV-store peer-liveness monitor and the per-step deadline,
   both exiting with :data:`~perceiver_io_tpu.resilience.multihost
   .EXIT_TRANSIENT` so restart-the-world supervision relaunches the job.
+- :mod:`elastic` — the in-process alternative to restart-the-world:
+  shrink/grow the world on a peer-death verdict without relaunching
+  survivors, with peer-redundant in-memory checkpoints (buddy mirrors)
+  and hot-spare join; degrades to :mod:`multihost` bounded exit below
+  the quorum floor.
 
 Consumers: ``inference/engine.py`` (deadline shedding, bounded-queue
 admission, transient re-dispatch, breaker-gated submission),
@@ -30,6 +35,12 @@ Importing this package never initializes a jax backend.
 """
 
 from perceiver_io_tpu.resilience.breaker import BreakerOpen, CircuitBreaker
+from perceiver_io_tpu.resilience.elastic import (
+    BuddyMirror,
+    BuddyStore,
+    ElasticConfig,
+    ElasticRuntime,
+)
 from perceiver_io_tpu.resilience.failover import AffinityLost, FailoverPolicy
 from perceiver_io_tpu.resilience.faults import (
     FaultInjector,
@@ -56,8 +67,12 @@ from perceiver_io_tpu.resilience.retry import (
 __all__ = [
     "AffinityLost",
     "BreakerOpen",
+    "BuddyMirror",
+    "BuddyStore",
     "CircuitBreaker",
     "DeadlineExceeded",
+    "ElasticConfig",
+    "ElasticRuntime",
     "EXIT_TRANSIENT",
     "FailoverPolicy",
     "FaultInjector",

@@ -1,0 +1,753 @@
+"""Elastic multi-host training: shrink/grow the world without restarting it.
+
+r19's answer to a dead peer is bounded exit + restart-the-world: every
+survivor leaves with ``EXIT_TRANSIENT`` and the supervisor relaunches all N
+processes from the newest checkpoint — measured at 10–11 s decision→resume
+on the 2-process CPU sim, all of it process teardown, re-spawn, jax
+re-init, and re-registration. This module keeps the survivors ALIVE
+instead: on an agreed :class:`~perceiver_io_tpu.resilience.multihost
+.PeerLivenessMonitor` verdict they stop at the step boundary they already
+reached, demolish the device runtime in-process, rebuild the world at N−1,
+re-shard data assignments, and continue from in-memory state — no process
+relaunch. The same rebuild path admits a hot spare back to N. Measured on
+the 4→3→4 CPU drill: decision→resume ≈1.7 s, grow ≈0.3–0.4 s.
+
+Every mechanism below encodes a failure mode found during bring-up (the
+probes are summarized in PERF.md §Elastic training); none is decorative:
+
+- **Control plane sized for the pool.** The coordinator service is started
+  for ``n_max`` (train world + spares) with heartbeats slowed to
+  never-expire, and every process keeps ``shutdown_on_destruction=False``.
+  The coordinator must outlive any single generation: it is the rendezvous
+  and KV channel the resize itself rides. WHO is dead is decided by the
+  fast KV-counter monitor (sub-second), never by the service's own
+  liveness, which would take the whole job down with one verdict.
+- **Socket fencing, not client teardown.** gloo has no timeout: a rank
+  blocked in ``recv`` on a dead pair unblocks ONLY when the socket dies.
+  The CpuClient cannot be freed in-process (live executables pin it), so
+  :meth:`ElasticRuntime.fence` walks ``/proc/self/fd``, finds every TCP
+  socket created AFTER control-plane bring-up, and ``shutdown(SHUT_RDWR)``
+  s it — releasing wedged peers in milliseconds. LISTEN sockets are
+  skipped (shutting one down wakes gloo's ``accept`` with ``EINVAL`` and
+  aborts the process); so is the coordinator connection.
+- **Generation rebuild.** ``reset_backend()`` (parallel/mesh.py) clears
+  backends/caches/mesh registry; survivors rendezvous on per-generation KV
+  keys; the generation leader deletes the stale PJRT topology/gloo keys so
+  re-registration at the new size cannot collide with generation g−1; then
+  ``adopt_world`` points ``jax.distributed.global_state`` at the new dense
+  rank/size and a fresh mesh is built. Programs recompile against the new
+  mesh (sub-second on CPU; a persistent compile cache absorbs it on TPU).
+- **State carries over in host memory**, placed onto the new mesh with
+  ``jax.make_array_from_process_local_data`` — never ``jax.device_put``,
+  whose replicated placement is a hidden broadcast collective that wedges
+  exactly like the one being recovered from. Elastic resume requires the
+  fully-replicated state layout (``snapshot_is_complete``); ZeRO-sharded
+  state degrades to restart-the-world.
+- **Peer-redundant in-memory checkpoints.** Each host mirrors its state
+  snapshot to a buddy (ring neighbor in the world descriptor) over a unix
+  socket speaking the r22 length-prefixed frame + raw-array codec
+  (``serving/transport.py``). The mirror's content digest
+  (``utils/treepath.tree_digest`` — the r13 checkpoint-sidecar discipline)
+  is computed BEFORE the ``multihost.buddy_send`` fault hook, so a
+  corrupted mirror is rejected at restore, never trusted. Unix sockets are
+  untouched by the TCP fence, so mirrors survive resizes.
+- **Quorum floor.** Below ``quorum`` survivors (or with the coordinator
+  host itself dead) elastic resume is off the table:
+  :func:`~perceiver_io_tpu.resilience.multihost.abort_transient` degrades
+  to r19 restart-the-world, which remains the backstop for every failure
+  this module cannot absorb.
+
+Fault sites (drilled in ``tests/test_multihost_recovery.py``):
+``multihost.resize`` fires at the start of every shrink/grow attempt
+(a fatal there = a survivor dying MID-RESIZE; the retry loop re-runs the
+verdict and shrinks again at the next generation), ``multihost.buddy_send``
+fires over the snapshot before framing (nan = a torn mirror the digest
+check must reject), ``multihost.join`` fires on the spare's join edge.
+
+Importing this module never initializes a jax backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from perceiver_io_tpu.resilience import faults
+from perceiver_io_tpu.resilience.multihost import (
+    PeerLivenessMonitor,
+    abort_transient,
+)
+
+_INVITE_KEY = "invite"
+
+
+# -- control-plane plumbing ----------------------------------------------------
+
+
+def _xe():
+    from jax._src.lib import xla_extension as xe
+
+    return xe
+
+
+def _sock_fds() -> Dict[int, Tuple[Optional[int], Optional[str]]]:
+    """fd → (remote_port, tcp_state_hex) for every TCP socket fd of this
+    process, via /proc (inode join between net/tcp* and /proc/self/fd)."""
+    inode_info: Dict[str, Tuple[int, str]] = {}
+    for net in ("/proc/self/net/tcp", "/proc/self/net/tcp6"):
+        try:
+            with open(net) as f:
+                next(f)
+                for line in f:
+                    parts = line.split()
+                    inode_info[parts[9]] = (
+                        int(parts[2].split(":")[1], 16), parts[3])
+        except (OSError, StopIteration):
+            pass
+    out: Dict[int, Tuple[Optional[int], Optional[str]]] = {}
+    try:
+        fds = os.listdir("/proc/self/fd")
+    except OSError:
+        return out
+    for fd in fds:
+        try:
+            tgt = os.readlink(f"/proc/self/fd/{fd}")
+        except OSError:
+            continue
+        if tgt.startswith("socket:["):
+            out[int(fd)] = inode_info.get(tgt[8:-1], (None, None))
+    return out
+
+
+def fetch_with_deadline(arr, deadline_s: float):
+    """Fetch ``arr`` to host with a hard deadline, off-thread.
+
+    Returns ``("ok", value)``, ``("err", exception)`` or ``("wedged",
+    None)``. A fetch that rides a dead collective never returns — the
+    daemon thread is abandoned (the fence then kills the socket it is
+    blocked on) rather than joined forever.
+    """
+    box: Dict[str, Any] = {}
+
+    def _fetch():
+        try:
+            box["v"] = np.asarray(arr)
+        except Exception as e:  # noqa: BLE001 — verdict, not handling
+            box["e"] = e
+
+    t = threading.Thread(target=_fetch, daemon=True)
+    t.start()
+    t.join(deadline_s)
+    if "v" in box:
+        return "ok", box["v"]
+    if "e" in box:
+        return "err", box["e"]
+    return "wedged", None
+
+
+# -- elastic progress (the supervisor's rejoin-success probe) ------------------
+
+
+def progress_path(root: str) -> str:
+    """The per-job elastic progress file (leader-written, supervisor-read)."""
+    return os.path.join(root, "elastic_progress.json")
+
+
+def note_progress(path: str, *, generation: int, step: int,
+                  world_size: int) -> None:
+    """Record a clean step boundary (atomic tmp+rename). The supervisor's
+    ``--elastic`` mode reads this to tell a SUCCESSFUL elastic rejoin from a
+    crash loop: progress advancing past a launch resets the restart budget."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"generation": int(generation), "step": int(step),
+                   "world_size": int(world_size),
+                   "wall": time.time()}, f)
+    os.replace(tmp, path)
+
+
+def read_progress(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+# -- the elastic runtime -------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticConfig:
+    """Knobs for one elastic pool member. ``node_id`` is the STABLE pool
+    identity (coordination node id, also the KV heartbeat id) — distinct
+    from the dense per-generation rank a ``WorldDescriptor`` derives."""
+
+    node_id: int
+    n_max: int
+    coordinator_address: str  # "host:port"; node 0 hosts the service
+    quorum: int = 1
+    namespace: str = "es"
+    monitor_interval_s: float = 0.25
+    monitor_deadline_s: float = 1.5
+    fetch_deadline_s: float = 3.0
+    sync_timeout_ms: int = 60_000
+    resize_attempts: int = 3
+    connect_timeout_s: int = 60
+
+    def __post_init__(self):
+        if not 0 <= self.node_id < self.n_max:
+            raise ValueError(
+                f"node_id {self.node_id} outside pool [0, {self.n_max})")
+        if self.quorum < 1:
+            raise ValueError(f"quorum must be >= 1, got {self.quorum}")
+
+    @property
+    def coordinator_port(self) -> int:
+        return int(self.coordinator_address.rsplit(":", 1)[1])
+
+
+class ElasticRuntime:
+    """One pool member's handle on the elastic control plane.
+
+    Lifecycle: :meth:`start` brings up the pool-sized coordinator
+    connection, captures the socket baseline, and starts the peer monitor
+    (verdict-recording, never process-killing — the RESIZE is the response
+    to a death here, not bounded exit). The training loop then drives
+    :meth:`adopt` / :meth:`rebuild` / :meth:`shrink_until_stable` /
+    invite-based grow at step boundaries. Everything cross-host rides the
+    coordinator KV store; nothing here dispatches a device collective.
+    """
+
+    def __init__(self, config: ElasticConfig,
+                 on_peer_down: Optional[Callable[[int], None]] = None):
+        self.cfg = config
+        self.client = None
+        self.monitor: Optional[PeerLivenessMonitor] = None
+        self.world = None  # Optional[WorldDescriptor]
+        self._service = None
+        self._baseline: set = set()
+        self._fenced: set = set()
+        self._on_peer_down = on_peer_down
+        self._last_invite_gen = -1
+
+    # -- bring-up / teardown --------------------------------------------------
+
+    def start(self) -> "ElasticRuntime":
+        import jax
+        from jax._src import distributed
+
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            # gloo is the only CPU collectives backend that tolerates the
+            # in-process rebuild (mpi pins world size at MPI_Init)
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        xe = _xe()
+        st = distributed.global_state
+        cfg = self.cfg
+        if cfg.node_id == 0:
+            # heartbeats slowed to never-expire: the service must outlive
+            # every generation; the KV monitor owns death verdicts
+            self._service = xe.get_distributed_runtime_service(
+                f"[::]:{cfg.coordinator_port}", cfg.n_max,
+                heartbeat_interval=300, max_missing_heartbeats=100,
+                cluster_register_timeout=cfg.connect_timeout_s,
+                shutdown_timeout=5)
+            st.service = self._service
+        client = xe.get_distributed_runtime_client(
+            cfg.coordinator_address, cfg.node_id,
+            init_timeout=cfg.connect_timeout_s, shutdown_timeout=5,
+            heartbeat_interval=300, max_missing_heartbeats=100,
+            shutdown_on_destruction=False, use_compression=True)
+        client.connect()
+        st.client = client
+        self.client = client
+        self._baseline = set(_sock_fds())
+        self._fenced = set()
+        self.monitor = PeerLivenessMonitor(
+            process_id=cfg.node_id, num_processes=cfg.n_max, kv=client,
+            interval_s=cfg.monitor_interval_s,
+            deadline_s=cfg.monitor_deadline_s,
+            on_peer_down=self._record_peer_down,
+        ).start()
+        return self
+
+    def close(self) -> None:
+        if self.monitor is not None:
+            self.monitor.close()
+
+    def _record_peer_down(self, peer: int) -> None:
+        # peer -1 is the monitor's "coordinator itself unreachable" verdict:
+        # the KV channel the resize would ride is gone — only
+        # restart-the-world can recover that
+        if peer < 0:
+            abort_transient(
+                "coordinator KV store unreachable — elastic resize "
+                "impossible without it; degrading to restart-the-world")
+        import perceiver_io_tpu.obs as obs
+
+        obs.event("elastic_peer_down", peer=peer,
+                  generation=self.world.generation if self.world else -1)
+        if self._on_peer_down is not None:
+            self._on_peer_down(peer)
+
+    # -- socket fencing -------------------------------------------------------
+
+    def fence(self) -> int:
+        """``shutdown(SHUT_RDWR)`` every TCP socket opened since bring-up.
+
+        Releases peers blocked in gloo recv on pairs to a dead rank NOW
+        (there is no gloo timeout — only socket death unblocks them).
+        Skips the coordinator connection and LISTEN sockets (tcp state 0A:
+        shutting a listener down wakes gloo's accept with EINVAL and aborts
+        the process). fds are detached, never closed — a close would free
+        the fd number for reuse while gloo still holds it.
+        """
+        n = 0
+        for fd, (rport, state_hex) in _sock_fds().items():
+            if (fd in self._baseline or fd in self._fenced
+                    or rport is None  # not in the TCP tables: a unix socket
+                    # (buddy mirrors) or other non-TCP fd — never gloo's
+                    or rport == self.cfg.coordinator_port
+                    or state_hex == "0A"):
+                continue
+            try:
+                s = socket.socket(fileno=fd)
+            except OSError:
+                continue
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            s.detach()
+            self._fenced.add(fd)
+            n += 1
+        return n
+
+    # -- KV rendezvous --------------------------------------------------------
+
+    def _key(self, *parts) -> str:
+        return "/".join((self.cfg.namespace,) + tuple(str(p) for p in parts))
+
+    def kv_sync(self, tag: str, ranks: Sequence[int],
+                timeout_ms: Optional[int] = None) -> None:
+        """Barrier over ``ranks`` on per-tag KV keys. set + blocking-get per
+        rank on purpose: ``key_value_dir_get_bytes`` can crash the client in
+        the immediate aftermath of a collective failure."""
+        timeout_ms = timeout_ms or self.cfg.sync_timeout_ms
+        self.client.key_value_set(
+            self._key("sync", tag, self.cfg.node_id), "1",
+            allow_overwrite=True)
+        for r in ranks:
+            self.client.blocking_key_value_get(
+                self._key("sync", tag, r), timeout_ms)
+
+    def _pjrt_cleanup(self) -> int:
+        """Generation leader: delete the stale PJRT topology and gloo
+        rendezvous keys so re-registration at the new world size cannot
+        collide with the previous generation's entries."""
+        doomed = ["cpu:global_topology"]
+        for prefix in ("cpu:local_topology", "cpu:gloo"):
+            try:
+                doomed += [k for k, _ in
+                           self.client.key_value_dir_get_bytes(prefix)]
+            except Exception:  # noqa: BLE001 — absent prefix on gen 0
+                pass
+        for k in doomed:
+            try:
+                self.client.key_value_delete(k)
+            except Exception:  # noqa: BLE001 — already gone
+                pass
+        return len(doomed)
+
+    # -- generations ----------------------------------------------------------
+
+    def adopt(self, descriptor) -> None:
+        """Point jax.distributed and the peer monitor at ``descriptor``."""
+        from perceiver_io_tpu.parallel.mesh import adopt_world
+
+        adopt_world(descriptor)
+        self.world = descriptor
+        self.monitor.set_peers(descriptor.ranks)
+
+    def check_quorum(self, descriptor) -> None:
+        """Degrade to restart-the-world when elastic resume is off the
+        table: below the quorum floor, or the coordinator host itself gone
+        (node 0 hosts the service — without it there is no control plane
+        to resize over)."""
+        if descriptor.num_processes < self.cfg.quorum:
+            abort_transient(
+                f"elastic world {list(descriptor.ranks)} below quorum floor "
+                f"{self.cfg.quorum} — degrading to restart-the-world")
+        if 0 not in descriptor.ranks and self.cfg.node_id != 0:
+            abort_transient(
+                "coordinator host (node 0) left the world — elastic resize "
+                "impossible without its service; restart-the-world")
+
+    def rebuild(self, descriptor) -> float:
+        """Demolish the current device runtime and bring up ``descriptor``.
+
+        Returns the rebuild wall seconds. Sequencing is load-bearing:
+        demolish+fence BEFORE the rendezvous (a survivor still wedged on a
+        dead pair would miss the barrier), barrier BEFORE the leader's key
+        cleanup (a straggler re-registering under generation g−1 keys while
+        the leader deletes them would deadlock bring-up).
+        """
+        t0 = time.monotonic()
+        # chaos hook: the resize negotiation edge — fatal here = a survivor
+        # dying MID-RESIZE (peers' kv_sync below times out; the caller's
+        # retry loop takes a fresh verdict and shrinks again)
+        faults.inject("multihost.resize")
+        from perceiver_io_tpu.parallel.mesh import reset_backend
+
+        reset_backend()
+        self.fence()
+        gen = descriptor.generation
+        self.kv_sync(f"pre_del_g{gen}", descriptor.ranks)
+        if self.cfg.node_id == descriptor.leader:
+            self._pjrt_cleanup()
+            self.client.key_value_set(
+                self._key("clean", f"g{gen}"), "1", allow_overwrite=True)
+        else:
+            self.client.blocking_key_value_get(
+                self._key("clean", f"g{gen}"), self.cfg.sync_timeout_ms)
+        self.adopt(descriptor)
+        wall = time.monotonic() - t0
+        import perceiver_io_tpu.obs as obs
+
+        obs.event("elastic_rebuild", generation=gen,
+                  ranks=list(descriptor.ranks), wall_s=round(wall, 3))
+        return wall
+
+    def dead_in(self, descriptor) -> Tuple[int, ...]:
+        """The monitor's current verdict, restricted to ``descriptor``."""
+        return tuple(p for p in self.monitor.peers_down()
+                     if p in descriptor.ranks)
+
+    def await_death_verdict(self, grace_s: float = 2.0) -> Tuple[int, ...]:
+        """Dispatch failed / fetch wedged: fence immediately (release peers
+        wedged on OUR dead pairs before they miss the verdict window), then
+        wait out one monitor deadline for an agreed verdict."""
+        self.fence()
+        deadline = (time.monotonic()
+                    + self.cfg.monitor_deadline_s + grace_s)
+        while time.monotonic() < deadline:
+            dead = self.dead_in(self.world)
+            if dead:
+                return dead
+            time.sleep(0.05)
+        return self.dead_in(self.world)
+
+    def shrink_until_stable(self, attempts: Optional[int] = None):
+        """Shrink the world until one rebuild completes with every
+        participant alive. A survivor dying MID-RESIZE surfaces as a
+        rendezvous timeout: take a fresh verdict, shrink again at the next
+        generation. Exhausting ``attempts`` degrades to restart-the-world.
+        Returns the stable :class:`~perceiver_io_tpu.parallel.mesh
+        .WorldDescriptor`.
+        """
+        cur = self.world
+        budget = attempts if attempts is not None else self.cfg.resize_attempts
+        for _ in range(budget):
+            nxt = cur.shrink(self.dead_in(cur))
+            self.check_quorum(nxt)
+            try:
+                self.rebuild(nxt)
+                return nxt
+            except faults.InjectedFatalError:
+                # the multihost.resize fatal drill: a fault-killed survivor
+                # must DIE here (the worker exits on it), not consume a
+                # retry as if the rendezvous had merely timed out
+                raise
+            except Exception as e:  # noqa: BLE001 — rendezvous timeout
+                import perceiver_io_tpu.obs as obs
+
+                obs.event("elastic_resize_retry",
+                          generation=nxt.generation, error=type(e).__name__)
+                self.fence()
+                # let the monitor reach a verdict on whoever died mid-resize
+                time.sleep(self.cfg.monitor_deadline_s + 1.0)
+                cur = nxt
+        abort_transient(
+            f"elastic resize failed {budget} consecutive attempts — "
+            f"degrading to restart-the-world")
+
+    # -- grow / hot-spare join ------------------------------------------------
+
+    def post_invite(self, new_ids: Sequence[int],
+                    **extra: Any) -> Dict[str, Any]:
+        """Leader: invite ``new_ids`` into the next generation. Survivors
+        see it at their next step boundary (:meth:`check_invite`); parked
+        spares see it via :meth:`await_invite`. ``extra`` rides the invite
+        verbatim (e.g. ``at_step`` — the agreed boundary every participant
+        switches generations at, so late readers of the sticky key still
+        grow at the same step as the leader)."""
+        ranks = sorted(set(self.world.ranks) | {int(i) for i in new_ids})
+        invite = {"gen": self.world.generation + 1, "ranks": ranks, **extra}
+        self.client.key_value_set(
+            self._key(_INVITE_KEY), json.dumps(invite), allow_overwrite=True)
+        return invite
+
+    def _read_invite(self, timeout_ms: int) -> Optional[Dict[str, Any]]:
+        try:
+            raw = self.client.blocking_key_value_get(
+                self._key(_INVITE_KEY), timeout_ms)
+        except Exception:  # noqa: BLE001 — no invite posted yet
+            return None
+        invite = json.loads(raw)
+        if invite["gen"] <= self._last_invite_gen:
+            return None  # stale: already acted on (the key is sticky)
+        return invite
+
+    def check_invite(self) -> Optional[Dict[str, Any]]:
+        """Survivor, at a step boundary: a pending grow invite, or None.
+        1 ms poll — cheap enough for every step."""
+        invite = self._read_invite(1)
+        if invite is not None and invite["gen"] <= self.world.generation:
+            return None
+        return invite
+
+    def await_invite(self, timeout_ms: int = 600_000,
+                     ) -> Optional[Dict[str, Any]]:
+        """Parked spare: block until invited into a generation."""
+        return self._read_invite(timeout_ms)
+
+    def accept_invite(self, invite: Dict[str, Any]):
+        """Build the invited world descriptor and mark the invite consumed
+        (on every participant — survivors and the joining spare alike)."""
+        from perceiver_io_tpu.parallel.mesh import WorldDescriptor
+
+        self._last_invite_gen = invite["gen"]
+        return WorldDescriptor(generation=invite["gen"],
+                               ranks=tuple(invite["ranks"]),
+                               node_id=self.cfg.node_id)
+
+    def join(self, invite: Dict[str, Any]) -> float:
+        """Spare side of a grow: the same rebuild path the survivors run.
+        Returns the rebuild wall seconds."""
+        # chaos hook: the join edge — transient here = a spare whose join
+        # attempt fails (it re-parks and waits for the next invite)
+        faults.inject("multihost.join")
+        return self.rebuild(self.accept_invite(invite))
+
+
+# -- peer-redundant in-memory checkpoints (buddy mirrors) ----------------------
+
+
+def buddy_path_for(node_id: int, root: Optional[str] = None) -> str:
+    """The node's buddy-mirror unix-socket path (stable across resizes)."""
+    return os.path.join(root or tempfile.gettempdir(),
+                        f"pit-buddy-{node_id}.sock")
+
+
+class BuddyStore:
+    """The receive half: a unix-socket server holding peers' mirrored
+    snapshots in memory, keyed by owner node id. Speaks the r22 transport
+    frame (``serving/transport.py send_frame/recv_frame``); ops: ``put``
+    (store a mirror, ack), ``get`` (return a mirror + its metadata).
+    Mirrors live in THIS process's memory — the redundancy is across
+    hosts, which is exactly the failure domain a resize recovers from.
+    """
+
+    _guarded_by = {"_mirrors": "_lock"}
+
+    def __init__(self, node_id: int, root: Optional[str] = None):
+        self.node_id = int(node_id)
+        self.path = buddy_path_for(node_id, root)
+        self._lock = threading.Lock()
+        self._mirrors: Dict[int, Tuple[Dict[str, Any], bytes]] = {}
+        self._listener: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "BuddyStore":
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+        listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        listener.bind(self.path)
+        listener.listen(8)
+        self._listener = listener
+        self._thread = threading.Thread(
+            target=self._accept_loop, name=f"buddy-store-{self.node_id}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        from perceiver_io_tpu.serving.transport import recv_frame, send_frame
+
+        try:
+            with conn:
+                header, payload = recv_frame(conn)
+                op = header.get("op")
+                if op == "put":
+                    meta = {k: header[k] for k in
+                            ("owner", "gen", "step", "digest")}
+                    with self._lock:
+                        self._mirrors[int(header["owner"])] = (meta, payload)
+                    send_frame(conn, {"ok": True})
+                elif op == "get":
+                    with self._lock:
+                        entry = self._mirrors.get(int(header["owner"]))
+                    if entry is None:
+                        send_frame(conn, {"ok": False})
+                    else:
+                        meta, payload = entry
+                        send_frame(conn, dict(meta, ok=True), payload)
+                else:
+                    send_frame(conn, {"ok": False})
+        except (ConnectionError, OSError, ValueError, KeyError):
+            pass  # a dying peer mid-frame: drop the connection
+
+    def mirror_meta(self, owner: int) -> Optional[Dict[str, Any]]:
+        """Local introspection (tests, drill reporting): the stored
+        mirror's metadata, without moving the payload."""
+        with self._lock:
+            entry = self._mirrors.get(int(owner))
+        return dict(entry[0]) if entry else None
+
+    def close(self) -> None:
+        if self._listener is not None:
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+
+class BuddyMirror:
+    """The send half: mirror this host's state snapshot to its buddy, and
+    pull a mirror back for restore. Payload = the snapshot's leaves in
+    ``jax.tree`` order through the raw-array codec; structure is supplied
+    at restore time by a template snapshot, and integrity by the tree
+    digest carried in the header — computed over the PRE-send tree, so a
+    mirror corrupted in flight (the ``multihost.buddy_send`` nan drill)
+    fails verification at restore instead of poisoning the resumed run."""
+
+    def __init__(self, node_id: int, root: Optional[str] = None,
+                 timeout_s: float = 10.0):
+        self.node_id = int(node_id)
+        self.root = root
+        self.timeout_s = float(timeout_s)
+        self._thread: Optional[threading.Thread] = None
+        self.last_error: Optional[BaseException] = None
+        self.last_meta: Optional[Dict[str, Any]] = None
+
+    def _roundtrip(self, buddy_id: int, header: Dict[str, Any],
+                   payload: bytes = b"") -> Tuple[Dict[str, Any], bytes]:
+        from perceiver_io_tpu.serving.transport import recv_frame, send_frame
+
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+            s.settimeout(self.timeout_s)
+            s.connect(buddy_path_for(buddy_id, self.root))
+            send_frame(s, header, payload)
+            return recv_frame(s)
+
+    def mirror_to(self, buddy_id: int, snapshot, *, generation: int,
+                  step: int) -> Dict[str, Any]:
+        """Push ``snapshot`` (a ``host_state_snapshot`` tree) to the buddy;
+        returns the stored metadata. Synchronous — see
+        :meth:`mirror_async` for the off-step-boundary path."""
+        import jax
+
+        from perceiver_io_tpu.serving.transport import pack_raw_arrays
+        from perceiver_io_tpu.utils.treepath import tree_digest
+
+        digest = tree_digest(snapshot)
+        # chaos hook AFTER the digest: a poisoned mirror must carry the
+        # honest digest of what the sender MEANT to send, so the restore
+        # side's verification rejects it
+        snapshot = faults.fire("multihost.buddy_send", snapshot)
+        leaves = [np.asarray(x) for x in jax.tree.leaves(snapshot)]
+        meta = {"op": "put", "owner": self.node_id, "gen": int(generation),
+                "step": int(step), "digest": digest}
+        resp, _ = self._roundtrip(buddy_id, meta, pack_raw_arrays(leaves))
+        if not resp.get("ok"):
+            raise ConnectionError(
+                f"buddy {buddy_id} refused mirror from node {self.node_id}")
+        self.last_meta = {k: meta[k] for k in
+                          ("owner", "gen", "step", "digest")}
+        return self.last_meta
+
+    def mirror_async(self, buddy_id: int, snapshot, *, generation: int,
+                     step: int) -> bool:
+        """Fire-and-forget mirror off the training thread. At most one in
+        flight — a push landing while the previous is still sending is
+        DROPPED (latest-wins cadence; the next boundary re-mirrors).
+        Returns whether the push was started; failures land in
+        ``last_error`` and are surfaced at the next call."""
+        if self._thread is not None and self._thread.is_alive():
+            return False
+
+        def _push():
+            try:
+                self.mirror_to(buddy_id, snapshot,
+                               generation=generation, step=step)
+                self.last_error = None
+            except BaseException as e:  # noqa: BLE001 — reported next call
+                self.last_error = e
+
+        self._thread = threading.Thread(
+            target=_push, name=f"buddy-mirror-{self.node_id}", daemon=True)
+        self._thread.start()
+        return True
+
+    def flush(self, timeout_s: Optional[float] = None) -> None:
+        """Wait for an in-flight async mirror (step-boundary fence before a
+        resize consumes the mirrors)."""
+        if self._thread is not None:
+            self._thread.join(timeout_s if timeout_s is not None
+                              else self.timeout_s)
+
+    def fetch_from(self, buddy_id: int, owner: int, template,
+                   ) -> Optional[Tuple[Any, Dict[str, Any]]]:
+        """Pull ``owner``'s mirror from ``buddy_id`` and verify it. Returns
+        ``(snapshot, meta)``, or None when the buddy has no mirror OR the
+        digest does not match (a corrupted mirror is rejected here — the
+        caller falls back to the next recovery source, never resumes from
+        torn state)."""
+        import jax
+
+        from perceiver_io_tpu.serving.transport import read_raw_arrays
+        from perceiver_io_tpu.utils.treepath import tree_digest
+
+        resp, payload = self._roundtrip(
+            buddy_id, {"op": "get", "owner": int(owner)})
+        if not resp.get("ok"):
+            return None
+        leaves = read_raw_arrays(payload, copy=True)
+        treedef = jax.tree.structure(template)
+        snapshot = jax.tree.unflatten(treedef, leaves)
+        if tree_digest(snapshot) != resp.get("digest"):
+            import perceiver_io_tpu.obs as obs
+
+            obs.event("elastic_buddy_mirror_corrupt", owner=int(owner),
+                      buddy=int(buddy_id), expected=resp.get("digest"))
+            return None
+        meta = {k: resp[k] for k in ("owner", "gen", "step", "digest")}
+        return snapshot, meta

@@ -39,7 +39,16 @@ Instrumented sites (grep for ``faults.inject`` / ``faults.corrupt``):
 - ``transport.send`` / ``transport.recv`` — the replica RPC data plane
   (``serving/transport.py`` and the HTTP client): before a frame is
   written / after one is accepted, so transport chaos drills (mid-call
-  connection death, torn exchanges) run without killing real processes.
+  connection death, torn exchanges) run without killing real processes;
+- ``multihost.resize`` — the elastic world-resize edge
+  (``resilience/elastic.py``): fired at the start of every shrink/grow
+  attempt, so drills can kill a survivor mid-resize or throttle a
+  straggler;
+- ``multihost.buddy_send`` — ``fire`` hook over the host-local state
+  snapshot before it is framed to the buddy host (NaN corruption here is
+  the corrupted-mirror drill the digest check must catch at restore);
+- ``multihost.join`` — the spare/hot-join path (a spare dying mid-join,
+  or joining while a shrink is in flight).
 
 The registered sites live in :data:`SITES`; :func:`parse_spec` validates
 every clause against them (and the kind set), so a typo'd drill fails
@@ -121,6 +130,18 @@ SITES = (
     # real processes
     "transport.send",
     "transport.recv",
+    # elastic multi-host training (resilience/elastic.py): the resize
+    # negotiation edge (inject at the start of every shrink/grow attempt —
+    # fatal/kill here = a survivor dying MID-RESIZE, so the remaining peers
+    # must re-verdict and resize AGAIN; slow = a straggler survivor), the
+    # buddy in-memory-checkpoint send (fire hook over the host-local
+    # snapshot before it is framed — nan = a corrupted mirror the
+    # tree-digest check must reject at restore), and the spare/hot-join
+    # edge (inject inside the join path — a spare failing, or joining while
+    # a shrink is in flight)
+    "multihost.resize",
+    "multihost.buddy_send",
+    "multihost.join",
 )
 _SUFFIXED = ("engine.dispatch", "engine.complete")
 

@@ -206,6 +206,40 @@ class PeerLivenessMonitor:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    # -- elastic generation changes ------------------------------------------
+
+    def set_peers(self, peer_ids) -> None:
+        """Watch exactly ``peer_ids`` from now on (elastic world resize).
+
+        A shrink/grow changes WHO counts as a peer without restarting the
+        monitor: removed peers' heartbeats close and their down-verdicts
+        clear (a rank that left the world on purpose — or whose death was
+        already acted on — must not keep reading as a live failure), new
+        peers get fresh armed heartbeats, and surviving peers keep their
+        beat state uninterrupted. ``peer_ids`` may include this host's own
+        id; it is ignored.
+        """
+        from perceiver_io_tpu.obs.health import Heartbeat
+
+        wanted = {int(p) for p in peer_ids} - {self._pid}
+        started = self._thread is not None
+        stale = set(self._peer_beats) - wanted
+        for peer in stale:
+            self._peer_beats.pop(peer).close()
+        with self._lock:
+            self._down -= stale
+            for peer in stale:
+                self._last_seen.pop(peer, None)
+        for peer in sorted(wanted - set(self._peer_beats)):
+            hb = Heartbeat(
+                f"multihost_peer{peer}", deadline_s=self._deadline_s,
+                on_stall=(lambda p=peer: self._peer_down(p)),
+            )
+            self._peer_beats[peer] = hb
+            if started:
+                hb.arm()
+        self._n = len(wanted) + 1
+
     # -- introspection (tests / healthz detail) ------------------------------
 
     def peers_down(self) -> Tuple[int, ...]:
@@ -248,7 +282,8 @@ class PeerLivenessMonitor:
             return
         with self._lock:
             self._kv_failures = 0
-        for peer, hb in self._peer_beats.items():
+        # snapshot: set_peers (elastic resize, main thread) mutates the dict
+        for peer, hb in list(self._peer_beats.items()):
             value = entries.get(f"{self._namespace}/{peer}")
             with self._lock:
                 advanced = (value is not None

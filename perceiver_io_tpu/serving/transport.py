@@ -218,6 +218,15 @@ def _recv_frame(sock: socket.socket) -> Tuple[Dict[str, Any], bytes]:
     return header, payload
 
 
+# public aliases for OTHER planes built on the same frame format — the
+# elastic buddy-mirror channel (resilience/elastic.py) ships host-side
+# checkpoint shards over these frames so there is exactly one length-
+# prefixed wire protocol in the tree (same desync-fails-the-connection
+# bounds as the replica data plane)
+send_frame = _send_frame
+recv_frame = _recv_frame
+
+
 # -- the shared-memory slot ring ----------------------------------------------
 
 FREE = "free"

@@ -1,0 +1,366 @@
+"""Worker for the elastic 4→3→4 chaos drill — one pool member.
+
+Run as ``python elastic_worker.py --rank R --pool 5 --port P --workdir D``.
+Ranks 0–3 train a toy sharded linear regression as a 4-process world;
+rank 4 parks as a hot spare on the invite key. The drill script:
+
+1. rank ``--die_rank`` exits hard at step ``--die_at`` (mid-epoch kill);
+2. survivors' next dispatch wedges/errors → fence → monitor verdict →
+   ``shrink_until_stable`` rebuilds the world at 3 IN-PROCESS;
+3. the dead rank's buddy restores its in-memory mirror (digest-verified)
+   and the survivors REPLAY the failed step from the same deterministic
+   global batch — zero steps lost, loss parity with an unkilled control;
+4. at ``--grow_at`` the leader invites the spare; everyone rebuilds at 4
+   via the same resize path, the spare pulling state from its buddy.
+
+Every rank writes ``rank<R>_elastic.json`` with losses, walls, digests and
+generation history; assertions live on the pytest side
+(``tests/test_multihost_recovery.py``). The fault drills (mid-resize death,
+corrupted buddy mirror, flaky spare join) ride PIT_FAULTS in the
+environment — this worker only adds the exit-on-fatal behavior at the
+resize site.
+
+Not named test_* on purpose: pytest must not collect it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--rank", type=int, required=True)
+    parser.add_argument("--pool", type=int, default=5)
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--workdir", required=True)
+    parser.add_argument("--steps", type=int, default=12)
+    parser.add_argument("--die_rank", type=int, default=3)
+    parser.add_argument("--die_at", type=int, default=4,
+                        help="global step at which --die_rank exits; -1 never")
+    parser.add_argument("--grow_at", type=int, default=-2,
+                        help="leader posts the spare invite at this step; "
+                        "-1 never, -2 auto (die_at+3)")
+    parser.add_argument("--quorum", type=int, default=3)
+    parser.add_argument("--sync_timeout_ms", type=int, default=60_000,
+                        help="rendezvous sync timeout; drills that expect a "
+                        "mid-resize death shorten it so the retry path runs "
+                        "inside the test budget")
+    parser.add_argument("--park_timeout_s", type=float, default=120.0)
+    args = parser.parse_args()
+    if args.grow_at == -2:
+        args.grow_at = (args.die_at + 3) if args.die_at >= 0 else 4
+
+    from perceiver_io_tpu.utils.platform import ensure_cpu_only
+
+    ensure_cpu_only(device_count=2)
+    run(args)
+
+
+BATCH = 24  # divides every world size the drill resizes through (4, 3)
+N_EXAMPLES = 96
+TRAIN_WORLD = (0, 1, 2, 3)
+
+
+def _dataset():
+    import numpy as np
+
+    rng = np.random.default_rng(0)  # identical on every node
+    w_true = np.asarray([[1.0], [-2.0], [0.5]], np.float32)
+    x = rng.normal(0, 1, (N_EXAMPLES, 3)).astype(np.float32)
+    return list(zip(x, x @ w_true))
+
+
+def _collate(batch):
+    import numpy as np
+
+    return {"x": np.stack([e[0] for e in batch]),
+            "y": np.stack([e[1] for e in batch])}
+
+
+def run(args) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from perceiver_io_tpu.data.pipeline import DataLoader
+    from perceiver_io_tpu.parallel import make_mesh, make_sharded_train_step
+    from perceiver_io_tpu.parallel.mesh import WorldDescriptor
+    from perceiver_io_tpu.resilience import faults
+    from perceiver_io_tpu.resilience.elastic import (
+        BuddyMirror,
+        BuddyStore,
+        ElasticConfig,
+        ElasticRuntime,
+        fetch_with_deadline,
+        note_progress,
+        progress_path,
+    )
+    from perceiver_io_tpu.training import TrainState
+    from perceiver_io_tpu.training.checkpoint import (
+        host_state_snapshot,
+        restore_from_snapshot,
+        snapshot_digest,
+    )
+
+    rank = args.rank
+    out = {"node_id": rank, "losses": {}, "walls": {}, "events": [],
+           "generations": []}
+
+    rt = ElasticRuntime(ElasticConfig(
+        node_id=rank, n_max=args.pool,
+        coordinator_address=f"localhost:{args.port}",
+        quorum=args.quorum,
+        sync_timeout_ms=args.sync_timeout_ms)).start()
+    store = BuddyStore(rank, root=args.workdir).start()
+    mirror = BuddyMirror(rank, root=args.workdir)
+    examples = _dataset()
+
+    def fresh_state():
+        return TrainState.create(
+            {"w": jnp.zeros((3, 1))}, optax.sgd(0.1), jax.random.key(0))
+
+    def train_step(state, batch):
+        def loss_fn(params):
+            pred = batch["x"] @ params["w"]
+            return jnp.mean((pred - batch["y"]) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads), {"loss": loss}
+
+    class Session:
+        """One generation's device-side artifacts: mesh, jitted step,
+        placed state, loader slice. Rebuilt whole after every resize."""
+
+        def __init__(self, world, snapshot):
+            self.world = world
+            self.mesh = make_mesh()  # over the rebuilt global device set
+            state = fresh_state()
+            if snapshot is not None:
+                state = restore_from_snapshot(snapshot, state)
+            self.loader = DataLoader(
+                examples, batch_size=BATCH, collate=_collate, shuffle=True,
+                seed=0, drop_last=True, shard_id=world.process_id,
+                num_shards=world.num_processes)
+            per_shard = BATCH // world.num_processes
+            # donation OFF: the pre-step state must survive a failed
+            # dispatch — it IS the elastic resume point
+            self.step, self.state, self.b_shardings = make_sharded_train_step(
+                train_step, self.mesh, state,
+                _collate(examples[:per_shard]), donate_state=False)
+            out["generations"].append(
+                {"gen": world.generation, "ranks": list(world.ranks)})
+
+        def to_global(self, batch):
+            return {
+                k: jax.make_array_from_process_local_data(
+                    self.b_shardings[k], v, (BATCH,) + v.shape[1:])
+                for k, v in batch.items()
+            }
+
+    def batch_iter(loader, start_step):
+        """Deterministic handoff: position the (possibly re-sharded) loader
+        at global step ``start_step`` and stream batches from there."""
+        per_epoch = len(loader)
+        loader.epoch = start_step // per_epoch
+        loader.skip_next(start_step % per_epoch)
+        while True:
+            yield from loader
+
+    def snapshot_of(state):
+        return host_state_snapshot(state)
+
+    def mirror_out(world, snap, step):
+        """Push this host's snapshot to its ring buddy AND to its own store
+        (the self-copy is what a joining spare pulls from its buddy)."""
+        mirror.flush()
+        meta = dict(generation=world.generation, step=step)
+        mirror.mirror_to(world.buddy_of(rank), snap, **meta)
+        mirror.mirror_to(rank, snap, **meta)
+
+    dead_ids = set()  # ranks with a death verdict — never re-invited
+
+    def train_loop(world, sess, global_step):
+        it = batch_iter(sess.loader, global_step)
+        t_resume_timer = None
+        grew = False
+        while global_step < args.steps:
+            if rank == args.die_rank and global_step == args.die_at:
+                out["events"].append({"kind": "die", "step": global_step})
+                _flush_json(args, out)
+                os._exit(1)
+
+            # -- grow: act on a pending invite at its agreed boundary ------
+            invite = rt.check_invite()
+            if invite is not None and global_step >= invite.get(
+                    "at_step", global_step):
+                t0 = time.monotonic()
+                snap = snapshot_of(sess.state)
+                mirror_out(world, snap, global_step)
+                sess = None  # drop device refs before the demolish
+                world = rt.accept_invite(invite)
+                rt.rebuild(world)
+                sess = Session(world, snap)
+                it = batch_iter(sess.loader, global_step)
+                out["walls"]["grow_s"] = round(time.monotonic() - t0, 3)
+                grew = True
+                continue
+            if (rank == world.leader and not grew and args.grow_at >= 0
+                    and global_step == args.grow_at
+                    and args.pool > len(TRAIN_WORLD)):
+                spares = [i for i in range(args.pool)
+                          if i not in world.ranks and i not in dead_ids]
+                if spares:
+                    rt.post_invite(spares, at_step=global_step + 2)
+
+            # -- one guarded step ------------------------------------------
+            batch = next(it)
+            try:
+                new_state, metrics = sess.step(
+                    sess.state, sess.to_global(batch))
+                status, v = fetch_with_deadline(
+                    metrics["loss"], rt.cfg.fetch_deadline_s)
+            except Exception as e:  # noqa: BLE001 — peer death surfaces here
+                status, v = "err", e
+            if status == "ok":
+                sess.state = new_state
+                out["losses"][str(global_step)] = float(v)
+                global_step += 1
+                if t_resume_timer is not None:
+                    out["walls"]["decision_to_resume_s"] = round(
+                        time.monotonic() - t_resume_timer, 3)
+                    t_resume_timer = None
+                snap = snapshot_of(sess.state)
+                mirror_out(world, snap, global_step)
+                if rank == world.leader:
+                    note_progress(progress_path(args.workdir),
+                                  generation=world.generation,
+                                  step=global_step,
+                                  world_size=world.num_processes)
+                time.sleep(0.05)
+                continue
+
+            # -- shrink: fence, verdict, rebuild, buddy-restore, replay ----
+            t_detect = time.monotonic()
+            dead = rt.await_death_verdict()
+            dead_ids.update(dead)
+            out["events"].append({"kind": "death_verdict",
+                                  "step": global_step, "dead": list(dead),
+                                  "status": status})
+            # pre-failed-step state: replicated + host-local read, no
+            # collective — safe even with the fleet half dead
+            snap = snapshot_of(sess.state)
+            own_digest = snapshot_digest(snap)
+            new_state = metrics = None
+            sess = None
+            prev_ranks = set(world.ranks)
+            try:
+                world = rt.shrink_until_stable()
+            except faults.InjectedFatalError:
+                # the multihost.resize kill drill: die MID-RESIZE
+                out["events"].append({"kind": "die_in_resize"})
+                _flush_json(args, out)
+                os._exit(1)
+            # ranks discovered dead DURING the resize (a second death
+            # mid-rebuild) also leave the invite pool
+            dead_ids.update(prev_ranks - set(world.ranks))
+            # peer-redundant restore: the dead rank's buddy resumes from
+            # the digest-verified in-memory mirror it holds
+            for d in dead:
+                meta = store.mirror_meta(d)
+                if meta is None:
+                    continue
+                try:
+                    got = mirror.fetch_from(rank, d, snap)
+                except (ConnectionError, OSError):
+                    got = None
+                if got is None:
+                    out["events"].append(
+                        {"kind": "mirror_rejected", "owner": d,
+                         "digest": meta["digest"]})
+                else:
+                    restored, rmeta = got
+                    out["events"].append(
+                        {"kind": "mirror_restored", "owner": d,
+                         "digest": rmeta["digest"],
+                         "own_digest": own_digest,
+                         "bytes": int(sum(np.asarray(x).nbytes for x in
+                                          jax.tree.leaves(restored)))})
+                    snap = restored
+            sess = Session(world, snap)
+            it = batch_iter(sess.loader, global_step)  # REPLAY failed step
+            t_resume_timer = t_detect
+        return world, sess, global_step
+
+    # -- role dispatch ---------------------------------------------------------
+    if rank in TRAIN_WORLD:
+        world = WorldDescriptor(0, TRAIN_WORLD, rank)
+        rt.adopt(world)  # before the first jax.devices(): gen-0 bring-up
+        sess = Session(world, None)
+        world, sess, step = train_loop(world, sess, 0)
+        out["final_step"] = step
+        out["final_w"] = np.asarray(
+            sess.state.params["w"].addressable_data(0)).ravel().tolist()
+        out["final_digest"] = snapshot_digest(snapshot_of(sess.state))
+    else:
+        # hot spare: park on the invite key, join through the resize path
+        invite = None
+        deadline = time.monotonic() + args.park_timeout_s
+        while invite is None and time.monotonic() < deadline:
+            invite = rt.await_invite(timeout_ms=1000)
+        if invite is None:
+            out["events"].append({"kind": "park_timeout"})
+            _flush_json(args, out)
+            os._exit(0)
+        t0 = time.monotonic()
+        while True:
+            try:
+                rt.join(invite)
+                break
+            except faults.InjectedTransientError:
+                # flaky-join drill: re-attempt the SAME invite (survivors
+                # are parked in the rendezvous until we arrive)
+                out["events"].append({"kind": "join_retry"})
+                time.sleep(0.2)
+        world = rt.world
+        buddy = world.buddy_of(rank)
+        template = snapshot_of(fresh_state())
+        got = None
+        for _ in range(50):  # the buddy's self-copy lands at its boundary
+            got = mirror.fetch_from(buddy, buddy, template)
+            if got is not None:
+                break
+            time.sleep(0.1)
+        assert got is not None, f"no state mirror on buddy {buddy}"
+        snap, meta = got
+        out["walls"]["join_s"] = round(time.monotonic() - t0, 3)
+        out["events"].append({"kind": "joined", "from_buddy": buddy,
+                              "at_step": meta["step"],
+                              "digest": meta["digest"]})
+        sess = Session(world, snap)
+        world, sess, step = train_loop(world, sess, meta["step"])
+        out["final_step"] = step
+        out["final_w"] = np.asarray(
+            sess.state.params["w"].addressable_data(0)).ravel().tolist()
+        out["final_digest"] = snapshot_digest(snapshot_of(sess.state))
+
+    _flush_json(args, out)
+    print(f"rank {rank} elastic done", flush=True)
+    # Skip interpreter teardown: the distributed client's C++ destructor can
+    # raise on a world that resized under it (terminate without exception).
+    # The JSON above is the contract; exit codes must stay deterministic.
+    os._exit(0)
+
+
+def _flush_json(args, out) -> None:
+    path = os.path.join(args.workdir, f"rank{args.rank}_elastic.json")
+    with open(path, "w") as f:
+        json.dump(out, f)
+
+
+if __name__ == "__main__":
+    main()

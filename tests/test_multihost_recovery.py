@@ -17,6 +17,7 @@ kill/SIGTERM chaos versions behind slow marks):
   crash-loop detach, and the ``spawn.child_exit`` chaos site.
 """
 
+import json
 import os
 import signal
 import threading
@@ -348,12 +349,16 @@ def test_peer_liveness_kv_failure_escalates():
 
 def test_fault_sites_registered():
     for site in ("trainer.collective", "multihost.heartbeat",
-                 "spawn.child_exit"):
+                 "spawn.child_exit", "multihost.resize",
+                 "multihost.buddy_send", "multihost.join"):
         assert faults.validate_site(site) == site
     # and the grammar accepts drill specs against them
     inj = faults.parse_spec(
         "trainer.collective:nan@3;spawn.child_exit:transient@1")
     assert inj is not None
+    assert faults.parse_spec(
+        "multihost.resize:fatal@1;multihost.buddy_send:nan@every:1;"
+        "multihost.join:transient@1") is not None
 
 
 # -- the restart-the-world supervisor -----------------------------------------
@@ -511,3 +516,453 @@ def test_newest_resumable_run_scans_committed_checkpoints(tmp_path):
     (v2 / "hparams.json").write_text("{}")
     # newest dir is not resumable — fall back to the newest one that is
     assert _newest_resumable_run(str(tmp_path), "exp") == str(base / "version_1")
+
+
+# -- elastic resize (r23): descriptor, progress, buddy mirrors, supervisor ----
+
+
+def test_world_descriptor_shrink_grow_buddy_ring():
+    from perceiver_io_tpu.parallel.mesh import WorldDescriptor
+
+    w = WorldDescriptor(0, (0, 1, 2, 3), node_id=1)
+    assert (w.process_id, w.num_processes, w.leader) == (1, 4, 0)
+    assert [w.buddy_of(r) for r in w.ranks] == [1, 2, 3, 0]
+    s = w.shrink(3)
+    assert s.generation == 1 and s.ranks == (0, 1, 2)
+    assert s.buddy_of(2) == 0  # the ring re-closes over the survivors
+    g = s.grow(4)
+    assert g.generation == 2 and g.ranks == (0, 1, 2, 4)
+    assert g.process_id == 1  # node ids are stable; jax ids are dense
+    assert g.buddy_of(4) == 0
+    with pytest.raises(ValueError):
+        WorldDescriptor(0, (0, 2), node_id=1)  # not a member
+
+
+def test_elastic_progress_file_roundtrip(tmp_path):
+    from perceiver_io_tpu.resilience.elastic import (
+        note_progress, progress_path, read_progress)
+
+    path = progress_path(str(tmp_path))
+    assert read_progress(path) is None  # missing file: no progress yet
+    note_progress(path, generation=1, step=7, world_size=3)
+    rec = read_progress(path)
+    assert (rec["generation"], rec["step"], rec["world_size"]) == (1, 7, 3)
+    assert rec["wall"] > 0
+    note_progress(path, generation=2, step=9, world_size=4)
+    assert read_progress(path)["step"] == 9  # atomic replace, last wins
+
+
+def test_elastic_config_validation():
+    from perceiver_io_tpu.resilience.elastic import ElasticConfig
+
+    cfg = ElasticConfig(node_id=2, n_max=5,
+                        coordinator_address="localhost:12345")
+    assert cfg.coordinator_port == 12345
+    with pytest.raises(ValueError):
+        ElasticConfig(node_id=5, n_max=5,
+                      coordinator_address="localhost:12345")
+
+
+def _np_snapshot():
+    return {"params": {"w": np.arange(6, dtype=np.float32).reshape(3, 2)},
+            "step": np.asarray(4, np.int64)}
+
+
+def test_buddy_mirror_roundtrip_is_digest_identical(tmp_path):
+    from perceiver_io_tpu.resilience.elastic import BuddyMirror, BuddyStore
+    from perceiver_io_tpu.training.checkpoint import snapshot_digest
+
+    store = BuddyStore(0, root=str(tmp_path)).start()
+    try:
+        mirror = BuddyMirror(1, root=str(tmp_path))
+        snap = _np_snapshot()
+        mirror.mirror_to(0, snap, generation=1, step=4)
+        meta = store.mirror_meta(1)
+        assert meta["digest"] == snapshot_digest(snap)
+        assert (meta["owner"], meta["gen"], meta["step"]) == (1, 1, 4)
+        got = mirror.fetch_from(0, 1, _np_snapshot())
+        assert got is not None
+        restored, rmeta = got
+        assert snapshot_digest(restored) == meta["digest"]
+        np.testing.assert_array_equal(restored["params"]["w"],
+                                      snap["params"]["w"])
+        # a shard nobody mirrored is a clean miss, not an error
+        assert mirror.fetch_from(0, 9, _np_snapshot()) is None
+    finally:
+        store.close()
+
+
+def test_buddy_send_corruption_is_digest_rejected(tmp_path):
+    """The multihost.buddy_send chaos site: a NaN-poisoned mirror payload
+    must be REJECTED at fetch time (digest mismatch), never restored."""
+    from perceiver_io_tpu.resilience.elastic import BuddyMirror, BuddyStore
+
+    store = BuddyStore(0, root=str(tmp_path)).start()
+    try:
+        mirror = BuddyMirror(1, root=str(tmp_path))
+        faults.install(faults.parse_spec("multihost.buddy_send:nan@1"))
+        mirror.mirror_to(0, _np_snapshot(), generation=1, step=4)
+        faults.install(None)
+        assert store.mirror_meta(1) is not None  # the PUT itself landed
+        assert mirror.fetch_from(0, 1, _np_snapshot()) is None
+    finally:
+        store.close()
+
+
+def test_reresolve_shardings_reports_degradation():
+    from perceiver_io_tpu.parallel import make_mesh
+    from perceiver_io_tpu.parallel.sharding import reresolve_shardings
+
+    devs = jax.devices()
+    old = make_mesh(dp=2, tp=2, devices=devs[:4])
+    new = make_mesh(dp=2, tp=4, devices=devs[:8])
+    tree = {"q_proj": {"kernel": np.zeros((4, 6), np.float32)},
+            "norm": {"scale": np.zeros((4,), np.float32)}}
+    shardings, degraded = reresolve_shardings(tree, old, new)
+    # 6 % tp=2 fit on the old mesh but 6 % tp=4 falls back to replication
+    assert degraded == ["q_proj/kernel"]
+    assert set(jax.tree.leaves(shardings, is_leaf=lambda x: hasattr(
+        x, "mesh"))) != set()
+    # same tp: nothing degrades
+    _, none_degraded = reresolve_shardings(tree, old, old)
+    assert none_degraded == []
+
+
+def test_dataloader_reshard_preserves_global_batches():
+    from perceiver_io_tpu.data.pipeline import DataLoader
+
+    examples = list(range(24))
+
+    def collate(batch):
+        return np.asarray(batch)
+
+    def epoch_of(loader, epoch):
+        loader.epoch = epoch
+        return [b.tolist() for b in loader]
+
+    whole = DataLoader(examples, batch_size=12, collate=collate,
+                       shuffle=True, seed=3, drop_last=True)
+    shard0 = DataLoader(examples, batch_size=12, collate=collate,
+                        shuffle=True, seed=3, drop_last=True,
+                        shard_id=0, num_shards=4)
+    full = epoch_of(whole, 5)
+    quarter = epoch_of(shard0, 5)
+    # elastic handoff: re-shard 4 -> 3 mid-run; the GLOBAL batch at each
+    # step is unchanged, only its slicing moves
+    shard0.reshard(0, 3)
+    third = epoch_of(shard0, 5)
+    for full_b, q_b, t_b in zip(full, quarter, third):
+        assert full_b[:3] == q_b
+        assert full_b[:4] == t_b
+
+
+class _ScriptedProgress:
+    """A progress probe whose step advances on every call — the signature
+    of an elastic world that keeps training through deaths."""
+
+    def __init__(self, advancing=True):
+        self.calls = 0
+        self.advancing = advancing
+
+    def __call__(self):
+        if self.advancing:
+            self.calls += 1
+        return {"generation": 1, "step": self.calls, "wall": float(self.calls)}
+
+
+def _elastic_supervisor(worlds, n, **kw):
+    from perceiver_io_tpu.cli.common import WorldSupervisor
+
+    launches, sleeps = [], []
+    script = iter(worlds)
+
+    def launch(resume_dir):
+        launches.append(resume_dir)
+        return next(script), [None] * n
+
+    kw.setdefault("poll_s", 0.0)
+    sup = WorldSupervisor(launch=launch, n=n, sleep=sleeps.append, **kw)
+    return sup, launches, sleeps
+
+
+def test_supervisor_elastic_absorbs_death_when_progress_advances():
+    """--elastic: a child death with elastic progress still advancing is
+    ABSORBED — no reap, no relaunch; the survivors finish the job."""
+    absorbed0 = obs.get_registry().counter(
+        "spawn_elastic_absorbed_total").value
+    world = [FakeChild(0, after_polls=8), FakeChild(0, after_polls=8),
+             FakeChild(1, after_polls=2)]
+    sup, launches, _ = _elastic_supervisor(
+        [world], n=3, attempts=2, elastic=True, quorum=1,
+        progress_probe=_ScriptedProgress())
+    sup.run()
+    assert launches == [None]  # one world, zero restarts
+    assert not world[0].terminated and not world[1].terminated
+    assert (obs.get_registry().counter("spawn_elastic_absorbed_total").value
+            == absorbed0 + 1)
+
+
+def test_supervisor_elastic_quorum_floor_restarts_world():
+    """--elastic below the quorum floor degrades to restart-the-world."""
+    import perceiver_io_tpu.cli.common as common
+
+    worlds = [[FakeChild(0, after_polls=None), FakeChild(1, after_polls=2)],
+              [FakeChild(0), FakeChild(0)]]
+    sup, launches, _ = _elastic_supervisor(
+        worlds, n=2, attempts=3, elastic=True, quorum=2,
+        progress_probe=_ScriptedProgress())
+    orig = common._CRASHLOOP_WINDOW_S
+    common._CRASHLOOP_WINDOW_S = -1.0
+    try:
+        sup.run()
+    finally:
+        common._CRASHLOOP_WINDOW_S = orig
+    assert len(launches) == 2  # the restart actuated
+    assert worlds[0][0].terminated  # survivors reaped with the world
+
+
+def test_supervisor_elastic_stalled_progress_restarts_world():
+    """--elastic with quorum met but NO elastic progress inside the grace
+    window falls back to restart-the-world (the resize wedged/failed)."""
+    import perceiver_io_tpu.cli.common as common
+
+    worlds = [[FakeChild(0, after_polls=None), FakeChild(0, after_polls=None),
+               FakeChild(1, after_polls=2)],
+              [FakeChild(0), FakeChild(0), FakeChild(0)]]
+    sup, launches, _ = _elastic_supervisor(
+        worlds, n=3, attempts=3, elastic=True, quorum=1,
+        progress_probe=_ScriptedProgress(advancing=False),
+        elastic_grace_s=0.05)
+    orig = common._CRASHLOOP_WINDOW_S
+    common._CRASHLOOP_WINDOW_S = -1.0
+    try:
+        sup.run()
+    finally:
+        common._CRASHLOOP_WINDOW_S = orig
+    assert len(launches) == 2
+
+
+def test_supervisor_progress_resets_attempt_budget():
+    """The satellite fix: a world that made step progress earns back the
+    FULL --spawn_attempts budget — rejoins reaching a clean boundary (or
+    plain productive training) must not inherit old failures' attempts."""
+    import perceiver_io_tpu.cli.common as common
+
+    worlds = [[FakeChild(5), FakeChild(0)],
+              [FakeChild(5), FakeChild(0)],
+              [FakeChild(0), FakeChild(0)]]
+    sup, launches, _ = _elastic_supervisor(
+        worlds, n=2, attempts=2, progress_probe=_ScriptedProgress())
+    orig = common._CRASHLOOP_WINDOW_S
+    common._CRASHLOOP_WINDOW_S = -1.0
+    try:
+        sup.run()  # would raise SystemExit after 2 launches without the fix
+    finally:
+        common._CRASHLOOP_WINDOW_S = orig
+    assert len(launches) == 3
+
+
+def test_multihost_drill_dry_declares_elastic_keys(capsys):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "multihost_drill", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "multihost_drill.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["--elastic", "--dry"]) == 0
+    record = json.loads(capsys.readouterr().out.strip())
+    assert record["dry"] is True and record["mode"] == "elastic"
+    for key in ("resize_wall_s", "grow_wall_s", "join_wall_s",
+                "buddy_restore_bytes", "steps_lost", "parity", "speedup"):
+        assert key in record
+
+
+# -- the elastic chaos drills (slow): 4 -> 3 -> 4 on the real CPU cluster -----
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ELASTIC_WORKER = os.path.join(_REPO, "tests", "elastic_worker.py")
+
+
+def _spawn_elastic(workdir, *, steps=12, pool=5, die_rank=3, die_at=4,
+                   quorum=3, rank_env=None, extra=()):
+    """Run the elastic pool to completion; returns (rcs, per-rank reports).
+
+    ``rank_env`` maps rank -> extra env (per-rank PIT_FAULTS drills)."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    base_env = dict(os.environ)
+    base_env["PYTHONPATH"] = _REPO + os.pathsep + base_env.get(
+        "PYTHONPATH", "")
+    procs = []
+    for rank in range(pool):
+        env = dict(base_env)
+        env.update((rank_env or {}).get(rank, {}))
+        log = open(os.path.join(str(workdir), f"r{rank}.log"), "w")
+        procs.append(subprocess.Popen(
+            [sys.executable, _ELASTIC_WORKER, "--rank", str(rank),
+             "--pool", str(pool), "--port", str(port),
+             "--workdir", str(workdir), "--steps", str(steps),
+             "--die_rank", str(die_rank), "--die_at", str(die_at),
+             "--quorum", str(quorum), *extra],
+            env=env, stdout=log, stderr=log))
+    rcs = [p.wait(timeout=240) for p in procs]
+    reports = {}
+    for rank in range(pool):
+        path = os.path.join(str(workdir), f"rank{rank}_elastic.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                reports[rank] = json.load(f)
+    return rcs, reports
+
+
+def _control_losses(steps=12):
+    """The unkilled single-process control: the same deterministic global
+    batches (seed/epoch-pure DataLoader order), the same SGD math — what
+    every elastic world must reproduce step for step."""
+    from perceiver_io_tpu.data.pipeline import DataLoader
+
+    rng = np.random.default_rng(0)
+    w_true = np.asarray([[1.0], [-2.0], [0.5]], np.float32)
+    x = rng.normal(0, 1, (96, 3)).astype(np.float32)
+    examples = list(zip(x, x @ w_true))
+
+    def collate(batch):
+        return {"x": np.stack([e[0] for e in batch]),
+                "y": np.stack([e[1] for e in batch])}
+
+    loader = DataLoader(examples, batch_size=24, collate=collate,
+                        shuffle=True, seed=0, drop_last=True)
+    w = np.zeros((3, 1), np.float32)
+    losses = []
+    while len(losses) < steps:
+        for batch in loader:
+            pred = batch["x"] @ w
+            err = pred - batch["y"]
+            losses.append(float(np.mean(err ** 2)))
+            w = w - 0.1 * (2.0 / len(err)) * (batch["x"].T @ err)
+            if len(losses) >= steps:
+                break
+    return losses
+
+
+def _merged_losses(reports, ranks):
+    merged = {}
+    for r in ranks:
+        for k, v in reports[r]["losses"].items():
+            if int(k) in merged:
+                assert merged[int(k)] == v, f"step {k} diverged across ranks"
+            merged[int(k)] = v
+    return merged
+
+
+@pytest.mark.slow  # tier-1 budget (r23): 5-process 4->3->4 chaos drill ~60s
+def test_elastic_chaos_drill_4_3_4(tmp_path):
+    """The acceptance drill: kill rank 3 mid-epoch -> survivors resize to 3
+    IN-PROCESS and replay from the buddy-mirrored boundary (zero steps
+    lost, loss-parity with the unkilled control), then the hot spare joins
+    back to 4 through the same resize path and the whole world converges
+    to one state digest."""
+    rcs, reports = _spawn_elastic(tmp_path)
+    assert rcs[3] == 1, "the killed rank must exit nonzero"
+    assert [rcs[r] for r in (0, 1, 2, 4)] == [0, 0, 0, 0], (
+        f"survivor rcs {rcs}")
+
+    # ISSUE bound: <=1 step loss divergence vs the control; measured zero
+    merged = _merged_losses(reports, (0, 1, 2))
+    lost = sorted(set(range(12)) - set(merged))
+    assert not lost, f"steps lost: {lost}"
+    control = _control_losses(12)
+    for s in range(12):
+        assert abs(merged[s] - control[s]) <= 1e-4 * (abs(control[s]) + 1e-8)
+
+    # peer-redundant restore: the restored shard is digest-identical to
+    # the buddy mirror (replicated state: also to the survivor's own)
+    restored = [e for e in reports[0]["events"]
+                if e["kind"] == "mirror_restored"]
+    assert restored and restored[0]["owner"] == 3
+    assert restored[0]["digest"] == restored[0]["own_digest"]
+    assert restored[0]["bytes"] > 0
+
+    # generation history 4 -> 3 -> 4 on every survivor, dense jax view
+    for r in (0, 1, 2):
+        gens = [(g["gen"], tuple(g["ranks"]))
+                for g in reports[r]["generations"]]
+        assert gens == [(0, (0, 1, 2, 3)), (1, (0, 1, 2)),
+                        (2, (0, 1, 2, 4))]
+
+    # the spare joined from its buddy's self-copy and caught up
+    kinds4 = [e["kind"] for e in reports[4]["events"]]
+    assert "joined" in kinds4
+    assert reports[4]["final_step"] == 12
+
+    # one agreed final state across the post-resize world
+    digests = {reports[r]["final_digest"] for r in (0, 1, 2, 4)}
+    assert len(digests) == 1 and None not in digests
+
+    # recovery wall: decision -> resume, bounded well under the ~10-11s
+    # restart-the-world baseline (PERF.md SElastic training)
+    walls = [reports[r]["walls"]["decision_to_resume_s"] for r in (0, 1, 2)]
+    assert max(walls) < 20.0, f"resize walls {walls}"
+    assert all("grow_s" in reports[r]["walls"] for r in (0, 1, 2))
+    assert "join_s" in reports[4]["walls"]
+
+
+@pytest.mark.slow  # tier-1 budget (r23): fault-site chaos variants ~60s
+def test_elastic_fault_drill_corrupt_mirror_and_flaky_join(tmp_path):
+    """Two drilled fault sites in one world: the dead rank's buddy mirrors
+    were NaN-poisoned in flight (multihost.buddy_send) -> digest-REJECTED
+    at restore, training continues from the survivor's own replicated
+    state; the spare's first join attempt is injected transient
+    (multihost.join) -> it retries the same invite and lands."""
+    rank_env = {3: {"PIT_FAULTS": "multihost.buddy_send:nan@every:1"},
+                4: {"PIT_FAULTS": "multihost.join:transient@1"}}
+    rcs, reports = _spawn_elastic(tmp_path, rank_env=rank_env)
+    assert [rcs[r] for r in (0, 1, 2, 4)] == [0, 0, 0, 0], (
+        f"survivor rcs {rcs}")
+
+    rejected = [e for e in reports[0]["events"]
+                if e["kind"] == "mirror_rejected"]
+    assert rejected and rejected[0]["owner"] == 3
+    assert not any(e["kind"] == "mirror_restored"
+                   for e in reports[0]["events"])
+
+    kinds4 = [e["kind"] for e in reports[4]["events"]]
+    assert "join_retry" in kinds4 and "joined" in kinds4
+
+    merged = _merged_losses(reports, (0, 1, 2))
+    assert sorted(merged) == list(range(12))  # still zero steps lost
+    digests = {reports[r]["final_digest"] for r in (0, 1, 2, 4)}
+    assert len(digests) == 1 and None not in digests
+
+
+@pytest.mark.slow  # tier-1 budget (r23): double-death mid-resize drill ~90s
+def test_elastic_fault_drill_death_mid_resize(tmp_path):
+    """A second rank dies INSIDE the resize (multihost.resize:fatal, the
+    kill -9 drill): the first rebuild attempt times out on the dead
+    rank's rendezvous key, shrink_until_stable retires it and lands the
+    remaining two; the spare still joins at the agreed boundary."""
+    rank_env = {2: {"PIT_FAULTS": "multihost.resize:fatal@1"}}
+    rcs, reports = _spawn_elastic(
+        tmp_path, quorum=2, rank_env=rank_env,
+        extra=("--sync_timeout_ms", "8000"))
+    assert rcs[0] == 0 and rcs[1] == 0, f"survivor rcs {rcs}"
+    assert rcs[2] == 1 and rcs[3] == 1
+
+    assert any(e["kind"] == "die_in_resize" for e in reports[2]["events"])
+    for r in (0, 1):
+        gens = [tuple(g["ranks"]) for g in reports[r]["generations"]]
+        assert (0, 1) in gens and gens[-1] == (0, 1, 4)
+        assert reports[r]["final_step"] == 12
+
+    merged = _merged_losses(reports, (0, 1))
+    assert sorted(merged) == list(range(12))
+    assert reports[4]["final_step"] == 12
+    digests = {reports[r]["final_digest"] for r in (0, 1, 4)}
+    assert len(digests) == 1 and None not in digests

@@ -112,6 +112,16 @@ FLOOR_CLASSES: List[Tuple[str, str, float, str, str]] = [
      r"|(^|\.)ar_decode_slot_occupancy$", "frac", 0.10, "higher",
      "PERF.md §Continuous batching r20: occupancy/steps-per-dispatch are "
      "schedule-determined aggregates; ~10% run-to-run on CPU"),
+    # multihost_drill (r19 restart / r23 elastic): recovery walls are
+    # host-clock CPU-sim walls; the elastic-vs-restart `speedup` is a
+    # same-process paired ratio and matches the r20 speedup class above.
+    (r"(^|\.)(kill_to_\w+_s|total_wall_s|resize_wall_s|grow_wall_s"
+     r"|join_wall_s|restart_baseline_s)$", "frac", HOST_FLOOR, "lower",
+     "PERF.md §Elastic training r23: recovery walls are host-clock, "
+     "cross-session (±2x swing)"),
+    (r"(^|\.)steps_lost$", "abs", 0.0, "lower",
+     "PERF.md §Elastic training r23: zero-loss accounting is "
+     "deterministic — ANY lost step is a regression"),
 ]
 
 # bench.py's headline: 'value' is device-trace only when the record says so
