@@ -227,15 +227,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serving compute dtype: float32 is the golden-parity "
                         "path; bfloat16 rebuilds the model at bf16 compute "
                         "and casts params once (the bf16 serving path)")
-    g.add_argument("--quantize", choices=("none", "int8"), default="none",
+    g.add_argument("--quantize", choices=("none", "int8", "int4"),
+                   default="none",
                    help="weight-only quantization: int8 stores the matmul "
                         "kernels as per-channel symmetric int8 (f32 scales), "
-                        "dequantized inside the compiled program — halves "
-                        "the weight bytes streamed from HBM per micro-batch "
-                        "vs bf16 (the measured serving bottleneck). Params "
-                        "are quantized once at load; the checkpoint stays "
-                        "f32 on disk. Composes with --dtype: compute runs "
-                        "at --dtype, only weight STORAGE is int8")
+                        "int4 as grouped symmetric int4 (one scale per "
+                        "--group_size rows of each column), dequantized "
+                        "inside the compiled program — 0.5x/0.25x the weight "
+                        "bytes streamed from HBM per micro-batch vs bf16 "
+                        "(the measured serving bottleneck); on TPU the fused "
+                        "dequant-matmul kernel streams the int tiles "
+                        "directly. Params are quantized once at load; the "
+                        "checkpoint stays f32 on disk. Composes with "
+                        "--dtype: compute runs at --dtype, only weight "
+                        "STORAGE is int8/int4")
+    g.add_argument("--group_size", type=int, default=None,
+                   help="rows per int4 scale group (default 128); int8 "
+                        "stays per-channel unless set")
     g.add_argument("--cached", action="store_true",
                    help="serve via the latent-cache split (encode once, "
                         "decode the [MASK] queries) instead of the fused "
@@ -701,6 +709,7 @@ def _serve(args, MLMServer, load_tokenizer, load_mlm_checkpoint,
         max_delay_ms=args.max_delay_ms,
         compute_dtype="bfloat16" if args.dtype == "bfloat16" else None,
         quantize=None if args.quantize == "none" else args.quantize,
+        group_size=args.group_size,
         heartbeat_deadline_s=args.heartbeat_deadline_s,
         selfprofile_every=args.selfprofile_every,
         request_deadline_s=args.request_deadline_s,
@@ -951,6 +960,8 @@ def _serve_fleet(args, drain_state):
         extra += ["--step", str(args.step)]
     if args.quantize != "none":
         extra += ["--quantize", args.quantize]
+    if args.group_size is not None:
+        extra += ["--group_size", str(args.group_size)]
     if args.compile_cache:
         extra += ["--compile_cache", args.compile_cache]
     if args.no_warmup:

@@ -409,6 +409,8 @@ class ContinuousBatcher(ARGenerator):
         slots: int = 8,
         max_slots: int = 64,
         compute_dtype: Optional[str] = None,
+        quantize: Optional[str] = None,
+        group_size: Optional[int] = None,
         name: str = "generate",
         registry: Optional[obs.MetricsRegistry] = None,
         compile_cache: Optional[str] = None,
@@ -417,8 +419,11 @@ class ContinuousBatcher(ARGenerator):
         import jax
         import jax.numpy as jnp
 
+        from perceiver_io_tpu.quant import apply_operands
+
         super().__init__(model, params, max_seq_len, chunk=chunk,
-                         compute_dtype=compute_dtype, name=name,
+                         compute_dtype=compute_dtype, quantize=quantize,
+                         group_size=group_size, name=name,
                          registry=registry)
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -487,6 +492,11 @@ class ContinuousBatcher(ARGenerator):
 
         def arena_decode_fn(p, buf, temperature, top_k, seeds, steps_left):
             n_slots = steps_left.shape[0]
+            # quantized tree -> QKernel operands ONCE per dispatch; the
+            # vmapped per-slot steps then share one int-byte weight stream —
+            # batched decode over quantized weights is exactly the
+            # compounding play (weight stream ~= the whole decode bill)
+            p = apply_operands(p)
 
             def body(i, carry):
                 buf_c, out = carry
@@ -628,6 +638,8 @@ class ContinuousBatcher(ARGenerator):
 
             base = dict(environment_fingerprint())
             base.update(chunk=self.chunk,
+                        quantize=str(self.quantize),
+                        group_size=str(self.group_size),
                         sources=tuple(callable_sources(self.model.apply)))
             self._fp_base = base
         return self._fp_base

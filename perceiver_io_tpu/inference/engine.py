@@ -168,26 +168,31 @@ def resolve_params_mode(
     compute_dtype: Optional[str], quantize: Optional[str]
 ) -> Tuple[Optional[str], Optional[str]]:
     """Normalize the (compute_dtype, quantize) pair — ONE definition of the
-    ``'int8w'`` shorthand (bf16 compute over int8-stored weights) and the
-    mode validation, shared by ``ServingEngine`` and ``MLMServer`` so the
-    two can never drift."""
+    ``'int8w'``/``'int4w'`` shorthands (bf16 compute over int-stored
+    weights) and the mode validation, shared by ``ServingEngine``,
+    ``MLMServer``, and the decode engines so they can never drift."""
     # validate BEFORE the shorthand rewrite: compute_dtype='int8w' must not
     # silently swallow a typo'd quantize= argument
-    if quantize not in (None, "int8"):
+    if quantize not in (None, "int8", "int4"):
         raise ValueError(
-            f"unknown quantize mode {quantize!r}; expected None or 'int8'"
+            f"unknown quantize mode {quantize!r}; expected None, 'int8', "
+            "or 'int4'"
         )
     if compute_dtype == "int8w":
         compute_dtype, quantize = "bfloat16", "int8"
+    elif compute_dtype == "int4w":
+        compute_dtype, quantize = "bfloat16", "int4"
     return compute_dtype, quantize
 
 
-def prepare_param_tree(params, compute_dtype, quantize: Optional[str]):
+def prepare_param_tree(params, compute_dtype, quantize: Optional[str],
+                       group_size: Optional[int] = None):
     """Load-time param preparation under a serving mode (no device_put):
     cast floating leaves to ``compute_dtype`` (bf16 path), or quantize the
-    matmul kernels to int8 with the remaining floats cast (int8w path —
-    scales computed from the caller's tree, so hand in f32 for full scale
-    precision). A tree that is already ``QuantizedParams`` is trusted as
+    matmul kernels to int8/int4 with the remaining floats cast (int8w/int4w
+    paths — scales computed from the caller's tree, so hand in f32 for full
+    scale precision; int4 defaults to grouped scales, ``group_size``
+    overrides). A tree that is already ``QuantizedParams`` is trusted as
     prepared."""
     import jax
     import jax.numpy as jnp
@@ -196,10 +201,12 @@ def prepare_param_tree(params, compute_dtype, quantize: Optional[str]):
 
     if is_quantized(params):
         return params  # prepared upstream (e.g. once for MLMServer's 3 engines)
-    if quantize == "int8":
+    if quantize in ("int8", "int4"):
         return quantize_tree(
             params,
             compute_dtype=str(jnp.dtype(compute_dtype or jnp.float32)),
+            bits=8 if quantize == "int8" else 4,
+            group_size=group_size,
         )
     if compute_dtype is not None:
         dt = jnp.dtype(compute_dtype)
@@ -431,6 +438,7 @@ class ServingEngine:
         max_inflight: int = 2,
         compute_dtype: Optional[str] = None,
         quantize: Optional[str] = None,
+        group_size: Optional[int] = None,
         donate_inputs: Optional[bool] = None,
         name: str = "serve",
         registry: Optional[obs.MetricsRegistry] = None,
@@ -452,7 +460,7 @@ class ServingEngine:
         import jax
         import jax.numpy as jnp
 
-        from perceiver_io_tpu.quant import dequantize_tree, is_quantized
+        from perceiver_io_tpu.quant import is_quantized, kernel_operands
 
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -480,8 +488,18 @@ class ServingEngine:
             # implies the mode; its baked compute dtype is validated in
             # _prepare_params — which also guards update_params, so a later
             # hot-swap cannot slip in a mismatched tree either
-            quantize = "int8"
+            quantize = params.mode
+            group_size = params.group_size
+        if quantize == "int4" and group_size is None:
+            # pin the effective group size at construction so the mode
+            # guard in _prepare_params can demand exact equality — a
+            # hot-swap with a different grouping changes the treedef and
+            # would recompile every warmed bucket program
+            from perceiver_io_tpu.quant import DEFAULT_GROUP_SIZE
+
+            group_size = DEFAULT_GROUP_SIZE
         self.quantize = quantize
+        self.group_size = group_size
         self._compute_dtype = (
             None if compute_dtype is None else jnp.dtype(compute_dtype)
         )
@@ -505,9 +523,11 @@ class ServingEngine:
 
         def call(p, inputs):
             if is_quantized(p):
-                # traced inside the jit: XLA fuses the int8→compute-dtype
-                # dequant into the matmul operand reads (weight-only int8)
-                p = dequantize_tree(p)
+                # traced inside the jit: quantized kernels travel as QKernel
+                # operands to the linear_apply sites, where the fused
+                # dequant-matmul (TPU) or the XLA-fused dequant (elsewhere)
+                # streams the int8/int4 bytes (ops/pallas_matmul.py)
+                p = kernel_operands(p)
             return apply_fn(p, *inputs)
 
         self._call = call
@@ -700,16 +720,20 @@ class ServingEngine:
 
         if is_quantized(params):
             want = str(jnp.dtype(self._compute_dtype or jnp.float32))
-            if self.quantize != "int8" or params.compute_dtype != want:
+            if (self.quantize != params.mode
+                    or params.compute_dtype != want
+                    or self.group_size != params.group_size):
                 raise ValueError(
-                    f"pre-quantized params (compute_dtype="
-                    f"{params.compute_dtype!r}) do not match this engine's "
-                    f"mode (quantize={self.quantize!r}, compute_dtype="
-                    f"{want!r}) — re-quantize under the engine's mode or "
-                    "pass the raw f32 tree"
+                    f"pre-quantized params (mode={params.mode!r}, "
+                    f"compute_dtype={params.compute_dtype!r}, group_size="
+                    f"{params.group_size}) do not match this engine's mode "
+                    f"(quantize={self.quantize!r}, compute_dtype={want!r}, "
+                    f"group_size={self.group_size}) — re-quantize under the "
+                    "engine's mode or pass the raw f32 tree"
                 )
         return jax.device_put(
-            prepare_param_tree(params, self._compute_dtype, self.quantize)
+            prepare_param_tree(params, self._compute_dtype, self.quantize,
+                               self.group_size)
         )
 
     def update_params(self, params) -> None:
@@ -1315,6 +1339,7 @@ class ServingEngine:
             base.update(
                 donate=self.donate_inputs,
                 quantize=str(self.quantize),
+                group_size=str(self.group_size),
                 compute_dtype=str(self._compute_dtype),
                 salt=self._cache_salt,
             )
@@ -1756,6 +1781,7 @@ class MLMServer:
         max_inflight: int = 2,
         compute_dtype: Optional[str] = None,
         quantize: Optional[str] = None,
+        group_size: Optional[int] = None,
         registry: Optional[obs.MetricsRegistry] = None,
         heartbeat_deadline_s: Optional[float] = None,
         selfprofile_every: int = 0,
@@ -1797,10 +1823,11 @@ class MLMServer:
         # prepare_param_tree, so server and engine can never drift)
         compute_dtype, quantize = resolve_params_mode(compute_dtype, quantize)
         self._compute_dtype, self._quantize = compute_dtype, quantize
+        self._group_size = group_size
         self._update_lock = threading.Lock()
         self._warmup_handles: List[WarmupHandle] = []
         params = jax.device_put(
-            prepare_param_tree(params, compute_dtype, quantize)
+            prepare_param_tree(params, compute_dtype, quantize, group_size)
         )
 
         apply_fns = mlm_apply_fns(model)
@@ -1995,7 +2022,8 @@ class MLMServer:
 
         with self._update_lock:
             prepared = jax.device_put(
-                prepare_param_tree(params, self._compute_dtype, self._quantize)
+                prepare_param_tree(params, self._compute_dtype,
+                                   self._quantize, self._group_size)
             )
             for eng in (self.engine, self.encoder, self.decoder):
                 eng.update_params(prepared)

@@ -148,12 +148,18 @@ class ARGenerator:
         max_seq_len: int,
         chunk: int = 8,
         compute_dtype: Optional[str] = None,
+        quantize: Optional[str] = None,
+        group_size: Optional[int] = None,
         name: str = "generate",
         registry: Optional[obs.MetricsRegistry] = None,
     ):
         import jax
 
-        from perceiver_io_tpu.inference.engine import prepare_param_tree
+        from perceiver_io_tpu.inference.engine import (
+            prepare_param_tree,
+            resolve_params_mode,
+        )
+        from perceiver_io_tpu.quant import apply_operands, is_quantized
 
         if chunk < 1:
             raise ValueError(f"chunk must be >= 1, got {chunk}")
@@ -170,12 +176,26 @@ class ARGenerator:
             w += self.capacity - 1
         widths.append(max_seq_len)
         self.widths = widths
-        self.params = jax.device_put(
-            prepare_param_tree(params, compute_dtype, None))
+        # same mode surface as ServingEngine: quantize='int8'/'int4' (or the
+        # compute_dtype='int8w'/'int4w' shorthands) store the projection
+        # kernels as int bytes, and the batched step's GEMMs stream them
+        # through the fused dequant-matmul at the linear_apply sites
+        compute_dtype, quantize = resolve_params_mode(compute_dtype, quantize)
+        prepared = prepare_param_tree(params, compute_dtype, quantize,
+                                      group_size)
+        if is_quantized(prepared):
+            # read the mode off the PREPARED tree: covers pre-quantized
+            # input and int4's default grouping in one place, so the AOT
+            # fingerprint always names the effective layout
+            quantize, group_size = prepared.mode, prepared.group_size
+        self.quantize = quantize
+        self.group_size = group_size
+        self.params = jax.device_put(prepared)
 
         def prefill_fn(p, ids, pad, length):
             import jax.numpy as jnp
 
+            p = apply_operands(p)  # quantized tree -> QKernel operands
             logits, cache = model.apply(
                 {"params": p}, ids, pad, length=length, method="prefill")
             n_cap = logits.shape[1]
@@ -191,6 +211,8 @@ class ARGenerator:
             import jax.numpy as jnp
 
             b = logits_in.shape[0]
+
+            p = apply_operands(p)  # quantized tree -> QKernel operands
 
             def body(i, carry):
                 cache, logits, out = carry

@@ -26,9 +26,11 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from perceiver_io_tpu.ops.attention import (
+    _LinearParams,
     torch_linear_bias_init,
     torch_linear_kernel_init,
 )
+from perceiver_io_tpu.ops.pallas_matmul import linear_apply
 from perceiver_io_tpu.ops.fourier import (
     fourier_position_encodings,
     num_position_encoding_channels,
@@ -238,13 +240,13 @@ class ClassificationOutputAdapter(OutputAdapter):
     def __call__(self, x: Array) -> Array:
         c_in = self.output_shape[-1]
         n_out = self.padded_num_classes
-        x = nn.Dense(
-            n_out,
-            dtype=self.dtype,
-            kernel_init=torch_linear_kernel_init,
-            bias_init=torch_linear_bias_init(c_in),
-            name="linear",
-        )(x)
+        w, b = _LinearParams(
+            x.shape[-1], n_out, kernel_init=torch_linear_kernel_init,
+            bias_init=torch_linear_bias_init(c_in), name="linear")()
+        # the vocab head is the single biggest weight stream in the serving
+        # forward — linear_apply routes a quantized tree's kernel through
+        # the fused dequant-matmul
+        x = linear_apply(x, w, b, self.dtype)
         if n_out != self.num_classes:
             # finite stand-in for -inf: exp() underflows to exactly 0 in the
             # downstream softmax/logsumexp, and no argmax/top-k can pick it

@@ -29,9 +29,11 @@ from flax import linen as nn
 
 from perceiver_io_tpu.models.adapters import InputAdapter, OutputAdapter
 from perceiver_io_tpu.ops.attention import (
+    _LinearParams,
     torch_linear_bias_init,
     torch_linear_kernel_init,
 )
+from perceiver_io_tpu.ops.pallas_matmul import linear_apply
 from perceiver_io_tpu.ops.fourier import (
     fourier_position_encodings,
     num_position_encoding_channels,
@@ -129,13 +131,12 @@ class DenseSpatialOutputAdapter(OutputAdapter):
     @nn.compact
     def __call__(self, x: Array) -> Array:
         b = x.shape[0]
-        x = nn.Dense(
-            self.num_output_features,
-            dtype=self.dtype,
+        wl, bl = _LinearParams(
+            x.shape[-1], self.num_output_features,
             kernel_init=torch_linear_kernel_init,
             bias_init=torch_linear_bias_init(self.num_output_channels),
-            name="linear",
-        )(x)
+            name="linear")()
+        x = linear_apply(x, wl, bl, self.dtype)
         h, w = self.spatial_shape
         return x.reshape(b, h, w, self.num_output_features)
 

@@ -50,9 +50,11 @@ from perceiver_io_tpu.models.adapters import (
     OutputAdapter,
 )
 from perceiver_io_tpu.ops.attention import (
+    _LinearParams,
     torch_linear_bias_init,
     torch_linear_kernel_init,
 )
+from perceiver_io_tpu.ops.pallas_matmul import linear_apply
 from perceiver_io_tpu.ops.fourier import (
     fourier_position_encodings,
     num_position_encoding_channels,
@@ -247,13 +249,12 @@ class AudioOutputAdapter(OutputAdapter):
     @nn.compact
     def __call__(self, x: Array) -> Array:
         b = x.shape[0]
-        x = nn.Dense(
-            self.samples_per_patch * self.num_audio_channels,
-            dtype=self.dtype,
+        w, bias = _LinearParams(
+            x.shape[-1], self.samples_per_patch * self.num_audio_channels,
             kernel_init=torch_linear_kernel_init,
             bias_init=torch_linear_bias_init(self.num_output_channels),
-            name="linear",
-        )(x)
+            name="linear")()
+        x = linear_apply(x, w, bias, self.dtype)
         return x.reshape(b, self.num_samples, self.num_audio_channels)
 
 
@@ -294,13 +295,12 @@ class VideoOutputAdapter(OutputAdapter):
         b = x.shape[0]
         (gt, gh, gw), (pt, ph, pw) = self.grid_shape, self.patch_shape
         c = self.video_shape[-1]
-        x = nn.Dense(
-            math.prod(self.patch_shape) * c,
-            dtype=self.dtype,
+        w, bias = _LinearParams(
+            x.shape[-1], math.prod(self.patch_shape) * c,
             kernel_init=torch_linear_kernel_init,
             bias_init=torch_linear_bias_init(self.num_output_channels),
-            name="linear",
-        )(x)
+            name="linear")()
+        x = linear_apply(x, w, bias, self.dtype)
         if self.as_patches:
             return x  # (B, N_patches, pt·ph·pw·C)
         x = x.reshape(b, gt, gh, gw, pt, ph, pw, c)

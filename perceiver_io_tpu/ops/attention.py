@@ -53,6 +53,14 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+# Every projection in this module applies through ``linear_apply``: plain
+# tensors take the exact flax-Dense math (promote_dtype + x @ w + b), while
+# a quantized tree's ``QKernel`` leaves (quant/int8.py) dispatch to the
+# fused dequant-matmul kernel — the model code itself never branches on
+# quantization beyond the fused-stack special case below.
+from perceiver_io_tpu.ops.pallas_matmul import linear_apply
+from perceiver_io_tpu.quant.int8 import QKernel
+
 Array = jax.Array
 
 # torch nn.Linear default init: U(±1/sqrt(fan_in)) for weight and bias
@@ -274,17 +282,25 @@ class MultiHeadAttention(nn.Module):
             # which this call does not have)
             wk, bk = _LinearParams(x_kv.shape[-1], e, name="k_proj")()
             wv, bv = _LinearParams(x_kv.shape[-1], e, name="v_proj")()
-            xkv, wk, bk, wv, bv = nn.dtypes.promote_dtype(
-                x_kv, wk, bk, wv, bv, dtype=self.dtype)
-            return xkv @ wk + bk, xkv @ wv + bv
+            return (linear_apply(x_kv, wk, bk, self.dtype),
+                    linear_apply(x_kv, wv, bv, self.dtype))
 
         wq, bq = _LinearParams(x_q.shape[-1], e, name="q_proj")()
         wk, bk = _LinearParams(x_kv.shape[-1], e, name="k_proj")()
         wv, bv = _LinearParams(x_kv.shape[-1], e, name="v_proj")()
         if kv is not None:
             k, v = kv
-            xq, wq, bq = nn.dtypes.promote_dtype(x_q, wq, bq, dtype=self.dtype)
-            q = xq @ wq + bq
+            q = linear_apply(x_q, wq, bq, self.dtype)
+        elif isinstance(wq, QKernel) and x_q is x_kv:
+            # quantized self-attention: the fused-stack trick below cannot
+            # stack int kernels with distinct scale grids, so the three
+            # projections apply separately through the dequant-matmul
+            # kernel. The stack's win was reading the input once on the
+            # TRAINING path; on the quantized serving path the weight
+            # stream is the bill, and that still streams int bytes here.
+            q = linear_apply(x_q, wq, bq, self.dtype)
+            k = linear_apply(x_kv, wk, bk, self.dtype)
+            v = linear_apply(x_kv, wv, bv, self.dtype)
         elif x_q is x_kv:
             # self-attention: one fused matmul instead of three — the input
             # is read once and the three skinny gemms become one (measured
@@ -303,12 +319,9 @@ class MultiHeadAttention(nn.Module):
             qkv = jnp.einsum("btc,nce->btne", x, w) + bias
             q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         else:
-            xq, wq, bq = nn.dtypes.promote_dtype(x_q, wq, bq, dtype=self.dtype)
-            xkv, wk, bk = nn.dtypes.promote_dtype(x_kv, wk, bk, dtype=self.dtype)
-            _, wv, bv = nn.dtypes.promote_dtype(x_kv, wv, bv, dtype=self.dtype)
-            q = xq @ wq + bq
-            k = xkv @ wk + bk
-            v = xkv @ wv + bv
+            q = linear_apply(x_q, wq, bq, self.dtype)
+            k = linear_apply(x_kv, wk, bk, self.dtype)
+            v = linear_apply(x_kv, wv, bv, self.dtype)
 
         b, t = q.shape[:2]
         s = k.shape[1]
@@ -415,13 +428,9 @@ class MultiHeadAttention(nn.Module):
                 v.reshape(b, s, h, d), pad_mask, attn_mask,
                 self.dropout, dropout_rng, deterministic,
             ).reshape(b, t, e)
-        out = nn.Dense(
-            features=e,
-            dtype=self.dtype,
-            kernel_init=torch_linear_kernel_init,
-            bias_init=nn.initializers.zeros_init(),
-            name="out_proj",
-        )(out)
+        wo, bo = _LinearParams(e, e, kernel_init=torch_linear_kernel_init,
+                               name="out_proj")()
+        out = linear_apply(out, wo, bo, self.dtype)
         if return_kv:
             return out, (k, v)
         return out
@@ -522,21 +531,15 @@ class MLP(nn.Module):
     def __call__(self, x):
         c = self.num_channels
         x = layer_norm(self.dtype, "norm")(x)
-        x = nn.Dense(
-            c,
-            dtype=self.dtype,
-            kernel_init=torch_linear_kernel_init,
-            bias_init=torch_linear_bias_init(c),
-            name="dense_1",
-        )(x)
+        w1, b1 = _LinearParams(
+            x.shape[-1], c, kernel_init=torch_linear_kernel_init,
+            bias_init=torch_linear_bias_init(c), name="dense_1")()
+        x = linear_apply(x, w1, b1, self.dtype)
         x = nn.gelu(x, approximate=False)
-        x = nn.Dense(
-            c,
-            dtype=self.dtype,
-            kernel_init=torch_linear_kernel_init,
-            bias_init=torch_linear_bias_init(c),
-            name="dense_2",
-        )(x)
+        w2, b2 = _LinearParams(
+            c, c, kernel_init=torch_linear_kernel_init,
+            bias_init=torch_linear_bias_init(c), name="dense_2")()
+        x = linear_apply(x, w2, b2, self.dtype)
         return x
 
 

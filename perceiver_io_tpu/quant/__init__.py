@@ -1,29 +1,39 @@
-"""Weight-only quantization for serving (int8 storage, f32/bf16 compute).
+"""Weight-only quantization for serving (int8/int4 storage, f32/bf16 compute).
 
-See :mod:`perceiver_io_tpu.quant.int8` for the scheme, the policy, and the
-tree contract (quantized key paths == f32 key paths — sharding rules and
-torch-parity names untouched).
+See :mod:`perceiver_io_tpu.quant.int8` for the scheme (per-channel int8,
+grouped int4), the policy, the tree contract (quantized key paths == f32
+key paths — sharding rules and torch-parity names untouched), and the
+:class:`QKernel` operand transport feeding the fused dequant-matmul kernel
+(:mod:`perceiver_io_tpu.ops.pallas_matmul`).
 """
 
 from perceiver_io_tpu.quant.int8 import (
+    DEFAULT_GROUP_SIZE,
     DEFAULT_QUANT_RULES,
+    QKernel,
     QuantizedParams,
+    apply_operands,
     bytes_summary,
     dequantize_array,
     dequantize_tree,
     is_quantized,
+    kernel_operands,
     quantize_array,
     quantize_tree,
     tree_bytes,
 )
 
 __all__ = [
+    "DEFAULT_GROUP_SIZE",
     "DEFAULT_QUANT_RULES",
+    "QKernel",
     "QuantizedParams",
+    "apply_operands",
     "bytes_summary",
     "dequantize_array",
     "dequantize_tree",
     "is_quantized",
+    "kernel_operands",
     "quantize_array",
     "quantize_tree",
     "tree_bytes",

@@ -1272,7 +1272,11 @@ def build_parser() -> argparse.ArgumentParser:
     eng.add_argument("--max_delay_ms", type=float, default=0.0)
     eng.add_argument("--dtype", choices=("float32", "bfloat16"),
                      default="float32")
-    eng.add_argument("--quantize", choices=("none", "int8"), default="none")
+    eng.add_argument("--quantize", choices=("none", "int8", "int4"),
+                     default="none")
+    eng.add_argument("--group_size", type=int, default=None,
+                     help="int4 quantization group size along the reduction "
+                          "dim (default 128)")
     eng.add_argument("--compile_cache", default=None)
     eng.add_argument("--no_warmup", action="store_true")
     eng.add_argument("--queue_limit", type=int, default=None)
@@ -1383,6 +1387,7 @@ def _build_app(args):
         max_delay_ms=args.max_delay_ms,
         compute_dtype="bfloat16" if args.dtype == "bfloat16" else None,
         quantize=None if args.quantize == "none" else args.quantize,
+        group_size=args.group_size,
         queue_limit=args.queue_limit,
         request_deadline_s=args.request_deadline_s,
         dispatch_retries=args.dispatch_retries,

@@ -373,6 +373,75 @@ def test_batched_replica_router_chaos_drill(tiny, oracle, rng):
         shared.close()
 
 
+# -- quantized decode: the weight stream must not touch the token stream -----
+
+
+def test_quantized_arena_stream_identity(tiny, oracle, rng):
+    """int8w decode (r24): the quantized arena serves streams bit-identical
+    to the quantized per-session engine (the scheduler never sees the
+    weight format), and on the tiny preset int8's logit perturbation is
+    small enough that GREEDY argmaxes still match the f32 oracle exactly —
+    the serving-level parity that matters. (Sampled top-k picks are NOT
+    cross-checked against f32: temperature reshapes the softmax enough
+    that a ~2e-2 logit perturbation legitimately flips draws.) The
+    prefix/budget band stays inside the width-16 episode: one compile
+    family per arm."""
+    model, params = tiny
+    cases = []
+    for i in range(4):
+        plen = int(rng.integers(2, 5))
+        prefix = [int(t) for t in rng.integers(3, VOCAB, plen)]
+        temp = 0.0 if i % 2 == 0 else 0.8
+        cases.append((prefix, int(rng.integers(3, 8)),
+                      SamplingConfig(temperature=temp, top_k=16, seed=i)))
+    seq8 = ARGenerator(model, params, max_seq_len=64, chunk=4,
+                       quantize="int8", name="q8-seq")
+    bat8 = ContinuousBatcher(model, params, max_seq_len=64, chunk=4,
+                             slots=2, max_slots=2, quantize="int8",
+                             name="q8-arena")
+    try:
+        assert bat8.quantize == "int8" and seq8.quantize == "int8"
+        from perceiver_io_tpu import quant
+
+        assert quant.is_quantized(seq8.params)
+        want = [seq8.generate(list(p), mn, s)[0] for p, mn, s in cases]
+        got = _fan_out(bat8, cases)
+        for i, (g, w) in enumerate(zip(got, want)):
+            assert g == w, f"int8 stream {i} diverged: {g} vs {w}"
+        for i, (p, mn, s) in enumerate(cases):
+            if s.temperature != 0.0:
+                continue
+            w = oracle.generate(list(p), mn, s)[0]
+            assert got[i] == w, f"int8 greedy vs f32 {i}: {got[i]} vs {w}"
+    finally:
+        bat8.close()
+
+
+@pytest.mark.slow  # coverage retained: test_quantized_arena_stream_identity
+# pins the quantized seq==batched identity tier-1 on int8; this is the same
+# assertion on the grouped-int4 tree (whose f32 divergence is expected —
+# 4-bit weights on a random tiny model move argmaxes)
+def test_int4_arena_matches_int4_sequential(tiny, rng):
+    model, params = tiny
+    cases = []
+    for i in range(3):
+        prefix = [int(t) for t in rng.integers(3, VOCAB, 3)]
+        cases.append((prefix, 5,
+                      SamplingConfig(temperature=0.8, top_k=16, seed=i)))
+    seq4 = ARGenerator(model, params, max_seq_len=64, chunk=4,
+                       quantize="int4", name="q4-seq")
+    bat4 = ContinuousBatcher(model, params, max_seq_len=64, chunk=4,
+                             slots=2, max_slots=2, quantize="int4",
+                             name="q4-arena")
+    try:
+        assert seq4.group_size == bat4.group_size and seq4.group_size
+        want = [seq4.generate(list(p), mn, s)[0] for p, mn, s in cases]
+        got = _fan_out(bat4, cases)
+        assert got == want
+    finally:
+        bat4.close()
+
+
 # -- the perf contract (slow: the tier-1 signal is the bench's JSON line) -----
 
 

@@ -424,6 +424,11 @@ def test_json_emitters_keep_one_line_stdout_contract(tmp_path):
     # causal prefill (batch = arena slots) at VMEM-guard boundaries
     assert "attn-arena8-q1-32k" in report["skipped"]
     assert "attn-arena16-prefill-d64" in report["skipped"]
+    # the fused dequant-matmul kernel geometries (r24): flagship vocab
+    # head, grouped int4, and the all-axes-unaligned pad/slice path
+    assert "qmm-int8-vocab-head" in report["skipped"]
+    assert "qmm-int4-grouped-mlp" in report["skipped"]
+    assert "qmm-int8-awkward-f32" in report["skipped"]
     with open(tmp_path / "ks.json") as f:
         assert json.loads(f.read()) == report
 
@@ -453,13 +458,51 @@ def test_quant_bench_cpu_emits_one_json_line(tmp_path):
     result = json.loads(lines[0])
     assert result["mode"] == "quant" and result["backend"] == "cpu"
     for key in ("bf16_requests_per_s", "int8w_requests_per_s",
-                "speedup_int8w_vs_bf16", "parity_bf16_rel_err",
-                "parity_int8w_rel_err", "param_bytes_int8w",
-                "predicted_weight_stream_ratio"):
+                "int4w_requests_per_s", "speedup_int8w_vs_bf16",
+                "speedup_int4w_vs_bf16", "parity_bf16_rel_err",
+                "parity_int8w_rel_err", "parity_int4w_rel_err",
+                "param_bytes_int8w", "param_bytes_int4w",
+                "predicted_weight_stream_ratio",
+                "predicted_weight_stream_ratio_int4w",
+                "qmm_pallas_ms", "qmm_xla_ms", "qmm_kernel_rel_err",
+                "speedup_qmm_pallas_vs_xla"):
         assert key in result, result
-    # the documented tiny-preset parity bound (PERF.md §Quantization)
+    # the documented tiny-preset parity bounds (PERF.md §Quantization)
     assert result["parity_int8w_rel_err"] <= 0.05, result
+    assert result["parity_int4w_rel_err"] <= 0.35, result
+    # the kernel A/B consumes identical quantized operands — any gap is
+    # purely kernel-vs-XLA, and in bf16 compute it measures exactly 0
+    assert result["qmm_kernel_rel_err"] <= 2e-5, result
     assert 0 < result["predicted_weight_stream_ratio"] < 1, result
+    assert (result["predicted_weight_stream_ratio_int4w"]
+            < result["predicted_weight_stream_ratio"]), result
+
+
+def test_quant_bench_dry_declares_record_keys(tmp_path):
+    """tools/quant_bench.py --dry: one JSON line declaring the record's key
+    contract without touching any device — what bench_compare and the
+    driver key their floor classes on (tier-1: no model build, <5 s)."""
+    import subprocess
+    import sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "quant_bench.py"),
+         "--dry"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    result = json.loads(lines[0])
+    assert result["mode"] == "quant" and result["dry"] is True
+    keys = set(result["keys"])
+    for key in ("bf16_requests_per_s", "int8w_requests_per_s",
+                "int4w_requests_per_s", "parity_int4w_rel_err",
+                "param_bytes_int4w", "qmm_pallas_ms",
+                "speedup_qmm_pallas_vs_xla"):
+        assert key in keys, result
+    assert "achieved_hbm_ratio_int8w_vs_bf16" in result["tpu_only_keys"]
 
 
 @pytest.mark.slow  # tier-1 budget (r19): the executable-cache tier keeps

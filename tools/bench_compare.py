@@ -122,6 +122,37 @@ FLOOR_CLASSES: List[Tuple[str, str, float, str, str]] = [
     (r"(^|\.)steps_lost$", "abs", 0.0, "lower",
      "PERF.md §Elastic training r23: zero-loss accounting is "
      "deterministic — ANY lost step is a regression"),
+    # quant_bench (r24): parity errors are seed/model-deterministic
+    # (identical quantized values every run) — only the compiler's lowering
+    # can wiggle the last ulps, so a 10% floor is already generous; any
+    # bigger jump means the kernel or the quantizer changed behavior.
+    (r"(^|\.)(parity_\w+_rel_err|qmm_kernel_rel_err)$", "frac", 0.10,
+     "lower", "PERF.md §Quantization r24: parity vs the f32 oracle is "
+     "deterministic per seed/preset; 10% floor covers compiler ulps"),
+    # byte accounting is pure arithmetic over the param tree: ANY drift is
+    # a storage-format change, not noise
+    (r"(^|\.)(param_bytes_\w+|predicted_weight_stream_ratio(_int4w)?)$",
+     "abs", 0.0, "lower",
+     "PERF.md §Quantization r24: predicted weight-stream bytes are "
+     "deterministic accounting — any change is a format change"),
+    # the engine-arm and kernel A/B speedups are SAME-PROCESS interleaved
+    # ratios (drift cancels): the r20 paired-speedup treatment
+    (r"(^|\.)(speedup_int[48]w_vs_bf16|speedup_qmm_pallas_vs_xla)$",
+     "frac", 0.15, "higher",
+     "PERF.md §Quantization r24: same-process interleaved A/B ratio; "
+     "per-round spread floor (the r20 paired-speedup class)"),
+    (r"(^|\.)(bf16|int8w|int4w)_requests_per_s$", "frac", HOST_FLOOR,
+     "higher",
+     "CLAUDE.md: CPU requests/s is host-clock, cross-session (±2x swing)"),
+    (r"(^|\.)qmm_(pallas|xla)_ms$", "frac", HOST_FLOOR, "lower",
+     "CLAUDE.md: kernel micro-A/B arm times are host-clock; only the "
+     "paired speedup_qmm ratio resolves finer"),
+    (r"(^|\.)device_dispatch_lq_ms_\w+$", "frac", DEVICE_FLOOR, "lower",
+     "PERF.md §Measurement r3: device-trace lower-quartile ±0.04%"),
+    (r"(^|\.)achieved_hbm_(bytes_per_dispatch_\w+|ratio_\w+)$", "frac",
+     0.05, "lower",
+     "PERF.md §Quantization r24: traced HBM bytes/dispatch vary with "
+     "batching composition ~5% run-to-run"),
 ]
 
 # bench.py's headline: 'value' is device-trace only when the record says so

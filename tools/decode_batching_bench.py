@@ -39,7 +39,7 @@ from perceiver_io_tpu.utils.jsonline import emit_json_line  # noqa: E402
 RECORD_KEYS = (
     "metric", "dry", "backend", "streams", "concurrency", "chunk", "slots",
     "pairs", "mean_new", "max_new_cap", "prefix_lens", "temperature",
-    "top_k",
+    "top_k", "quantize",
     "batched_tokens_per_s", "sequential_tokens_per_s",
     "speedup", "speedup_median", "tokens_match",
     "admitted", "retired", "slot_occupancy_mean", "steps_per_dispatch_mean",
@@ -145,15 +145,17 @@ def run(args) -> int:
     sampling = SamplingConfig(temperature=args.temperature,
                               top_k=args.top_k, seed=args.seed)
 
+    quantize = None if args.quantize == "none" else args.quantize
     seq = ARGenerator(model, params, max_seq_len=max_seq_len,
-                      chunk=args.chunk, name="ab_seq")
+                      chunk=args.chunk, quantize=quantize, name="ab_seq")
     # max_slots pinned to slots: arena growth is the right policy on TPU
     # (a marginal slot rides the same weight stream) but on CPU every slot
     # costs linear compute, so the A/B holds capacity fixed and lets the
     # admission queue keep the arena full instead.
     bat = ContinuousBatcher(model, params, max_seq_len=max_seq_len,
                             chunk=args.chunk, slots=args.slots,
-                            max_slots=args.slots, name="ab_bat")
+                            max_slots=args.slots, quantize=quantize,
+                            name="ab_bat")
     sched = _schedule(args, vocab=int(model.input_adapter.vocab_size),
                       max_seq_len=max_seq_len)
     _log(f"{len(sched)} streams, concurrency {args.concurrency}, "
@@ -199,6 +201,7 @@ def run(args) -> int:
         "mean_new": args.mean_new, "max_new_cap": args.max_new_cap,
         "prefix_lens": args.prefix_lens,
         "temperature": args.temperature, "top_k": args.top_k,
+        "quantize": args.quantize,
         "batched_tokens_per_s": per_pair[-1]["batched_tokens_per_s"],
         "sequential_tokens_per_s": per_pair[-1]["sequential_tokens_per_s"],
         "speedup": per_pair[-1]["speedup"],
@@ -248,6 +251,11 @@ def main() -> None:
                    help="arrival stagger between launch cohorts")
     p.add_argument("--temperature", type=float, default=0.8)
     p.add_argument("--top_k", type=int, default=16)
+    p.add_argument("--quantize", choices=("none", "int8", "int4"),
+                   default="none",
+                   help="weight-only quantization for BOTH arms (the A/B "
+                        "stays apples-to-apples; sequential==batched token "
+                        "identity must hold per mode — tests/test_batching)")
     p.add_argument("--seed", type=int, default=0)
     raise SystemExit(run(p.parse_args()))
 

@@ -33,6 +33,10 @@ Covered paths and what each geometry pins:
 - the weight-only int8 serving path (`perceiver_io_tpu.quant`): in-program
   dequant (int8 values × f32 per-channel scales → bf16) feeding a matmul,
   parity-checked against the f32 oracle.
+- the fused dequant-matmul kernel (``ops/pallas_matmul``) at the flagship
+  vocab-head shape (int8), a grouped-int4 MLP shape (bk pinned to the
+  group), and an all-axes-unaligned f32 shape (the pad/slice path) — each
+  vs the XLA-dequant oracle over identical quantized values.
 """
 
 from __future__ import annotations
@@ -157,6 +161,31 @@ def _quant_case():
     _assert_close("int8w-matmul", got, ref)
 
 
+def _qmm_case(m, k, n, bits=8, group_size=None, compute_dtype="bfloat16",
+              rtol=0.02, seed=0):
+    """Fused dequant-matmul kernel (ops/pallas_matmul) vs the XLA-dequant
+    oracle over the SAME quantized values — any difference is purely
+    kernel-vs-XLA, so the bound is tight. Pins that the int8/int4
+    convert×scale-in-VMEM lowering and the block/padding resolution stay
+    sane as Mosaic moves (the r3 lesson: scoped-VMEM boundaries only
+    surface on the real compiler)."""
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.ops.pallas_matmul import quantized_matmul
+    from perceiver_io_tpu.quant.int8 import QKernel, quantize_array
+
+    rng = np.random.default_rng(seed)
+    w = rng.normal(0, 0.02, (k, n)).astype(np.float32)
+    x = jnp.asarray(rng.normal(0, 1, (m, k)), jnp.dtype(compute_dtype))
+    q, scale = quantize_array(w, bits=bits, group_size=group_size)
+    store = jnp.int8 if bits == 8 else jnp.int4
+    qk = QKernel(jnp.asarray(q, store), jnp.asarray(scale), compute_dtype)
+
+    got = quantized_matmul(x, qk, impl="pallas")
+    ref = (x.astype(qk.compute_dtype) @ qk.dequantize()).astype(x.dtype)
+    _assert_close(f"qmm-int{bits}", got, ref, rtol=rtol)
+
+
 def _sp_case():
     import jax
     import jax.numpy as jnp
@@ -210,6 +239,19 @@ CASES = {
     # weight-only int8: in-program dequant feeding a bf16 matmul stays
     # within parity vs the f32 oracle (the serving engines' int8w path)
     "quant-int8w-dequant": _quant_case,
+    # -- fused dequant-matmul (ops/pallas_matmul) guard geometries --
+    # the flagship vocab head (C=64 → 10003 padded to 10112): the single
+    # biggest weight stream in the serving forward, lane-unaligned only
+    # after class padding — the shape the int8w serving path lives on
+    "qmm-int8-vocab-head": lambda: _qmm_case(512, 64, 10112, bits=8),
+    # grouped int4 at the flagship MLP width: bk pinned to group_size=128
+    # (the grouped-scale broadcast path), K a multiple of the group
+    "qmm-int4-grouped-mlp": lambda: _qmm_case(2048, 512, 2048, bits=4,
+                                              group_size=128),
+    # sublane/lane-unaligned M/K/N: the zero-pad + slice path, f32 compute
+    # (parity dtype) where kernel-vs-XLA must be near-exact
+    "qmm-int8-awkward-f32": lambda: _qmm_case(
+        96, 320, 161, bits=8, compute_dtype="float32", rtol=2e-5),
     # -- generative decode geometries (the in-kernel causal flag) --
     # causal prefill at the d<=128 wide-KV tier (kv resolves to 2048 with
     # the q-bump interplay): fwd + BOTH backward kernels recompute the same

@@ -1014,6 +1014,13 @@ def main() -> None:
     parser.add_argument("--rates", default=None,
                         help="explicit offered rates (requests/s, comma-"
                              "separated) — overrides --rate_factors")
+    parser.add_argument("--quantize", choices=("none", "int8", "int4"),
+                        default="none",
+                        help="weight-only quantized serving for every "
+                             "engine/generator this run builds (the fused "
+                             "dequant-matmul weight stream under load; "
+                             "process replicas get it via --quantize "
+                             "passthrough)")
     parser.add_argument("--max_batch", type=int, default=8,
                         help="engine micro-batch cap")
     parser.add_argument("--queue_limit", type=int, default=64,
@@ -1235,6 +1242,7 @@ def main() -> None:
             "metric": "load_bench", "dry": True, "backend": None,
             "preset": args.preset, "arrival": args.arrival,
             "duration_s": args.duration_s, "schedule": args.schedule,
+            "quantize": args.quantize,
             "point_keys": list(POINT_KEYS), "phase_keys": list(PHASE_KEYS),
             "fleet_keys": list(FLEET_KEYS), "deploy_keys": list(DEPLOY_KEYS),
             "trace_keys": list(TRACE_KEYS),
@@ -1327,6 +1335,8 @@ def main() -> None:
 
             extra = ["--preset", "tiny" if tiny else "flagship",
                      "--max_batch", str(args.max_batch)]
+            if args.quantize != "none":
+                extra += ["--quantize", args.quantize]
             if args.cpu:
                 extra.append("--cpu")
             if queue_limit is not None:
@@ -1373,6 +1383,8 @@ def main() -> None:
                 made[0] += 1
                 eng = ServingEngine(
                     gathered_apply, params, max_batch=args.max_batch,
+                    quantize=(None if args.quantize == "none"
+                              else args.quantize),
                     name=f"lb_r{i}", registry=registry,
                     queue_limit=queue_limit,
                     request_deadline_s=args.deadline_s,
@@ -1398,12 +1410,16 @@ def main() -> None:
                             ar_model, ar_params, max_seq_len=64,
                             chunk=args.generate_chunk,
                             slots=args.decode_slots,
+                            quantize=(None if args.quantize == "none"
+                                      else args.quantize),
                             name=f"lb_r{i}-gen", registry=registry)
                     else:
                         generator = ARGenerator(
                             ar_model, ar_params, max_seq_len=64,
-                            chunk=args.generate_chunk, name=f"lb_r{i}-gen",
-                            registry=registry)
+                            chunk=args.generate_chunk,
+                            quantize=(None if args.quantize == "none"
+                                      else args.quantize),
+                            name=f"lb_r{i}-gen", registry=registry)
                     warm_sampling = SamplingConfig(
                         temperature=GENERATE_TEMPERATURE,
                         top_k=GENERATE_TOP_K)
@@ -1453,6 +1469,7 @@ def main() -> None:
         gathered_apply, params = build_model_apply()
         engine = ServingEngine(
             gathered_apply, params, max_batch=args.max_batch,
+            quantize=None if args.quantize == "none" else args.quantize,
             name="load_bench", registry=registry,
             queue_limit=queue_limit,
             request_deadline_s=args.deadline_s,
@@ -1943,7 +1960,7 @@ def main() -> None:
         "preset": "tiny" if tiny else "flagship",
         "arrival": args.arrival, "burst": args.burst,
         "duration_s": args.duration_s, "schedule": args.schedule,
-        "max_batch": args.max_batch,
+        "max_batch": args.max_batch, "quantize": args.quantize,
         "queue_limit": args.queue_limit, "seed": args.seed,
         "seq_len": max_seq_len,
         "calibrated_rps": round(cal_rps, 3),

@@ -1,5 +1,6 @@
-"""Weight-only int8 serving A/B: bf16 vs int8w through the micro-batching
-engine, with parity vs the f32 oracle and bytes-streamed accounting.
+"""Weight-only quantized serving A/B: bf16 vs int8w vs grouped-int4w
+through the micro-batching engine, with parity vs the f32 oracle,
+bytes-streamed accounting, and a fused-kernel-vs-XLA micro A/B.
 
 The measured roofline (PERF.md, `tools/hbm_roofline.py`) shows the serving
 forward bound by HBM param/elementwise streams, and every engine dispatch
@@ -23,14 +24,22 @@ discipline:
    inside the engine's StepTraceAnnotation windows (the same analysis
    `tools/hbm_roofline.py` runs) — prediction vs measurement in one record.
 
+4. **Kernel A/B** (r24): the fused dequant-matmul Pallas kernel
+   (``ops/pallas_matmul``) vs the XLA dequant-then-matmul lowering on the
+   SAME int8 vocab-head-shaped operands, same-process interleaved rounds.
+   On CPU the kernel runs in interpret mode — expected much slower (a
+   documented negative result, PERF.md §Quantization); the decision-grade
+   number is the TPU run (§r10 queue).
+
 Prints ONE JSON line on stdout (logs on stderr) — the driver-trackable
 contract shared with ``tools/inference_bench.py --engine``. ``--cpu`` pins
 the CPU backend before jax initializes (the tier-1 offline mode, tiny
 preset); TPU runs additionally carry the ``device_*``/``achieved_*`` keys.
+``--dry`` emits the record's key contract without touching any device.
 
 Usage::
 
-    timeout 1800 python tools/quant_bench.py [--cpu]
+    timeout 1800 python tools/quant_bench.py [--cpu] [--dry]
         [--preset auto|tiny|flagship] [--requests N] [--rounds R]
         [--max_batch M] [--trace-dir DIR]
 """
@@ -132,6 +141,71 @@ def _trace_hbm_per_dispatch(round_fn, trace_dir: str):
     return tot_hbm / len(windows), lq_s, len(windows)
 
 
+# the record's key contract, declared for --dry (bench_compare and the
+# driver read this shape; TPU runs add the achieved/device keys)
+RECORD_KEYS = (
+    "mode", "backend", "preset", "requests", "rounds", "max_batch",
+    "seq_len",
+    "bf16_requests_per_s", "int8w_requests_per_s", "int4w_requests_per_s",
+    "speedup_int8w_vs_bf16", "speedup_int4w_vs_bf16",
+    "parity_bf16_rel_err", "parity_int8w_rel_err", "parity_int4w_rel_err",
+    "param_bytes_f32", "param_bytes_bfloat16", "param_bytes_int8w",
+    "param_bytes_int4w", "quantized_leaves",
+    "predicted_weight_stream_ratio", "predicted_weight_stream_ratio_int4w",
+    "qmm_shape", "qmm_xla_ms", "qmm_pallas_ms", "qmm_kernel_rel_err",
+    "speedup_qmm_pallas_vs_xla",
+)
+TPU_ONLY_KEYS = (
+    "achieved_hbm_bytes_per_dispatch_bf16",
+    "achieved_hbm_bytes_per_dispatch_int8w",
+    "achieved_hbm_bytes_per_dispatch_int4w",
+    "device_dispatch_lq_ms_bf16", "device_dispatch_lq_ms_int8w",
+    "device_dispatch_lq_ms_int4w",
+    "achieved_hbm_ratio_int8w_vs_bf16",
+)
+
+
+def _qmm_kernel_ab(tiny: bool, rounds: int):
+    """Same-process interleaved fused-Pallas-vs-XLA dequant-matmul A/B at
+    the vocab-head shape (the biggest serving weight stream). Both impls
+    consume the SAME int8 operands, so ``qmm_kernel_rel_err`` is purely
+    kernel-vs-XLA. Off-TPU the kernel runs in interpret mode — the timing
+    is a correctness exercise, not a perf claim (PERF.md discipline)."""
+    import jax
+    import jax.numpy as jnp
+
+    from perceiver_io_tpu.ops.pallas_matmul import quantized_matmul
+    from perceiver_io_tpu.quant.int8 import QKernel, quantize_array
+
+    m, k, n = (64, 32, 384) if tiny else (512, 64, 10112)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 1, (m, k)), jnp.bfloat16)
+    q, scale = quantize_array(rng.normal(0, 0.02, (k, n)).astype(np.float32))
+    qk = QKernel(jnp.asarray(q, jnp.int8), jnp.asarray(scale), "bfloat16")
+
+    impls = {
+        "pallas": jax.jit(lambda x, w: quantized_matmul(x, w, impl="pallas")),
+        "xla": jax.jit(lambda x, w: quantized_matmul(x, w, impl="xla")),
+    }
+    outs = {name: np.asarray(fn(x, qk), np.float32)
+            for name, fn in impls.items()}  # warm + parity in one pass
+    rel_err = _rel_to_peak_err(outs["pallas"], outs["xla"])
+    times = {name: [] for name in impls}
+    for _ in range(max(rounds, 2)):  # interleaved: pallas, xla, pallas, ...
+        for name, fn in impls.items():
+            t0 = time.perf_counter()
+            fn(x, qk).block_until_ready()
+            times[name].append(time.perf_counter() - t0)
+    med = {k_: statistics.median(v) for k_, v in times.items()}
+    return {
+        "qmm_shape": f"{m}x{k}x{n}",
+        "qmm_xla_ms": round(med["xla"] * 1e3, 4),
+        "qmm_pallas_ms": round(med["pallas"] * 1e3, 4),
+        "qmm_kernel_rel_err": round(rel_err, 6),
+        "speedup_qmm_pallas_vs_xla": round(med["xla"] / med["pallas"], 4),
+    }
+
+
 def main() -> None:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--cpu", action="store_true",
@@ -149,7 +223,19 @@ def main() -> None:
                         help="engine micro-batch cap")
     parser.add_argument("--trace-dir", default=None,
                         help="keep TPU traces here instead of a temp dir")
+    parser.add_argument("--dry", action="store_true",
+                        help="emit the record's key contract as one JSON "
+                             "line without touching any device (stdout-"
+                             "contract CI mode, like kernel_smoke --dry)")
     args = parser.parse_args()
+
+    if args.dry:
+        emit_json_line({
+            "mode": "quant", "dry": True,
+            "keys": list(RECORD_KEYS),
+            "tpu_only_keys": list(TPU_ONLY_KEYS),
+        })
+        return
 
     if args.cpu:
         from perceiver_io_tpu.utils.platform import ensure_cpu_only
@@ -187,11 +273,21 @@ def main() -> None:
     )
 
     bytes_acct = quant.bytes_summary(params, compute_dtype="bfloat16")
+    int4_acct = quant.bytes_summary(
+        params, qparams=quant.quantize_tree(
+            params, compute_dtype="bfloat16", bits=4),
+        compute_dtype="bfloat16",
+    )
+    bytes_acct["param_bytes_int4w"] = int4_acct["param_bytes_int4w"]
+    bytes_acct["predicted_weight_stream_ratio_int4w"] = (
+        int4_acct["predicted_weight_stream_ratio"])
     _log(f"param bytes: f32 {bytes_acct['param_bytes_f32']:,} / bf16 "
          f"{bytes_acct['param_bytes_bfloat16']:,} / int8w "
-         f"{bytes_acct['param_bytes_int8w']:,} "
-         f"(predicted weight-stream ratio "
-         f"{bytes_acct['predicted_weight_stream_ratio']})")
+         f"{bytes_acct['param_bytes_int8w']:,} / int4w "
+         f"{bytes_acct['param_bytes_int4w']:,} "
+         f"(predicted weight-stream ratios "
+         f"{bytes_acct['predicted_weight_stream_ratio']} / "
+         f"{bytes_acct['predicted_weight_stream_ratio_int4w']})")
 
     engines = {
         "bf16": ServingEngine(
@@ -201,6 +297,10 @@ def main() -> None:
         "int8w": ServingEngine(
             gathered_apply, params, max_batch=args.max_batch,
             compute_dtype="int8w", name="quant_bench_int8w",
+        ),
+        "int4w": ServingEngine(
+            gathered_apply, params, max_batch=args.max_batch,
+            compute_dtype="int4w", name="quant_bench_int4w",
         ),
     }
     try:
@@ -229,13 +329,20 @@ def main() -> None:
 
         for eng in engines.values():  # unmeasured steady-state round each
             engine_round(eng)
-        times = {"bf16": [], "int8w": []}
-        for r in range(args.rounds):  # interleaved: A, B, A, B, ...
+        times = {name: [] for name in engines}
+        for r in range(args.rounds):  # interleaved: A, B, C, A, B, C, ...
             for name, eng in engines.items():
                 times[name].append(engine_round(eng))
-            _log(f"round {r}: bf16 {times['bf16'][-1]:.3f}s "
-                 f"int8w {times['int8w'][-1]:.3f}s")
+            _log("round %d: %s" % (r, " ".join(
+                f"{name} {times[name][-1]:.3f}s" for name in engines)))
         med = {k: statistics.median(v) for k, v in times.items()}
+
+        # the fused-kernel-vs-XLA micro A/B (interleaved, same operands)
+        qmm = _qmm_kernel_ab(tiny, args.rounds)
+        _log(f"qmm {qmm['qmm_shape']}: pallas {qmm['qmm_pallas_ms']} ms vs "
+             f"xla {qmm['qmm_xla_ms']} ms (speedup "
+             f"{qmm['speedup_qmm_pallas_vs_xla']}x, rel err "
+             f"{qmm['qmm_kernel_rel_err']})")
 
         n = args.requests
         results = {
@@ -245,10 +352,14 @@ def main() -> None:
             "max_batch": args.max_batch, "seq_len": max_seq_len,
             "bf16_requests_per_s": round(n / med["bf16"], 2),
             "int8w_requests_per_s": round(n / med["int8w"], 2),
+            "int4w_requests_per_s": round(n / med["int4w"], 2),
             "speedup_int8w_vs_bf16": round(med["bf16"] / med["int8w"], 3),
+            "speedup_int4w_vs_bf16": round(med["bf16"] / med["int4w"], 3),
             "parity_bf16_rel_err": round(parity["bf16"], 6),
             "parity_int8w_rel_err": round(parity["int8w"], 6),
+            "parity_int4w_rel_err": round(parity["int4w"], 6),
             **bytes_acct,
+            **qmm,
         }
 
         # achieved bytes-streamed (TPU): trace one round per arm, sum HBM
